@@ -1,0 +1,27 @@
+"""gpuschedule_tpu — a TPU-native deep-learning cluster scheduling framework.
+
+A ground-up rebuild of the capabilities of matthewygf/GPUSchedule for TPU pods:
+a trace-replay simulator (Microsoft Philly trace + synthetic Poisson workloads)
+evaluating scheduling/placement/preemption policies (FIFO, SRTF, Tiresias-LAS,
+Gandiva, Optimus) over contiguous TPU v5e/v5p sub-mesh ("slice") allocations,
+plus an online per-job throughput profiler implemented as a JAX/XLA step-time
+harness over ICI (replacing the reference's torch.distributed + NCCL allreduce
+microbenchmarks).
+
+Provenance note: `/root/reference` was an empty mount during both the survey and
+build sessions (see SURVEY.md §0), so docstrings in this package cite SURVEY.md
+sections and BASELINE.json lines instead of reference `file:line`.
+
+Layering (SURVEY.md §1):
+    sim/        job model, trace replay, discrete-event engine, metrics
+    cluster/    TPU torus topology + contiguous slice allocator (+ GPU model
+                for the topology-aware comparison config)
+    policies/   FIFO, SRTF, Tiresias-DLAS, Gandiva, Optimus
+    placement/  consolidated / random / greedy / topology-aware schemes
+    profiler/   JAX step-time harness, ICI cost model, goodput curve fitting
+    models/     flax benchmark models driven by the profiler
+    parallel/   mesh construction + sharded train steps (dp/tp/sp)
+    ops/        pallas TPU kernels for the benchmark models
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,12 @@
+"""Cluster models (SURVEY.md §1 layer 3).
+
+Where the reference models a Switch → Node → GPU tree with NVLink/PCIe
+locality, this package models TPU pods as ICI tori with contiguous slice
+allocation (``TpuCluster``), plus a flat counting pool (``SimpleCluster``)
+for policy-only experiments and a GPU node model (``GpuCluster``) for the
+topology-aware comparison config (BASELINE.json config #5).
+"""
+
+from gpuschedule_tpu.cluster.base import Allocation, ClusterBase, SimpleCluster
+
+__all__ = ["Allocation", "ClusterBase", "SimpleCluster"]

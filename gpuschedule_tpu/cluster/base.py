@@ -1,0 +1,85 @@
+"""Cluster allocation protocol + flat counting cluster.
+
+All cluster flavors expose the same surface the reference's CLUSTER singleton
+offered its policies (SURVEY.md §1 layer 3: "allocate/release GPU sets,
+free-resource queries"): ``allocate(num_chips) -> Allocation | None`` with
+all-or-nothing gang semantics, ``free(allocation)``, and capacity properties.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass(frozen=True)
+class Allocation:
+    """Handle for a granted gang allocation.
+
+    ``detail`` is cluster-flavor specific: a slice geometry for
+    :class:`~gpuschedule_tpu.cluster.tpu.TpuCluster`, a node→gpu map for the
+    GPU model, nothing for :class:`SimpleCluster`.
+    """
+
+    alloc_id: int
+    num_chips: int
+    detail: Any = None
+
+
+class ClusterBase:
+    """Protocol all cluster models implement."""
+
+    total_chips: int
+
+    @property
+    def used_chips(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def free_chips(self) -> int:
+        return self.total_chips - self.used_chips
+
+    def allocate(self, num_chips: int, *, job=None, hint: Optional[dict] = None):
+        """Grant ``num_chips`` chips or return ``None`` (all-or-nothing)."""
+        raise NotImplementedError
+
+    def free(self, allocation: Allocation) -> None:
+        raise NotImplementedError
+
+    def can_allocate(self, num_chips: int) -> bool:
+        """Cheap feasibility probe (may be optimistic only for flavors where
+        placement can still fail; SimpleCluster's answer is exact)."""
+        return num_chips <= self.free_chips
+
+
+class SimpleCluster(ClusterBase):
+    """Flat chip pool with no topology — the minimal stand-in that makes the
+    policy layer runnable before (or without) the slice allocator, equivalent
+    to treating the cluster as one big node."""
+
+    def __init__(self, total_chips: int):
+        self.total_chips = int(total_chips)
+        self._used = 0
+        self._ids = itertools.count()
+        self._live: dict[int, int] = {}
+
+    @property
+    def used_chips(self) -> int:
+        return self._used
+
+    def allocate(self, num_chips: int, *, job=None, hint: Optional[dict] = None):
+        if num_chips <= 0 or num_chips > self.free_chips:
+            return None
+        alloc = Allocation(next(self._ids), num_chips)
+        self._live[alloc.alloc_id] = num_chips
+        self._used += num_chips
+        return alloc
+
+    def free(self, allocation: Optional[Allocation]) -> None:
+        if allocation is None:
+            return
+        n = self._live.pop(allocation.alloc_id, None)
+        if n is None:
+            raise ValueError(f"double free of allocation {allocation.alloc_id}")
+        self._used -= n

@@ -1,0 +1,29 @@
+"""Scheduling policies (SURVEY.md §2, layer 6).
+
+Registry maps CLI names to policy factories; policies plug into the engine via
+the :class:`gpuschedule_tpu.policies.base.Policy` interface.
+"""
+
+from gpuschedule_tpu.policies.base import Policy
+from gpuschedule_tpu.policies.fifo import FifoPolicy
+
+_REGISTRY = {"fifo": FifoPolicy}
+
+
+def register(name: str, factory) -> None:
+    _REGISTRY[name] = factory
+
+
+def make_policy(name: str, **kwargs) -> Policy:
+    """Instantiate a registered policy by CLI name (e.g. 'fifo', 'dlas')."""
+    try:
+        return _REGISTRY[name](**kwargs)
+    except KeyError:
+        raise ValueError(f"unknown policy {name!r}; known: {sorted(_REGISTRY)}") from None
+
+
+def available() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+__all__ = ["Policy", "FifoPolicy", "make_policy", "available", "register"]

@@ -1,0 +1,32 @@
+"""Policy interface.
+
+A policy is invoked by the engine after every event batch (arrival,
+completion, or a wakeup the policy itself requested) and manipulates jobs
+exclusively through the engine API — ``sim.try_start`` / ``sim.preempt`` /
+``sim.set_speed`` / ``sim.migrate`` / ``sim.resize`` — which is the same
+contract as the reference's per-policy ``*_sim_jobs`` loops acting on the
+global JOBS/CLUSTER singletons (SURVEY.md §3.1), minus the globals.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class Policy:
+    """Base class for scheduling policies."""
+
+    name: str = "base"
+
+    def attach(self, sim) -> None:
+        """Called once before the run starts; override for setup that needs
+        the cluster/trace (e.g. Tiresias queue thresholds)."""
+
+    def schedule(self, sim) -> Optional[float]:
+        """Make scheduling decisions at ``sim.now``.
+
+        Returns an optional absolute sim time at which the policy wants to be
+        woken even if no arrival/completion occurs (time-slice quanta,
+        periodic rounds).  Return ``None`` for purely event-driven policies.
+        """
+        raise NotImplementedError

@@ -1,0 +1,31 @@
+"""FIFO: non-preemptive gang scheduling in arrival order.
+
+Head-of-line blocking is intentional and part of the policy's definition
+(SURVEY.md §2 "Policy: FIFO": "Non-preemptive gang scheduling in arrival
+order; head-of-line blocks"): if the oldest pending job's gang cannot be
+placed, nothing behind it starts, which is what makes FIFO the baseline the
+preemptive policies beat.  A ``backfill=True`` variant relaxes that for
+comparison runs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from gpuschedule_tpu.policies.base import Policy
+
+
+class FifoPolicy(Policy):
+    name = "fifo"
+
+    def __init__(self, *, backfill: bool = False):
+        self.backfill = backfill
+
+    def schedule(self, sim) -> Optional[float]:
+        queue = sorted(sim.pending, key=lambda j: (j.submit_time, j.job_id))
+        for job in queue:
+            if sim.try_start(job):
+                continue
+            if not self.backfill:
+                break  # head-of-line blocks
+        return None

@@ -1,0 +1,10 @@
+"""Simulation core: job model, traces, discrete-event engine, metrics.
+
+This layer is deliberately JAX-free: trace replay must run end-to-end with no
+accelerator in the loop (BASELINE.json north_star; SURVEY.md §4).
+"""
+
+from gpuschedule_tpu.sim.job import Job, JobState
+from gpuschedule_tpu.sim.engine import Simulator, SimResult
+
+__all__ = ["Job", "JobState", "Simulator", "SimResult"]

@@ -1,0 +1,246 @@
+"""Discrete-event simulation engine.
+
+The reference drives its policies from per-policy time-stepped while-loops
+(SURVEY.md §3.1: advance clock, charge progress, invoke policy, apply
+preemptions).  This engine keeps that contract — progress charging, policy
+invocation after every state change, gang-aware start/preempt — but is
+event-driven rather than fixed-delta: the clock jumps between arrivals,
+(predicted) completions, and policy-requested wakeups ("ticks", used for
+Tiresias quanta / Gandiva rounds / Optimus rounds).  Completion events are
+predicted from each job's current speed and invalidated by a per-job epoch
+counter whenever a preemption/resize changes the prediction, so replay is
+exact rather than quantized to a time step.
+
+Single-process, pure Python, no accelerator in the loop (SURVEY.md §3.1:
+"pure single-process CPU sim").
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from gpuschedule_tpu.sim.job import END_STATES, Job, JobState
+from gpuschedule_tpu.sim.metrics import MetricsLog, SimResult
+
+# Event kinds, in processing-priority order at equal timestamps: completions
+# free resources before arrivals are considered, and the policy runs once
+# after the whole batch.
+_COMPLETION, _ARRIVAL, _TICK = 0, 1, 2
+
+
+class Simulator:
+    """Replay a trace against a cluster under a policy.
+
+    The policy object receives this simulator as its scheduling context and
+    mutates job state only through the engine API (:meth:`try_start`,
+    :meth:`preempt`, :meth:`set_speed`, :meth:`migrate`), which keeps
+    progress accounting and completion prediction consistent.
+    """
+
+    def __init__(
+        self,
+        cluster,
+        policy,
+        jobs: Sequence[Job],
+        *,
+        metrics: Optional[MetricsLog] = None,
+        max_time: float = float("inf"),
+        eps: float = 1e-6,
+    ):
+        self.cluster = cluster
+        self.policy = policy
+        self.jobs: List[Job] = sorted(jobs, key=lambda j: (j.submit_time, j.job_id))
+        self.metrics = metrics or MetricsLog()
+        self.max_time = max_time
+        self.eps = eps
+
+        self.now: float = 0.0
+        self.pending: List[Job] = []      # submitted, not running, not finished
+        self.running: List[Job] = []      # holding allocations
+        self.finished: List[Job] = []
+        self._heap: list = []
+        self._seq = itertools.count()
+
+        for job in self.jobs:
+            self._push(job.submit_time, _ARRIVAL, job)
+        policy.attach(self)
+
+    # ------------------------------------------------------------------ #
+    # event plumbing
+
+    def _push(self, time: float, kind: int, payload=None, epoch: int = 0) -> None:
+        heapq.heappush(self._heap, (time, kind, next(self._seq), payload, epoch))
+
+    def request_wakeup(self, time: float) -> None:
+        """Policy-facing: ask to be re-invoked at absolute sim time ``time``."""
+        if time > self.now + self.eps:
+            self._push(time, _TICK)
+
+    def _schedule_completion(self, job: Job) -> None:
+        rt = job.remaining_runtime()
+        if rt != float("inf"):
+            self._push(self.now + rt, _COMPLETION, job, job.epoch)
+
+    def _advance_running(self, t: float) -> None:
+        for job in self.running:
+            job.advance(t)
+
+    # ------------------------------------------------------------------ #
+    # policy-facing mutation API
+
+    def try_start(
+        self,
+        job: Job,
+        *,
+        chips: Optional[int] = None,
+        speed: float = 1.0,
+        overhead: float = 0.0,
+        placement_hint: Optional[dict] = None,
+    ) -> bool:
+        """Gang-start (or resume) ``job`` on ``chips`` chips; False if the
+        cluster cannot grant a valid allocation (all-or-nothing, SURVEY.md §3.1
+        placement step)."""
+        assert job.state in (JobState.PENDING, JobState.SUSPENDED), job
+        chips = chips if chips is not None else job.num_chips
+        alloc = self.cluster.allocate(chips, job=job, hint=placement_hint)
+        if alloc is None:
+            return False
+        job.advance(self.now)
+        job.allocation = alloc
+        job.allocated_chips = chips
+        job.state = JobState.RUNNING
+        job.speed = speed
+        job.overhead_remaining += overhead
+        job.epoch += 1
+        if job.first_start_time is None:
+            job.first_start_time = self.now
+        if job in self.pending:
+            self.pending.remove(job)
+        self.running.append(job)
+        self._schedule_completion(job)
+        return True
+
+    def preempt(self, job: Job, *, suspend: bool = True) -> None:
+        """Take ``job`` off the cluster.  ``suspend=True`` marks it as a
+        time-sliced victim with resume intent (Gandiva); ``suspend=False``
+        returns it to the pending queue (Tiresias/SRTF demotion)."""
+        assert job.state is JobState.RUNNING, job
+        job.advance(self.now)
+        self.cluster.free(job.allocation)
+        job.allocation = None
+        job.allocated_chips = 0
+        job.speed = 0.0
+        job.epoch += 1
+        job.preempt_count += 1
+        job.state = JobState.SUSPENDED if suspend else JobState.PENDING
+        self.running.remove(job)
+        self.pending.append(job)
+        self.metrics.count("preemptions")
+
+    def set_speed(self, job: Job, speed: float) -> None:
+        """Change a running job's progress rate (elastic resize effect)."""
+        assert job.state is JobState.RUNNING, job
+        job.advance(self.now)
+        job.speed = speed
+        job.epoch += 1
+        self._schedule_completion(job)
+
+    def migrate(self, job: Job, *, overhead: float, placement_hint: Optional[dict] = None) -> bool:
+        """Move a running job to a fresh allocation, paying ``overhead``
+        seconds of modeled checkpoint/restore cost (SURVEY.md §3.3 migration)."""
+        assert job.state is JobState.RUNNING, job
+        chips, speed = job.allocated_chips, job.speed
+        job.advance(self.now)
+        self.cluster.free(job.allocation)
+        alloc = self.cluster.allocate(chips, job=job, hint=placement_hint)
+        if alloc is None:  # shouldn't happen (we just freed); restore in place
+            alloc = self.cluster.allocate(chips, job=job)
+            assert alloc is not None, "allocation vanished during migration"
+            job.allocation = alloc
+            return False
+        job.allocation = alloc
+        job.overhead_remaining += overhead
+        job.migration_count += 1
+        job.epoch += 1
+        self._schedule_completion(job)
+        self.metrics.count("migrations")
+        return True
+
+    def resize(self, job: Job, *, chips: int, speed: float, overhead: float = 0.0) -> bool:
+        """Elastic grow/shrink (Optimus, SURVEY.md §3.2): re-allocate ``job``
+        at ``chips`` with new progress rate ``speed``."""
+        assert job.state is JobState.RUNNING, job
+        if chips == job.allocated_chips and speed == job.speed:
+            return True
+        job.advance(self.now)
+        self.cluster.free(job.allocation)
+        alloc = self.cluster.allocate(chips, job=job)
+        if alloc is None:
+            alloc = self.cluster.allocate(job.allocated_chips, job=job)
+            assert alloc is not None, "allocation vanished during resize"
+            job.allocation = alloc
+            job.epoch += 1
+            self._schedule_completion(job)
+            return False
+        job.allocation = alloc
+        job.allocated_chips = chips
+        job.speed = speed
+        job.overhead_remaining += overhead
+        job.epoch += 1
+        self._schedule_completion(job)
+        return True
+
+    # ------------------------------------------------------------------ #
+
+    def _finish(self, job: Job) -> None:
+        job.advance(self.now)
+        job.executed_work = job.duration  # absorb float residue
+        self.cluster.free(job.allocation)
+        job.allocation = None
+        job.allocated_chips = 0
+        job.speed = 0.0
+        job.epoch += 1
+        job.state = job.end_state
+        job.end_time = self.now
+        self.running.remove(job)
+        self.finished.append(job)
+        self.metrics.record_job(job)
+
+    def run(self) -> SimResult:
+        """Drive the event loop to completion and return summary metrics."""
+        while self._heap:
+            t = self._heap[0][0]
+            if t > self.max_time:
+                break
+            self.now = t
+            self._advance_running(t)
+            dirty = False
+            while self._heap and self._heap[0][0] <= t:
+                _, kind, _, payload, epoch = heapq.heappop(self._heap)
+                if kind == _ARRIVAL:
+                    job: Job = payload
+                    job.last_update_time = t
+                    self.pending.append(job)
+                    self.metrics.count("arrivals")
+                    dirty = True
+                elif kind == _COMPLETION:
+                    job = payload
+                    if job.epoch != epoch or job.state is not JobState.RUNNING:
+                        continue  # stale prediction from before a preempt/resize
+                    if job.remaining_runtime() > self.eps:
+                        # speed changed without epoch bump — repredict
+                        self._schedule_completion(job)
+                        continue
+                    self._finish(job)
+                    dirty = True
+                else:  # _TICK
+                    dirty = True
+            if dirty:
+                wakeup = self.policy.schedule(self)
+                if wakeup is not None:
+                    self.request_wakeup(wakeup)
+            self.metrics.sample(self.now, self.cluster, len(self.running), len(self.pending))
+        return self.metrics.result(self.jobs, self.now)

@@ -1,0 +1,147 @@
+"""Job model and progress accounting.
+
+Mirrors the reference's per-job trace record (SURVEY.md §2 "Job model + trace
+loader": id, submit_time, num_gpu, iterations, model, duration) with the GPU
+request generalized to a TPU chip request, plus the runtime accounting every
+policy needs:
+
+- ``executed_work`` / ``remaining_work`` in *reference-speed seconds* — the
+  progress currency for FIFO/SRTF and for deadline prediction;
+- ``attained_service`` in *chip-seconds* — the Tiresias-LAS priority currency
+  (SURVEY.md §2 "Policy: Tiresias LAS/DLAS");
+- ``speed`` — the instantaneous progress rate.  1.0 means "running at the
+  trace-declared allocation"; Optimus-style elastic policies set it from the
+  fitted goodput curve when they grow/shrink a job (SURVEY.md §3.2);
+- ``overhead_remaining`` — modeled preemption/migration cost: seconds of run
+  time that must be burned before real work resumes (Gandiva suspend/resume
+  and migration penalties are charged this way, SURVEY.md §3.3 / §5
+  "Checkpoint / resume": costs are modeled, not real).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+class JobState(enum.Enum):
+    """Lifecycle states of a simulated job."""
+
+    PENDING = "pending"        # submitted, waiting for its first/next allocation
+    RUNNING = "running"        # holds an allocation, accruing progress
+    SUSPENDED = "suspended"    # preempted with resume intent (Gandiva time-slice)
+    DONE = "done"              # ran to completion (trace status Pass)
+    FAILED = "failed"          # trace-declared failure surfaced at completion
+    KILLED = "killed"          # trace-declared kill surfaced at completion
+
+END_STATES = (JobState.DONE, JobState.FAILED, JobState.KILLED)
+
+# Map of trace-declared completion statuses (Philly schema, SURVEY.md §5
+# "Failure detection": a faithful replayer must handle failed/killed jobs) to
+# the terminal JobState a job enters once its trace duration has elapsed.
+STATUS_TO_END_STATE = {
+    "Pass": JobState.DONE,
+    "Failed": JobState.FAILED,
+    "Killed": JobState.KILLED,
+}
+
+
+@dataclass
+class Job:
+    """A single trace job.
+
+    Parameters mirror one trace row; everything after ``status`` is runtime
+    state owned by the simulation engine.
+    """
+
+    job_id: str
+    submit_time: float
+    num_chips: int                      # requested gang size, in TPU chips
+    duration: float                     # total service time (s) at requested size
+    model_name: str = "transformer-tiny"
+    iterations: Optional[int] = None    # optional iteration count (Optimus uses it)
+    status: str = "Pass"                # trace-declared outcome: Pass|Failed|Killed
+    user: str = ""                      # submitting user/vc (Philly has VCs)
+
+    # ---- runtime accounting (engine-owned) ----
+    state: JobState = JobState.PENDING
+    executed_work: float = 0.0          # reference-speed seconds of work done
+    attained_service: float = 0.0       # chip-seconds of service received
+    speed: float = 0.0                  # current progress rate (0 unless RUNNING)
+    overhead_remaining: float = 0.0     # modeled restart cost still to burn (s)
+    allocation: Optional[Any] = None    # cluster allocation handle when RUNNING
+    allocated_chips: int = 0            # chips currently held (elastic != num_chips)
+
+    first_start_time: Optional[float] = None
+    end_time: Optional[float] = None
+    last_update_time: float = 0.0       # progress integrated up to this sim time
+    preempt_count: int = 0
+    migration_count: int = 0
+    epoch: int = 0                      # invalidates stale scheduled completions
+
+    # scratch space for policies (queue index, profiling state, ...)
+    sched: dict = field(default_factory=dict)
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def remaining_work(self) -> float:
+        """Reference-speed seconds of service still owed to this job."""
+        return max(0.0, self.duration - self.executed_work)
+
+    @property
+    def finished(self) -> bool:
+        return self.state in END_STATES
+
+    @property
+    def end_state(self) -> JobState:
+        """Terminal state declared by the trace for when this job completes."""
+        return STATUS_TO_END_STATE.get(self.status, JobState.DONE)
+
+    def remaining_runtime(self) -> float:
+        """Wall-clock seconds to completion at the current speed (inf if idle)."""
+        if self.speed <= 0.0:
+            return float("inf")
+        return self.overhead_remaining + self.remaining_work / self.speed
+
+    def advance(self, now: float) -> None:
+        """Integrate progress from ``last_update_time`` to ``now``.
+
+        Overhead (modeled suspend/resume or migration cost) is burned first at
+        wall-clock rate; only the remainder of the interval accrues work and
+        attained service.
+        """
+        dt = now - self.last_update_time
+        if dt < 0:
+            raise ValueError(
+                f"time went backwards for {self.job_id}: {self.last_update_time} -> {now}"
+            )
+        self.last_update_time = now
+        if self.state is not JobState.RUNNING or dt == 0.0:
+            return
+        if self.overhead_remaining > 0.0:
+            burned = min(self.overhead_remaining, dt)
+            self.overhead_remaining -= burned
+            dt -= burned
+        if dt > 0.0:
+            self.executed_work += self.speed * dt
+            self.attained_service += self.allocated_chips * dt
+
+    def jct(self) -> Optional[float]:
+        """Job completion time (end - submit), once finished."""
+        if self.end_time is None:
+            return None
+        return self.end_time - self.submit_time
+
+    def queueing_delay(self) -> Optional[float]:
+        """Delay between submission and first start."""
+        if self.first_start_time is None:
+            return None
+        return self.first_start_time - self.submit_time
+
+    def __repr__(self) -> str:  # compact for debugging/log lines
+        return (
+            f"Job({self.job_id}, chips={self.num_chips}, state={self.state.value}, "
+            f"work={self.executed_work:.1f}/{self.duration:.1f})"
+        )

@@ -1,0 +1,159 @@
+"""Metrics and logging: per-job CSV, cluster-utilization samples, summaries.
+
+Matches the reference's logging surface (SURVEY.md §2 "Metrics/log", §8 in the
+layer map): per-job rows (submit/start/end → JCT, queueing delay), per-event
+cluster utilization samples, and an end-of-run summary whose headline numbers
+are **average JCT** and **makespan** (the BASELINE.json contract metrics),
+plus 95th-percentile queueing delay (SURVEY.md §3.1 summary line).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+from collections import Counter
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from gpuschedule_tpu.sim.job import Job, JobState
+
+JOB_CSV_FIELDS = [
+    "job_id",
+    "num_chips",
+    "submit_time",
+    "first_start_time",
+    "end_time",
+    "jct",
+    "queueing_delay",
+    "executed_work",
+    "attained_service",
+    "preempt_count",
+    "migration_count",
+    "status",
+    "end_state",
+    "model_name",
+]
+
+
+def _percentile(values: Sequence[float], p: float) -> float:
+    """Nearest-rank percentile on a copy-sorted list (no numpy dependency in
+    the sim core)."""
+    if not values:
+        return 0.0
+    s = sorted(values)
+    k = max(0, min(len(s) - 1, int(round(p / 100.0 * (len(s) - 1)))))
+    return s[k]
+
+
+@dataclass
+class SimResult:
+    """End-of-run summary. ``jobs`` holds the full per-job records."""
+
+    avg_jct: float
+    makespan: float
+    p95_queueing_delay: float
+    mean_utilization: float
+    num_finished: int
+    num_unfinished: int
+    counters: Dict[str, int]
+    end_time: float
+    jobs: List[Job] = field(repr=False, default_factory=list)
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            "avg_jct": self.avg_jct,
+            "makespan": self.makespan,
+            "p95_queueing_delay": self.p95_queueing_delay,
+            "mean_utilization": self.mean_utilization,
+            "num_finished": self.num_finished,
+            "num_unfinished": self.num_unfinished,
+            **{k: float(v) for k, v in self.counters.items()},
+        }
+
+
+class MetricsLog:
+    """Accumulates job records and utilization samples during a run."""
+
+    def __init__(self) -> None:
+        self.job_rows: List[dict] = []
+        self.util_samples: List[tuple] = []  # (t, used, total, running, pending)
+        self.counters: Counter = Counter()
+
+    # ------------------------------------------------------------------ #
+    def count(self, key: str, n: int = 1) -> None:
+        self.counters[key] += n
+
+    def record_job(self, job: Job) -> None:
+        self.job_rows.append(
+            {
+                "job_id": job.job_id,
+                "num_chips": job.num_chips,
+                "submit_time": job.submit_time,
+                "first_start_time": job.first_start_time,
+                "end_time": job.end_time,
+                "jct": job.jct(),
+                "queueing_delay": job.queueing_delay(),
+                "executed_work": round(job.executed_work, 6),
+                "attained_service": round(job.attained_service, 6),
+                "preempt_count": job.preempt_count,
+                "migration_count": job.migration_count,
+                "status": job.status,
+                "end_state": job.state.value,
+                "model_name": job.model_name,
+            }
+        )
+
+    def sample(self, t: float, cluster, num_running: int, num_pending: int) -> None:
+        self.util_samples.append(
+            (t, cluster.used_chips, cluster.total_chips, num_running, num_pending)
+        )
+
+    # ------------------------------------------------------------------ #
+    def result(self, jobs: Sequence[Job], end_time: float) -> SimResult:
+        finished = [j for j in jobs if j.end_time is not None]
+        jcts = [j.jct() for j in finished]
+        qdelays = [j.queueing_delay() for j in finished if j.queueing_delay() is not None]
+        if finished:
+            start = min(j.submit_time for j in finished)
+            makespan = max(j.end_time for j in finished) - start
+        else:
+            makespan = 0.0
+        # Time-weighted mean utilization over the sampled horizon.
+        util = 0.0
+        if len(self.util_samples) >= 2:
+            area, horizon = 0.0, 0.0
+            for (t0, used, total, _, _), (t1, *_rest) in zip(
+                self.util_samples, self.util_samples[1:]
+            ):
+                if total > 0:
+                    area += (used / total) * (t1 - t0)
+                    horizon += t1 - t0
+            util = area / horizon if horizon > 0 else 0.0
+        return SimResult(
+            avg_jct=sum(jcts) / len(jcts) if jcts else 0.0,
+            makespan=makespan,
+            p95_queueing_delay=_percentile(qdelays, 95.0),
+            mean_utilization=util,
+            num_finished=len(finished),
+            num_unfinished=len(jobs) - len(finished),
+            counters=dict(self.counters),
+            end_time=end_time,
+            jobs=list(jobs),
+        )
+
+    # ------------------------------------------------------------------ #
+    def write(self, out_dir: str | Path, *, prefix: str = "") -> None:
+        """Write job-level and utilization CSVs plus a counters JSON."""
+        out = Path(out_dir)
+        out.mkdir(parents=True, exist_ok=True)
+        with open(out / f"{prefix}jobs.csv", "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=JOB_CSV_FIELDS)
+            w.writeheader()
+            w.writerows(self.job_rows)
+        with open(out / f"{prefix}utilization.csv", "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["time", "used_chips", "total_chips", "running", "pending"])
+            w.writerows(self.util_samples)
+        with open(out / f"{prefix}counters.json", "w") as f:
+            json.dump(dict(self.counters), f, indent=2, sort_keys=True)

@@ -1,0 +1,13 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh *before* any test imports jax, so
+multi-chip sharding logic (profiler harness, parallel train steps) is
+exercised without TPU hardware.  The pure-Python sim core never imports jax.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
