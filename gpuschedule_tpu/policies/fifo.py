@@ -22,7 +22,7 @@ class FifoPolicy(Policy):
         self.backfill = backfill
 
     def schedule(self, sim) -> Optional[float]:
-        queue = sorted(sim.pending, key=lambda j: (j.submit_time, j.job_id))
+        queue = sorted(sim.pending, key=lambda j: (j.submit_time, j.arrival_seq))
         for job in queue:
             if sim.try_start(job):
                 continue

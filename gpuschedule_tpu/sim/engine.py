@@ -52,8 +52,14 @@ class Simulator:
     ):
         self.cluster = cluster
         self.policy = policy
-        self.jobs: List[Job] = sorted(jobs, key=lambda j: (j.submit_time, j.job_id))
+        # Stable sort: ties on submit_time keep trace order, and each job gets
+        # a numeric arrival sequence so policies can tie-break without relying
+        # on string job_id ordering (which misorders 'j2' vs 'j10').
+        self.jobs: List[Job] = sorted(jobs, key=lambda j: j.submit_time)
+        for seq, job in enumerate(self.jobs):
+            job.arrival_seq = seq
         self.metrics = metrics or MetricsLog()
+        self.metrics.attach_jobs(self.jobs)
         self.max_time = max_time
         self.eps = eps
 
@@ -103,7 +109,12 @@ class Simulator:
         """Gang-start (or resume) ``job`` on ``chips`` chips; False if the
         cluster cannot grant a valid allocation (all-or-nothing, SURVEY.md §3.1
         placement step)."""
-        assert job.state in (JobState.PENDING, JobState.SUSPENDED), job
+        if job.state not in (JobState.PENDING, JobState.SUSPENDED):
+            raise RuntimeError(f"try_start on non-schedulable job {job!r}")
+        if speed <= 0.0:
+            # A RUNNING job at speed<=0 never completes and holds chips forever;
+            # pausing-in-place is expressed via preempt(suspend=True) instead.
+            raise ValueError(f"try_start requires speed > 0, got {speed}")
         chips = chips if chips is not None else job.num_chips
         alloc = self.cluster.allocate(chips, job=job, hint=placement_hint)
         if alloc is None:
@@ -127,7 +138,8 @@ class Simulator:
         """Take ``job`` off the cluster.  ``suspend=True`` marks it as a
         time-sliced victim with resume intent (Gandiva); ``suspend=False``
         returns it to the pending queue (Tiresias/SRTF demotion)."""
-        assert job.state is JobState.RUNNING, job
+        if job.state is not JobState.RUNNING:
+            raise RuntimeError(f"preempt on non-running job {job!r}")
         job.advance(self.now)
         self.cluster.free(job.allocation)
         job.allocation = None
@@ -142,7 +154,10 @@ class Simulator:
 
     def set_speed(self, job: Job, speed: float) -> None:
         """Change a running job's progress rate (elastic resize effect)."""
-        assert job.state is JobState.RUNNING, job
+        if job.state is not JobState.RUNNING:
+            raise RuntimeError(f"set_speed on non-running job {job!r}")
+        if speed <= 0.0:
+            raise ValueError(f"set_speed requires speed > 0, got {speed}")
         job.advance(self.now)
         job.speed = speed
         job.epoch += 1
@@ -151,14 +166,16 @@ class Simulator:
     def migrate(self, job: Job, *, overhead: float, placement_hint: Optional[dict] = None) -> bool:
         """Move a running job to a fresh allocation, paying ``overhead``
         seconds of modeled checkpoint/restore cost (SURVEY.md §3.3 migration)."""
-        assert job.state is JobState.RUNNING, job
+        if job.state is not JobState.RUNNING:
+            raise RuntimeError(f"migrate on non-running job {job!r}")
         chips, speed = job.allocated_chips, job.speed
         job.advance(self.now)
         self.cluster.free(job.allocation)
         alloc = self.cluster.allocate(chips, job=job, hint=placement_hint)
-        if alloc is None:  # shouldn't happen (we just freed); restore in place
+        if alloc is None:  # hint unsatisfiable; restore in place (no cost charged)
             alloc = self.cluster.allocate(chips, job=job)
-            assert alloc is not None, "allocation vanished during migration"
+            if alloc is None:
+                raise RuntimeError(f"allocation vanished during migration of {job!r}")
             job.allocation = alloc
             return False
         job.allocation = alloc
@@ -172,7 +189,10 @@ class Simulator:
     def resize(self, job: Job, *, chips: int, speed: float, overhead: float = 0.0) -> bool:
         """Elastic grow/shrink (Optimus, SURVEY.md §3.2): re-allocate ``job``
         at ``chips`` with new progress rate ``speed``."""
-        assert job.state is JobState.RUNNING, job
+        if job.state is not JobState.RUNNING:
+            raise RuntimeError(f"resize on non-running job {job!r}")
+        if speed <= 0.0:
+            raise ValueError(f"resize requires speed > 0, got {speed}")
         if chips == job.allocated_chips and speed == job.speed:
             return True
         job.advance(self.now)
@@ -180,7 +200,8 @@ class Simulator:
         alloc = self.cluster.allocate(chips, job=job)
         if alloc is None:
             alloc = self.cluster.allocate(job.allocated_chips, job=job)
-            assert alloc is not None, "allocation vanished during resize"
+            if alloc is None:
+                raise RuntimeError(f"allocation vanished during resize of {job!r}")
             job.allocation = alloc
             job.epoch += 1
             self._schedule_completion(job)
@@ -214,6 +235,13 @@ class Simulator:
         while self._heap:
             t = self._heap[0][0]
             if t > self.max_time:
+                # Horizon cutoff: charge running jobs up to max_time so
+                # executed work and utilization cover the full simulated span.
+                self.now = self.max_time
+                self._advance_running(self.max_time)
+                self.metrics.sample(
+                    self.now, self.cluster, len(self.running), len(self.pending)
+                )
                 break
             self.now = t
             self._advance_running(t)

@@ -79,6 +79,8 @@ class Job:
     preempt_count: int = 0
     migration_count: int = 0
     epoch: int = 0                      # invalidates stale scheduled completions
+    arrival_seq: int = 0                # submit-order index assigned by the engine
+                                        # (numeric FIFO tie-break; 'j2' < 'j10')
 
     # scratch space for policies (queue index, profiling state, ...)
     sched: dict = field(default_factory=dict)

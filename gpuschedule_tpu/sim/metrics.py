@@ -79,30 +79,41 @@ class MetricsLog:
         self.job_rows: List[dict] = []
         self.util_samples: List[tuple] = []  # (t, used, total, running, pending)
         self.counters: Counter = Counter()
+        self._all_jobs: Sequence[Job] = ()   # set by attach_jobs(); lets write()
+                                             # emit rows for unfinished jobs too
+
+    def attach_jobs(self, jobs: Sequence[Job]) -> None:
+        """Register the full job list (engine does this at construction) so
+        :meth:`write` can emit rows for unfinished jobs even if the run aborts
+        before :meth:`result` is reached."""
+        self._all_jobs = jobs
 
     # ------------------------------------------------------------------ #
     def count(self, key: str, n: int = 1) -> None:
         self.counters[key] += n
 
+    @staticmethod
+    def _job_row(job: Job) -> dict:
+        """One jobs.csv row; end_time/jct are None while a job is unfinished."""
+        return {
+            "job_id": job.job_id,
+            "num_chips": job.num_chips,
+            "submit_time": job.submit_time,
+            "first_start_time": job.first_start_time,
+            "end_time": job.end_time,
+            "jct": job.jct(),
+            "queueing_delay": job.queueing_delay(),
+            "executed_work": round(job.executed_work, 6),
+            "attained_service": round(job.attained_service, 6),
+            "preempt_count": job.preempt_count,
+            "migration_count": job.migration_count,
+            "status": job.status,
+            "end_state": job.state.value,
+            "model_name": job.model_name,
+        }
+
     def record_job(self, job: Job) -> None:
-        self.job_rows.append(
-            {
-                "job_id": job.job_id,
-                "num_chips": job.num_chips,
-                "submit_time": job.submit_time,
-                "first_start_time": job.first_start_time,
-                "end_time": job.end_time,
-                "jct": job.jct(),
-                "queueing_delay": job.queueing_delay(),
-                "executed_work": round(job.executed_work, 6),
-                "attained_service": round(job.attained_service, 6),
-                "preempt_count": job.preempt_count,
-                "migration_count": job.migration_count,
-                "status": job.status,
-                "end_state": job.state.value,
-                "model_name": job.model_name,
-            }
-        )
+        self.job_rows.append(self._job_row(job))
 
     def sample(self, t: float, cluster, num_running: int, num_pending: int) -> None:
         self.util_samples.append(
@@ -147,10 +158,17 @@ class MetricsLog:
         """Write job-level and utilization CSVs plus a counters JSON."""
         out = Path(out_dir)
         out.mkdir(parents=True, exist_ok=True)
+        # Finished jobs were recorded incrementally; unfinished jobs (horizon
+        # cutoff) get a row with empty end_time/jct so the persisted log covers
+        # the whole trace.
+        extra_rows = [
+            self._job_row(j) for j in self._all_jobs if j.end_time is None
+        ]
         with open(out / f"{prefix}jobs.csv", "w", newline="") as f:
             w = csv.DictWriter(f, fieldnames=JOB_CSV_FIELDS)
             w.writeheader()
             w.writerows(self.job_rows)
+            w.writerows(extra_rows)
         with open(out / f"{prefix}utilization.csv", "w", newline="") as f:
             w = csv.writer(f)
             w.writerow(["time", "used_chips", "total_chips", "running", "pending"])

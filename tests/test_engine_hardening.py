@@ -1,0 +1,92 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md):
+
+- max_time cutoff charges running jobs up to the horizon;
+- FIFO tie-break is numeric arrival order, not string job_id order;
+- try_start/set_speed/resize reject speed <= 0;
+- jobs.csv includes unfinished jobs with empty end_time/jct;
+- engine state validation raises (not assert) so it survives ``python -O``.
+"""
+
+import csv
+
+import pytest
+
+from gpuschedule_tpu.cluster import SimpleCluster
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.sim import Job, JobState, Simulator
+
+
+def test_max_time_cutoff_advances_running_jobs():
+    jobs = [Job("a", submit_time=100.0, num_chips=4, duration=1000.0)]
+    sim = Simulator(SimpleCluster(8), make_policy("fifo"), jobs, max_time=150.0)
+    res = sim.run()
+    (j,) = res.jobs
+    assert j.state is JobState.RUNNING
+    assert j.executed_work == pytest.approx(50.0)  # ran [100, 150)
+    assert sim.now == pytest.approx(150.0)
+    assert res.num_unfinished == 1
+
+
+def test_fifo_tiebreak_is_arrival_order_not_string_order():
+    # 'j10' sorts before 'j2' as a string; arrival order must win at equal
+    # submit_time.  j2 appears first in the trace, so it starts first.
+    jobs = [
+        Job("j2", submit_time=0.0, num_chips=8, duration=10.0),
+        Job("j10", submit_time=0.0, num_chips=8, duration=10.0),
+    ]
+    sim = Simulator(SimpleCluster(8), make_policy("fifo"), jobs)
+    res = sim.run()
+    starts = {j.job_id: j.first_start_time for j in res.jobs}
+    assert starts["j2"] == pytest.approx(0.0)
+    assert starts["j10"] == pytest.approx(10.0)
+
+
+def test_try_start_rejects_nonpositive_speed():
+    job = Job("a", submit_time=0.0, num_chips=1, duration=10.0)
+    sim = Simulator(SimpleCluster(8), make_policy("fifo"), [job])
+    job.state = JobState.PENDING
+    with pytest.raises(ValueError):
+        sim.try_start(job, speed=0.0)
+    with pytest.raises(ValueError):
+        sim.try_start(job, speed=-1.0)
+
+
+def test_set_speed_rejects_nonpositive_speed():
+    job = Job("a", submit_time=0.0, num_chips=1, duration=10.0)
+    sim = Simulator(SimpleCluster(8), make_policy("fifo"), [job])
+    assert sim.try_start(job)
+    with pytest.raises(ValueError):
+        sim.set_speed(job, 0.0)
+
+
+def test_state_validation_raises_not_asserts():
+    job = Job("a", submit_time=0.0, num_chips=1, duration=10.0)
+    sim = Simulator(SimpleCluster(8), make_policy("fifo"), [job])
+    # job is PENDING: every RUNNING-only engine call must raise RuntimeError
+    with pytest.raises(RuntimeError):
+        sim.preempt(job)
+    with pytest.raises(RuntimeError):
+        sim.set_speed(job, 1.0)
+    with pytest.raises(RuntimeError):
+        sim.migrate(job, overhead=1.0)
+    with pytest.raises(RuntimeError):
+        sim.resize(job, chips=2, speed=1.0)
+    assert sim.try_start(job)
+    with pytest.raises(RuntimeError):
+        sim.try_start(job)  # already RUNNING
+
+
+def test_jobs_csv_includes_unfinished_jobs(tmp_path):
+    jobs = [
+        Job("done", submit_time=0.0, num_chips=4, duration=10.0),
+        Job("cut", submit_time=0.0, num_chips=4, duration=1000.0),
+    ]
+    sim = Simulator(SimpleCluster(8), make_policy("fifo"), jobs, max_time=100.0)
+    sim.run()
+    sim.metrics.write(tmp_path)
+    with open(tmp_path / "jobs.csv") as f:
+        rows = {r["job_id"]: r for r in csv.DictReader(f)}
+    assert set(rows) == {"done", "cut"}
+    assert rows["done"]["end_time"] != ""
+    assert rows["cut"]["end_time"] == ""
+    assert float(rows["cut"]["executed_work"]) == pytest.approx(100.0)
