@@ -8,5 +8,21 @@ topology-aware comparison config (BASELINE.json config #5).
 """
 
 from gpuschedule_tpu.cluster.base import Allocation, ClusterBase, SimpleCluster
+from gpuschedule_tpu.cluster.tpu import (
+    GENERATIONS,
+    SliceGeometry,
+    TpuCluster,
+    next_pow2,
+    valid_slice_shapes,
+)
 
-__all__ = ["Allocation", "ClusterBase", "SimpleCluster"]
+__all__ = [
+    "Allocation",
+    "ClusterBase",
+    "SimpleCluster",
+    "TpuCluster",
+    "SliceGeometry",
+    "GENERATIONS",
+    "next_pow2",
+    "valid_slice_shapes",
+]

@@ -1,0 +1,352 @@
+"""TPU torus topology + contiguous slice allocator.
+
+This replaces the reference's Switch → Node → GPU tree (SURVEY.md §2 "Cluster
+model": NVLink/PCIe locality) with the TPU-native resource model: a pod is an
+ICI torus — 2D for v5e (one pod = 16×16 = 256 chips), 3D for v5p — and an
+allocation is a **slice**: an axis-aligned contiguous sub-mesh whose shape
+comes from a power-of-two shape table (SURVEY.md §7 step 2, BASELINE.json
+north_star "slice-shaped allocations").  Where a GPU scheduler asks "are k
+GPUs free anywhere", a TPU scheduler must ask "is a contiguous k-chip box
+free" — that geometric constraint is what makes fragmentation, migration and
+topology-aware placement behave differently on pods, and it is the reason
+this allocator exists as its own component.
+
+Design notes
+------------
+- Occupancy is a tiny dense grid (≤ a few hundred cells for any one pod), so
+  slice search is a vectorized sliding-window scan rather than a free-list:
+  ``numpy.lib.stride_tricks.sliding_window_view`` gives every candidate
+  origin's occupancy in one shot and first-fit picks the lexicographically
+  smallest free origin.  Lexicographic first-fit packs slices toward the
+  origin corner, which is the "consolidated" default; the placement package
+  supplies other origin-selection orders (random / spread / best-fit).
+- Shape choice prefers the *squarest* candidate (minimal surface area) —
+  square/cube slices minimize ICI hop diameter and maximize wraparound
+  usefulness, and leave rectangular free space in bigger contiguous blocks.
+- A slice that spans a full torus axis gets that axis's wraparound links
+  (``SliceGeometry.wrap_axes``); the profiler's ICI allreduce term uses this
+  (ring bandwidth doubles on a wrapped axis).
+- Multi-pod clusters (``num_pods > 1``) model a DCN-connected fleet: slices
+  never span pods, which is exactly the ICI-within / DCN-across boundary
+  (SURVEY.md §5 "Distributed comm backend").
+
+No reference file:line citations are possible (/root/reference is an empty
+mount — SURVEY.md §0); blueprint sections are cited instead.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import math
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from gpuschedule_tpu.cluster.base import Allocation, ClusterBase
+
+# Modeled per-generation interconnect constants consumed by the profiler's
+# analytic allreduce term (SURVEY.md §7 "Step-time model fidelity").  Values
+# are modeled approximations of public specs, calibrated away by measurement:
+# what matters for policy comparisons is the *relative* ICI-vs-DCN and
+# per-generation scaling, not the absolute GB/s.
+GENERATIONS: Dict[str, dict] = {
+    "v5e": {
+        "torus_ndim": 2,
+        "pod_dims": (16, 16),
+        "ici_gbps_per_link": 400.0,     # per ICI link, per direction
+        "hbm_gbps": 819.0 * 8,          # 819 GB/s HBM BW
+        "bf16_tflops": 197.0,
+        "chips_per_host": 8,
+    },
+    "v5p": {
+        "torus_ndim": 3,
+        "pod_dims": (8, 8, 4),          # 256-chip pod ("v5p-256" scale)
+        "ici_gbps_per_link": 800.0,
+        "hbm_gbps": 2765.0 * 8,
+        "bf16_tflops": 459.0,
+        "chips_per_host": 4,
+    },
+}
+
+DCN_GBPS = 100.0  # modeled per-host DCN bandwidth (across-pod collectives)
+
+
+def _is_pow2(n: int) -> bool:
+    return n >= 1 and (n & (n - 1)) == 0
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (valid slice sizes are powers of two)."""
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def valid_slice_shapes(num_chips: int, dims: Sequence[int]) -> List[Tuple[int, ...]]:
+    """All axis-aligned shapes for a ``num_chips`` slice inside ``dims``.
+
+    A valid shape factors ``num_chips`` into one power-of-two extent per
+    torus axis, each fitting its axis.  Sorted squarest-first: minimal
+    max/min extent ratio, then minimal surface area — the ICI-friendly
+    preference order.  Empty list when ``num_chips`` is not a power of two
+    or exceeds what any box in ``dims`` can hold.
+    """
+    return list(_valid_slice_shapes(num_chips, tuple(dims)))
+
+
+@functools.lru_cache(maxsize=4096)
+def _valid_slice_shapes(num_chips: int, dims: Tuple[int, ...]) -> Tuple[Tuple[int, ...], ...]:
+    """Cached core of :func:`valid_slice_shapes` — allocate/can_allocate hit
+    this on every call with a handful of distinct (size, dims) pairs."""
+    if not _is_pow2(num_chips):
+        return ()
+    ndim = len(dims)
+    shapes = set()
+
+    def rec(prefix: Tuple[int, ...], remaining: int) -> None:
+        axis = len(prefix)
+        if axis == ndim - 1:
+            if remaining <= dims[axis]:
+                shapes.add(prefix + (remaining,))
+            return
+        f = 1
+        while f <= min(remaining, dims[axis]):
+            if remaining % f == 0:
+                rec(prefix + (f,), remaining // f)
+            f <<= 1
+
+    rec((), num_chips)
+
+    def squareness(shape: Tuple[int, ...]) -> Tuple[float, int, Tuple[int, ...]]:
+        ratio = max(shape) / min(shape)
+        # surface area ~ sum over axes of (volume / extent): lower = squarer
+        surface = sum(num_chips // s for s in shape)
+        return (ratio, surface, shape)
+
+    return tuple(sorted(shapes, key=squareness))
+
+
+@dataclass(frozen=True)
+class SliceGeometry:
+    """Where a slice sits in its pod.
+
+    ``wrap_axes[i]`` is True when the slice spans the full torus extent on
+    axis ``i`` and therefore owns that axis's wraparound ICI links.
+    """
+
+    pod: int
+    origin: Tuple[int, ...]
+    shape: Tuple[int, ...]
+    wrap_axes: Tuple[bool, ...]
+
+    @property
+    def num_chips(self) -> int:
+        return math.prod(self.shape)
+
+    def chips(self) -> Iterator[Tuple[int, ...]]:
+        """Yield the pod-local coordinates of every chip in the slice."""
+        for offs in itertools.product(*[range(s) for s in self.shape]):
+            yield tuple(o + d for o, d in zip(self.origin, offs))
+
+
+class TpuCluster(ClusterBase):
+    """A fleet of identical TPU pods with contiguous slice allocation.
+
+    ``allocate(k)`` grants an axis-aligned free box of a valid k-chip shape
+    (all-or-nothing, like every ClusterBase flavor) or returns None; ``k``
+    must be a power of two — trace loaders map raw GPU counts up via
+    :func:`next_pow2` / :meth:`round_up` (SURVEY.md §7 "Philly trace
+    fidelity": #GPU→valid-slice mapping happens at ingestion).
+    """
+
+    def __init__(
+        self,
+        generation: str = "v5e",
+        *,
+        dims: Optional[Sequence[int]] = None,
+        num_pods: int = 1,
+    ):
+        if generation not in GENERATIONS:
+            raise ValueError(f"unknown TPU generation {generation!r}; known: {sorted(GENERATIONS)}")
+        self.generation = generation
+        self.spec = GENERATIONS[generation]
+        self.dims: Tuple[int, ...] = tuple(dims) if dims is not None else self.spec["pod_dims"]
+        if len(self.dims) != self.spec["torus_ndim"]:
+            raise ValueError(
+                f"{generation} is a {self.spec['torus_ndim']}D torus; got dims {self.dims}"
+            )
+        if any(d < 1 for d in self.dims):
+            raise ValueError(f"bad pod dims {self.dims}")
+        self.num_pods = int(num_pods)
+        if self.num_pods < 1:
+            raise ValueError("num_pods must be >= 1")
+        self.pod_chips = math.prod(self.dims)
+        self.total_chips = self.pod_chips * self.num_pods
+
+        # occupancy[pod] is a dense int8 grid: 0 free, 1 occupied
+        self._occ: List[np.ndarray] = [
+            np.zeros(self.dims, dtype=np.int8) for _ in range(self.num_pods)
+        ]
+        self._used = 0
+        self._ids = itertools.count()
+        self._live: Dict[int, SliceGeometry] = {}
+        # fragmentation accounting: allocation failures while enough chips
+        # were free in aggregate (i.e. failures caused purely by geometry)
+        self.fragmentation_failures = 0
+        self.invalid_size_failures = 0
+        self.allocation_attempts = 0
+
+    # ------------------------------------------------------------------ #
+    # ClusterBase surface
+
+    @property
+    def used_chips(self) -> int:
+        return self._used
+
+    def round_up(self, num_chips: int) -> int:
+        """Smallest valid slice size >= num_chips (caps at one pod)."""
+        k = next_pow2(num_chips)
+        if k > self.pod_chips:
+            raise ValueError(
+                f"{num_chips} chips cannot fit a single {self.generation} pod of {self.pod_chips}"
+            )
+        return k
+
+    def allocate(self, num_chips: int, *, job=None, hint: Optional[dict] = None):
+        """Grant a contiguous ``num_chips`` slice or return None.
+
+        ``hint`` (from the placement layer):
+          - ``shape``: exact shape tuple to use (must be a valid shape);
+          - ``pod``: restrict search to one pod index;
+          - ``origin_order``: callable mapping a list of candidate origins to
+            the preferred order (placement schemes inject random/spread
+            orders here; default is lexicographic first-fit).
+        """
+        self.allocation_attempts += 1
+        if num_chips <= 0:
+            return None
+        shapes = valid_slice_shapes(num_chips, self.dims)
+        if not shapes:
+            # Grant-or-None contract (ClusterBase): a non-pow2 / oversized
+            # request is unsatisfiable, never an exception — loaders are
+            # expected to map raw GPU counts via round_up() at ingestion,
+            # but an unmapped trace must not crash the engine mid-run.
+            self.invalid_size_failures += 1
+            return None
+        hint = hint or {}
+        if "shape" in hint:
+            want = tuple(hint["shape"])
+            if want not in shapes:
+                raise ValueError(f"hinted shape {want} invalid for {num_chips} chips on {self.dims}")
+            shapes = [want]
+        if "pod" in hint:
+            p = hint["pod"]
+            if not 0 <= p < self.num_pods:
+                raise ValueError(f"hinted pod {p} out of range [0, {self.num_pods})")
+            pods = [p]
+        else:
+            pods = range(self.num_pods)
+        origin_order = hint.get("origin_order")
+
+        if num_chips > self.free_chips:
+            return None
+        for pod in pods:
+            for shape in shapes:
+                origin = self._find_free_box(self._occ[pod], shape, origin_order)
+                if origin is not None:
+                    return self._grant(pod, origin, shape)
+        if "pod" not in hint and "shape" not in hint:
+            # enough chips in aggregate, full search space, still no box:
+            # that is geometric fragmentation by definition
+            self.fragmentation_failures += 1
+        return None
+
+    def free(self, allocation: Optional[Allocation]) -> None:
+        if allocation is None:
+            return
+        geom = self._live.pop(allocation.alloc_id, None)
+        if geom is None:
+            raise ValueError(f"double free of allocation {allocation.alloc_id}")
+        self._box(self._occ[geom.pod], geom.origin, geom.shape)[...] = 0
+        self._used -= geom.num_chips
+
+    def can_allocate(self, num_chips: int) -> bool:
+        """Exact feasibility: is a free box of some valid shape available now?"""
+        if num_chips <= 0 or num_chips > self.free_chips:
+            return False
+        shapes = valid_slice_shapes(num_chips, self.dims)
+        return any(
+            self._find_free_box(occ, shape, None) is not None
+            for occ in self._occ
+            for shape in shapes
+        )
+
+    # ------------------------------------------------------------------ #
+    # geometry internals
+
+    @staticmethod
+    def _box(occ: np.ndarray, origin: Tuple[int, ...], shape: Tuple[int, ...]) -> np.ndarray:
+        return occ[tuple(slice(o, o + s) for o, s in zip(origin, shape))]
+
+    def _find_free_box(self, occ, shape, origin_order) -> Optional[Tuple[int, ...]]:
+        """First free origin for an axis-aligned ``shape`` box in ``occ``.
+
+        Sliding-window view computes every origin's occupancy count at once;
+        grids are <= a few hundred cells so this is microseconds.
+        """
+        if any(s > d for s, d in zip(shape, occ.shape)):
+            return None
+        windows = np.lib.stride_tricks.sliding_window_view(occ, shape)
+        ndim = occ.ndim
+        blocked = windows.sum(axis=tuple(range(ndim, 2 * ndim)))
+        free = np.argwhere(blocked == 0)
+        if free.size == 0:
+            return None
+        if origin_order is not None:
+            candidates = origin_order([tuple(int(c) for c in row) for row in free])
+            return candidates[0] if candidates else None
+        return tuple(int(c) for c in free[0])  # lexicographic first-fit
+
+    def _grant(self, pod: int, origin: Tuple[int, ...], shape: Tuple[int, ...]) -> Allocation:
+        self._box(self._occ[pod], origin, shape)[...] = 1
+        wrap = tuple(s == d for s, d in zip(shape, self.dims))
+        geom = SliceGeometry(pod=pod, origin=origin, shape=shape, wrap_axes=wrap)
+        alloc = Allocation(next(self._ids), geom.num_chips, detail=geom)
+        self._live[alloc.alloc_id] = geom
+        self._used += geom.num_chips
+        return alloc
+
+    # ------------------------------------------------------------------ #
+    # fragmentation / observability
+
+    def largest_allocatable(self) -> int:
+        """Largest valid slice size grantable right now (0 if none)."""
+        if self.free_chips == 0:
+            return 0
+        # largest pow2 <= min(free, pod capacity); min() of the raw values
+        # could land on a non-pow2 and skip every real candidate below it
+        k = 1 << (min(self.free_chips, self.pod_chips).bit_length() - 1)
+        while k >= 1:
+            if self.can_allocate(k):
+                return k
+            k >>= 1
+        return 0
+
+    def fragmentation(self) -> float:
+        """1 - largest_allocatable/free_chips: 0 = perfectly compact free
+        space, →1 = free chips exist but only in small shards."""
+        free = self.free_chips
+        if free == 0:
+            return 0.0
+        return 1.0 - self.largest_allocatable() / free
+
+    def live_slices(self) -> List[SliceGeometry]:
+        return list(self._live.values())
+
+    def __repr__(self) -> str:
+        return (
+            f"TpuCluster({self.generation}, dims={self.dims}, pods={self.num_pods}, "
+            f"used={self._used}/{self.total_chips})"
+        )

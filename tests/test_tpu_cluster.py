@@ -1,0 +1,285 @@
+"""Slice allocator tests: exact geometry cases + hypothesis property tests.
+
+The property suite is the test strategy SURVEY.md §4 prescribes for the
+allocator: every grant is a valid contiguous sub-mesh, no two live slices
+overlap, frees restore capacity, and alloc/free conserve chips under random
+operation sequences.
+"""
+
+import math
+
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from gpuschedule_tpu.cluster import (
+    SliceGeometry,
+    TpuCluster,
+    next_pow2,
+    valid_slice_shapes,
+)
+
+# --------------------------------------------------------------------- #
+# shape table
+
+
+def test_valid_shapes_2d():
+    shapes = valid_slice_shapes(8, (16, 16))
+    assert set(shapes) == {(1, 8), (8, 1), (2, 4), (4, 2)}
+    # squarest first
+    assert shapes[0] in ((2, 4), (4, 2))
+
+
+def test_valid_shapes_3d():
+    shapes = valid_slice_shapes(8, (8, 8, 4))
+    assert (2, 2, 2) == shapes[0]  # the cube wins
+    for s in shapes:
+        assert math.prod(s) == 8
+        assert all(x <= d for x, d in zip(s, (8, 8, 4)))
+
+
+def test_valid_shapes_rejects_non_pow2():
+    assert valid_slice_shapes(3, (16, 16)) == []
+    assert valid_slice_shapes(6, (16, 16)) == []
+    assert valid_slice_shapes(0, (16, 16)) == []
+
+
+def test_valid_shapes_respects_axis_limits():
+    # 32 chips on a 4x4 grid cannot exist (max box = 16)
+    assert valid_slice_shapes(32, (4, 4)) == []
+    # 256 on a full v5e pod: only the full 16x16
+    assert valid_slice_shapes(256, (16, 16)) == [(16, 16)]
+
+
+def test_next_pow2():
+    assert [next_pow2(n) for n in (1, 2, 3, 5, 8, 9, 100)] == [1, 2, 4, 8, 8, 16, 128]
+
+
+# --------------------------------------------------------------------- #
+# exact allocation behavior
+
+
+def test_allocate_full_pod():
+    c = TpuCluster("v5e")
+    a = c.allocate(256)
+    assert a is not None and a.detail.shape == (16, 16)
+    assert a.detail.wrap_axes == (True, True)
+    assert c.free_chips == 0
+    assert c.allocate(1) is None
+    c.free(a)
+    assert c.free_chips == 256
+
+
+def test_first_fit_packs_toward_origin():
+    c = TpuCluster("v5e")
+    a = c.allocate(4)
+    assert a.detail.origin == (0, 0) and a.detail.shape == (2, 2)
+    b = c.allocate(4)
+    # lexicographic first-fit: next free origin on the same rows
+    assert b.detail.origin == (0, 2)
+
+
+def test_geometry_blocks_despite_free_chips():
+    """The TPU-native behavior: enough free chips but no contiguous box."""
+    c = TpuCluster("v5e", dims=(4, 4))
+    # Fill the pod with 1-chip slices, free a scattered diagonal of 4.
+    allocs = [c.allocate(1) for _ in range(16)]
+    for i in (0, 5, 10, 15):  # diagonal coordinates
+        c.free(allocs[i])
+    assert c.free_chips == 4
+    before = c.fragmentation_failures
+    assert c.allocate(4) is None  # no 2x2/1x4 box exists on a diagonal
+    assert c.fragmentation_failures == before + 1
+    assert c.allocate(1) is not None  # singles still fit
+
+
+def test_fragmentation_metric():
+    c = TpuCluster("v5e", dims=(4, 4))
+    assert c.fragmentation() == 0.0
+    allocs = [c.allocate(1) for _ in range(16)]
+    for i in (0, 5, 10, 15):
+        c.free(allocs[i])
+    # 4 free chips, largest allocatable slice = 1
+    assert c.largest_allocatable() == 1
+    assert c.fragmentation() == pytest.approx(1 - 1 / 4)
+
+
+def test_v5p_3d_allocation():
+    c = TpuCluster("v5p")
+    assert c.dims == (8, 8, 4) and c.total_chips == 256
+    a = c.allocate(8)
+    assert a.detail.shape == (2, 2, 2)
+    b = c.allocate(64)
+    assert math.prod(b.detail.shape) == 64
+    assert all(o + s <= d for o, s, d in zip(b.detail.origin, b.detail.shape, c.dims))
+
+
+def test_multi_pod_slices_never_span_pods():
+    c = TpuCluster("v5e", dims=(4, 4), num_pods=3)
+    assert c.total_chips == 48
+    allocs = [c.allocate(16) for _ in range(3)]
+    assert all(a is not None for a in allocs)
+    assert sorted(a.detail.pod for a in allocs) == [0, 1, 2]
+    assert c.allocate(16) is None
+    # 32 chips exceeds one 4x4 pod → never a valid single slice
+    assert c.allocate(32) is None
+
+
+def test_non_pow2_request_returns_none():
+    # Grant-or-None contract: unmapped trace sizes must not crash the engine.
+    c = TpuCluster("v5e")
+    assert c.allocate(3) is None
+    assert c.invalid_size_failures == 1
+    assert c.fragmentation_failures == 0  # not a geometry failure
+    assert c.round_up(3) == 4
+    assert c.round_up(100) == 128
+    with pytest.raises(ValueError):
+        c.round_up(257)
+
+
+def test_oversized_request_returns_none():
+    c = TpuCluster("v5e", dims=(4, 4), num_pods=2)
+    assert c.allocate(32) is None  # exceeds any single-pod box
+    assert c.invalid_size_failures == 1
+
+
+def test_bad_pod_hint_raises():
+    c = TpuCluster("v5e", dims=(4, 4), num_pods=2)
+    with pytest.raises(ValueError):
+        c.allocate(4, hint={"pod": 5})
+    with pytest.raises(ValueError):
+        c.allocate(4, hint={"pod": -1})
+
+
+def test_hint_restricted_failure_not_counted_as_fragmentation():
+    c = TpuCluster("v5e", dims=(4, 4), num_pods=2)
+    a = c.allocate(16, hint={"pod": 0})
+    assert a is not None
+    before = c.fragmentation_failures
+    assert c.allocate(16, hint={"pod": 0}) is None  # pod 0 full, pod 1 free
+    assert c.fragmentation_failures == before
+
+
+def test_largest_allocatable_non_pow2_dims():
+    # 12x12 pod: 144 chips free, but the largest valid box is 8x8=64.
+    c = TpuCluster("v5e", dims=(12, 12))
+    assert c.largest_allocatable() == 64
+    assert c.can_allocate(64)
+
+
+def test_double_free_raises():
+    c = TpuCluster("v5e")
+    a = c.allocate(4)
+    c.free(a)
+    with pytest.raises(ValueError):
+        c.free(a)
+
+
+def test_shape_hint():
+    c = TpuCluster("v5e")
+    a = c.allocate(8, hint={"shape": (1, 8)})
+    assert a.detail.shape == (1, 8)
+    with pytest.raises(ValueError):
+        c.allocate(8, hint={"shape": (3, 3)})
+
+
+def test_chips_enumeration_matches_shape():
+    c = TpuCluster("v5p")
+    a = c.allocate(16)
+    coords = list(a.detail.chips())
+    assert len(coords) == 16 and len(set(coords)) == 16
+    for coord in coords:
+        assert all(
+            o <= x < o + s for x, o, s in zip(coord, a.detail.origin, a.detail.shape)
+        )
+
+
+# --------------------------------------------------------------------- #
+# hypothesis property tests
+
+SIZES = st.sampled_from([1, 2, 4, 8, 16, 32, 64])
+
+
+def _check_invariants(c: TpuCluster):
+    live = c.live_slices()
+    # conservation
+    assert c.used_chips == sum(g.num_chips for g in live)
+    assert 0 <= c.used_chips <= c.total_chips
+    seen = set()
+    for g in live:
+        # valid contiguous sub-mesh within the pod
+        assert math.prod(g.shape) == g.num_chips
+        assert all(o >= 0 and o + s <= d for o, s, d in zip(g.origin, g.shape, c.dims))
+        assert g.shape in valid_slice_shapes(g.num_chips, c.dims)
+        # no overlap across live slices (pod-qualified coordinates)
+        for coord in g.chips():
+            key = (g.pod, coord)
+            assert key not in seen, f"overlap at {key}"
+            seen.add(key)
+    # occupancy grid agrees with the live set
+    occupied = sum(int(occ.sum()) for occ in c._occ)
+    assert occupied == c.used_chips
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    ops=st.lists(
+        st.tuples(st.sampled_from(["alloc", "free"]), SIZES, st.integers(0, 10**6)),
+        max_size=60,
+    ),
+    gen=st.sampled_from(["v5e", "v5p"]),
+)
+def test_random_alloc_free_sequences(ops, gen):
+    """Random alloc/free interleavings keep every invariant intact."""
+    c = TpuCluster(gen)
+    handles = []
+    for kind, size, r in ops:
+        if kind == "alloc":
+            a = c.allocate(size)
+            if a is not None:
+                assert a.num_chips == size
+                handles.append(a)
+        elif handles:
+            c.free(handles.pop(r % len(handles)))
+        _check_invariants(c)
+    # freeing everything restores a pristine pod
+    for a in handles:
+        c.free(a)
+    _check_invariants(c)
+    assert c.free_chips == c.total_chips
+    full = c.allocate(c.pod_chips)
+    assert full is not None  # full-pod slice allocatable again
+
+
+@settings(max_examples=40, deadline=None)
+@given(sizes=st.lists(SIZES, min_size=1, max_size=40))
+def test_grants_never_overlap_under_pressure(sizes):
+    c = TpuCluster("v5e")
+    granted = []
+    for k in sizes:
+        a = c.allocate(k)
+        if a is not None:
+            granted.append(a)
+    _check_invariants(c)
+    assert sum(a.num_chips for a in granted) == c.used_chips
+
+
+@settings(max_examples=40, deadline=None)
+@given(sizes=st.lists(SIZES, min_size=1, max_size=30), data=st.data())
+def test_can_allocate_is_exact(sizes, data):
+    """can_allocate(k) == (allocate(k) would succeed), including geometry."""
+    c = TpuCluster("v5e", dims=(8, 8))
+    live = []
+    for k in sizes:
+        a = c.allocate(min(k, 64))
+        if a is not None:
+            live.append(a)
+    if live:
+        for _ in range(len(live) // 2):
+            c.free(live.pop(data.draw(st.integers(0, len(live) - 1))))
+    for probe in (1, 2, 4, 8, 16, 32, 64):
+        feasible = c.can_allocate(probe)
+        a = c.allocate(probe)
+        assert feasible == (a is not None)
+        if a is not None:
+            c.free(a)
