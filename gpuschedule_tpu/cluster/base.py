@@ -52,6 +52,13 @@ class ClusterBase:
         placement can still fail; SimpleCluster's answer is exact)."""
         return num_chips <= self.free_chips
 
+    def is_satisfiable(self, num_chips: int) -> bool:
+        """Could ``num_chips`` EVER be granted on this cluster (ignoring the
+        current occupancy)?  The engine rejects unsatisfiable jobs at
+        admission so they cannot wedge priority schedulers by reserving
+        budget for a grant that can never happen."""
+        return 0 < num_chips <= self.total_chips
+
 
 class SimpleCluster(ClusterBase):
     """Flat chip pool with no topology — the minimal stand-in that makes the

@@ -272,6 +272,12 @@ class TpuCluster(ClusterBase):
         self._box(self._occ[geom.pod], geom.origin, geom.shape)[...] = 0
         self._used -= geom.num_chips
 
+    def is_satisfiable(self, num_chips: int) -> bool:
+        """True iff some valid slice shape exists for this size at all —
+        power of two and small enough to fit one pod (slices never span
+        pods), regardless of current occupancy."""
+        return num_chips > 0 and bool(valid_slice_shapes(num_chips, self.dims))
+
     def can_allocate(self, num_chips: int) -> bool:
         """Exact feasibility: is a free box of some valid shape available now?"""
         if num_chips <= 0 or num_chips > self.free_chips:

@@ -5,9 +5,11 @@ the :class:`gpuschedule_tpu.policies.base.Policy` interface.
 """
 
 from gpuschedule_tpu.policies.base import Policy
+from gpuschedule_tpu.policies.dlas import DlasPolicy
 from gpuschedule_tpu.policies.fifo import FifoPolicy
+from gpuschedule_tpu.policies.srtf import SrtfPolicy
 
-_REGISTRY = {"fifo": FifoPolicy}
+_REGISTRY = {"fifo": FifoPolicy, "srtf": SrtfPolicy, "dlas": DlasPolicy}
 
 
 def register(name: str, factory) -> None:
@@ -26,4 +28,12 @@ def available() -> list[str]:
     return sorted(_REGISTRY)
 
 
-__all__ = ["Policy", "FifoPolicy", "make_policy", "available", "register"]
+__all__ = [
+    "Policy",
+    "FifoPolicy",
+    "SrtfPolicy",
+    "DlasPolicy",
+    "make_policy",
+    "available",
+    "register",
+]

@@ -1,0 +1,61 @@
+"""Shared machinery for priority-driven preemptive policies.
+
+SRTF and Tiresias-DLAS both reduce to the same step each time the engine
+wakes them (SURVEY.md §3.1: "preempt lower-queue jobs if needed,
+gang-aware"): order the active jobs by policy priority, make the running set
+equal the longest prefix that fits the cluster, preempting losers and
+gang-starting winners.  The helper here implements that step once.
+
+Capacity planning is chip-count based (strict priority: a high-priority gang
+reserves its chips even while geometry search for it fails), while actual
+grants go through ``cluster.allocate`` so slice-shape constraints always
+hold.  A winner whose box cannot be carved this round simply stays pending —
+its reservation still throttles lower-priority jobs, which is what keeps
+large gangs from starving on a fragmented pod.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+from gpuschedule_tpu.sim.job import Job, JobState
+
+
+def apply_priority_schedule(
+    sim,
+    ordered: Sequence[Job],
+    *,
+    restart_overhead: float = 0.0,
+) -> None:
+    """Make the running set match the highest-priority prefix that fits.
+
+    ``ordered`` lists schedulable jobs (PENDING/SUSPENDED/RUNNING), highest
+    priority first.  ``restart_overhead`` seconds are charged to a job that
+    resumes after having run before (modeled checkpoint/restore, SURVEY.md
+    §5 "Checkpoint / resume").
+    """
+    budget = sim.cluster.total_chips
+    keep: List[Job] = []
+    for job in ordered:
+        if job.num_chips <= budget:
+            keep.append(job)
+            budget -= job.num_chips
+    keep_ids = {id(j) for j in keep}
+
+    # Preempt running losers first so their chips are free for winners.
+    for job in list(sim.running):
+        if id(job) not in keep_ids:
+            sim.preempt(job, suspend=False)
+
+    # Gang-start winners in priority order; geometry failures skip (the
+    # budget reservation above already throttled lower priorities).
+    for job in keep:
+        if job.state is JobState.RUNNING:
+            continue
+        overhead = restart_overhead if job.executed_work > 0.0 else 0.0
+        sim.try_start(job, overhead=overhead)
+
+
+def active_jobs(sim) -> List[Job]:
+    """All jobs currently competing for the cluster."""
+    return [j for j in sim.pending + sim.running if not j.finished]

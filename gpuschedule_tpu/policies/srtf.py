@@ -1,0 +1,33 @@
+"""SRTF: preemptive shortest-remaining-time-first.
+
+The reference's SRTF/SJF uses known (trace-declared) remaining time to order
+jobs and preempts running work when a shorter job arrives (SURVEY.md §2
+"Policy: SRTF/SJF").  Remaining time here is ``job.remaining_work`` — the
+trace duration minus executed work — which is exactly what a simulator knows
+and what the optimality argument (exchange argument on any two jobs sharing
+a resource) is stated over.
+
+Ties break on arrival order so equal-length jobs never thrash.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from gpuschedule_tpu.policies.base import Policy
+from gpuschedule_tpu.policies.preemptive import active_jobs, apply_priority_schedule
+
+
+class SrtfPolicy(Policy):
+    name = "srtf"
+
+    def __init__(self, *, restart_overhead: float = 0.0):
+        self.restart_overhead = restart_overhead
+
+    def schedule(self, sim) -> Optional[float]:
+        ordered = sorted(
+            active_jobs(sim),
+            key=lambda j: (j.remaining_work, j.arrival_seq),
+        )
+        apply_priority_schedule(sim, ordered, restart_overhead=self.restart_overhead)
+        return None

@@ -251,8 +251,19 @@ class Simulator:
                 if kind == _ARRIVAL:
                     job: Job = payload
                     job.last_update_time = t
-                    self.pending.append(job)
                     self.metrics.count("arrivals")
+                    if not self.cluster.is_satisfiable(job.num_chips):
+                        # Admission control: this gang size can never be
+                        # granted here (non-slice size, bigger than a pod).
+                        # Reject now instead of letting it wedge priority
+                        # schedulers that would reserve budget for it forever.
+                        job.state = JobState.KILLED
+                        job.end_time = t
+                        self.finished.append(job)
+                        self.metrics.record_job(job)
+                        self.metrics.count("rejected_unsatisfiable")
+                    else:
+                        self.pending.append(job)
                     dirty = True
                 elif kind == _COMPLETION:
                     job = payload

@@ -1,0 +1,64 @@
+"""Admission control: unsatisfiable gang sizes are rejected at arrival.
+
+Regression for the round-2 review finding: a job whose size can never be
+granted (non-power-of-two on a TPU pod, or larger than one pod) used to
+reserve chip budget in the priority prefix forever, starving the whole
+cluster under SRTF/DLAS.
+"""
+
+import pytest
+
+from gpuschedule_tpu.cluster import SimpleCluster, TpuCluster
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.sim import Job, JobState, Simulator
+
+
+def test_unsatisfiable_sizes_rejected_on_tpu_cluster():
+    c = TpuCluster("v5e", dims=(4, 4), num_pods=2)
+    assert not c.is_satisfiable(3)    # non-pow2
+    assert not c.is_satisfiable(32)   # pow2 but > one pod (slices never span)
+    assert c.is_satisfiable(16)
+    assert SimpleCluster(64).is_satisfiable(64)
+    assert not SimpleCluster(64).is_satisfiable(65)
+
+
+def test_srtf_not_wedged_by_unsatisfiable_job():
+    """Reviewer repro: 32-chip 'shortest' job on a 2x(4x4) cluster used to
+    preempt everything every round and finish nothing."""
+    jobs = [
+        Job("running16", 0.0, num_chips=16, duration=100.0),
+        Job("impossible32", 5.0, num_chips=32, duration=10.0),
+        Job("small4", 6.0, num_chips=4, duration=10.0),
+    ]
+    c = TpuCluster("v5e", dims=(4, 4), num_pods=2)
+    res = Simulator(c, make_policy("srtf"), jobs).run()
+    by_id = {j.job_id: j for j in res.jobs}
+    assert by_id["impossible32"].state is JobState.KILLED
+    assert by_id["impossible32"].jct() == 0.0
+    assert by_id["running16"].state is JobState.DONE
+    assert by_id["small4"].state is JobState.DONE
+    assert by_id["small4"].first_start_time == pytest.approx(6.0)  # other pod
+    assert res.counters["rejected_unsatisfiable"] == 1
+
+
+def test_dlas_not_starved_by_non_pow2_job():
+    jobs = [
+        Job("odd3", 0.0, num_chips=3, duration=10.0),
+        Job("ok16", 1.0, num_chips=16, duration=10.0),
+    ]
+    res = Simulator(TpuCluster("v5e", dims=(4, 4)), make_policy("dlas"), jobs).run()
+    by_id = {j.job_id: j for j in res.jobs}
+    assert by_id["odd3"].state is JobState.KILLED
+    assert by_id["ok16"].state is JobState.DONE
+    assert by_id["ok16"].end_time == pytest.approx(11.0)
+
+
+def test_fifo_head_of_line_not_blocked_forever_by_rejected_job():
+    jobs = [
+        Job("huge", 0.0, num_chips=128, duration=10.0),
+        Job("tiny", 1.0, num_chips=1, duration=5.0),
+    ]
+    res = Simulator(SimpleCluster(64), make_policy("fifo"), jobs).run()
+    tiny = next(j for j in res.jobs if j.job_id == "tiny")
+    assert tiny.state is JobState.DONE
+    assert tiny.first_start_time == pytest.approx(1.0)

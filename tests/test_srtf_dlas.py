@@ -1,0 +1,227 @@
+"""SRTF and Tiresias-DLAS policy tests.
+
+SRTF is validated by the exchange argument on 2-job traces (SURVEY.md §4
+"policy-order properties"); DLAS by exact demotion/promotion timelines and
+by BASELINE config #2 running end-to-end on a synthetic trace over the slice
+allocator.
+"""
+
+import pytest
+
+from gpuschedule_tpu.cluster import SimpleCluster, TpuCluster
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.sim import Job, JobState, Simulator
+from gpuschedule_tpu.sim.trace import generate_poisson_trace
+
+
+def run(policy_name, jobs, cluster=None, **kw):
+    cluster = cluster or SimpleCluster(8)
+    sim = Simulator(cluster, make_policy(policy_name, **kw), jobs)
+    return sim.run()
+
+
+# --------------------------------------------------------------------- #
+# SRTF
+
+
+def test_srtf_preempts_long_job_for_short():
+    """Exchange argument: serving the shorter job first lowers total JCT."""
+    jobs = [
+        Job("long", 0.0, num_chips=8, duration=100.0),
+        Job("short", 10.0, num_chips=8, duration=10.0),
+    ]
+    res = run("srtf", jobs)
+    long_j = next(j for j in res.jobs if j.job_id == "long")
+    short_j = next(j for j in res.jobs if j.job_id == "short")
+    # short arrives with 10 remaining vs long's 90 -> preempts immediately
+    assert short_j.first_start_time == pytest.approx(10.0)
+    assert short_j.end_time == pytest.approx(20.0)
+    assert long_j.preempt_count == 1
+    assert long_j.end_time == pytest.approx(110.0)  # 10 done + 90 after resume
+    assert long_j.executed_work == pytest.approx(100.0)
+
+    # FIFO on the same trace: short waits -> strictly worse total JCT
+    fifo = run("fifo", [Job("long", 0.0, 8, 100.0), Job("short", 10.0, 8, 10.0)])
+    srtf_total = sum(j.jct() for j in res.jobs)
+    fifo_total = sum(j.jct() for j in fifo.jobs)
+    assert srtf_total < fifo_total
+
+
+def test_srtf_does_not_preempt_for_longer_job():
+    jobs = [
+        Job("short", 0.0, num_chips=8, duration=10.0),
+        Job("long", 1.0, num_chips=8, duration=100.0),
+    ]
+    res = run("srtf", jobs)
+    short_j = next(j for j in res.jobs if j.job_id == "short")
+    assert short_j.preempt_count == 0
+    assert short_j.end_time == pytest.approx(10.0)
+
+
+def test_srtf_equal_remaining_no_thrash():
+    """Equal-length jobs: arrival order wins, zero preemptions."""
+    jobs = [
+        Job("a", 0.0, num_chips=8, duration=50.0),
+        Job("b", 0.0, num_chips=8, duration=50.0),
+    ]
+    res = run("srtf", jobs)
+    a = next(j for j in res.jobs if j.job_id == "a")
+    b = next(j for j in res.jobs if j.job_id == "b")
+    assert a.preempt_count == 0 and b.preempt_count == 0
+    assert a.end_time == pytest.approx(50.0)
+    assert b.end_time == pytest.approx(100.0)
+
+
+def test_srtf_parallel_small_jobs():
+    """Jobs that fit side by side run side by side (no needless serialization)."""
+    jobs = [
+        Job("a", 0.0, num_chips=4, duration=50.0),
+        Job("b", 0.0, num_chips=4, duration=30.0),
+    ]
+    res = run("srtf", jobs)
+    assert all(j.first_start_time == 0.0 for j in res.jobs)
+
+
+def test_srtf_restart_overhead_charged():
+    jobs = [
+        Job("long", 0.0, num_chips=8, duration=100.0),
+        Job("short", 10.0, num_chips=8, duration=10.0),
+    ]
+    res = run("srtf", jobs, restart_overhead=5.0)
+    long_j = next(j for j in res.jobs if j.job_id == "long")
+    # resumes at t=20 but burns 5s of restore before the remaining 90
+    assert long_j.end_time == pytest.approx(115.0)
+    assert long_j.executed_work == pytest.approx(100.0)
+
+
+def test_srtf_work_conservation_poisson():
+    jobs = generate_poisson_trace(150, seed=11)
+    res = run("srtf", jobs, cluster=TpuCluster("v5e"))
+    assert res.num_finished == 150
+    for j in res.jobs:
+        assert j.executed_work == pytest.approx(j.duration)
+
+
+# --------------------------------------------------------------------- #
+# DLAS
+
+
+def test_dlas_demotes_after_threshold():
+    """1-chip cluster, threshold 10 chip-s: A runs 10s, is demoted, B takes
+    over, B is demoted at its own 10 chip-s, then FIFO within Q1: A first."""
+    jobs = [
+        Job("a", 0.0, num_chips=1, duration=30.0),
+        Job("b", 5.0, num_chips=1, duration=30.0),
+    ]
+    sim = Simulator(
+        SimpleCluster(1),
+        make_policy("dlas", thresholds=(10.0,), promote_ratio=1e9),
+        jobs,
+    )
+    res = sim.run()
+    a = next(j for j in res.jobs if j.job_id == "a")
+    b = next(j for j in res.jobs if j.job_id == "b")
+    # a served [0,10) then demoted; b (Q0) serves [10,20) then demoted;
+    # Q1 FIFO: a serves its remaining 20 [20,40), then b [40,60).
+    assert a.preempt_count == 1
+    assert b.first_start_time == pytest.approx(10.0)
+    assert b.preempt_count == 1
+    assert a.end_time == pytest.approx(40.0)
+    assert b.end_time == pytest.approx(60.0)
+    assert a.executed_work == pytest.approx(30.0)
+    assert b.executed_work == pytest.approx(30.0)
+
+
+def test_dlas_attained_service_is_chip_seconds():
+    """An 8-chip gang crosses a 80 chip-s threshold after 10 wall seconds."""
+    jobs = [
+        Job("big", 0.0, num_chips=8, duration=100.0),
+        Job("late", 5.0, num_chips=8, duration=100.0),
+    ]
+    sim = Simulator(
+        SimpleCluster(8),
+        make_policy("dlas", thresholds=(80.0,), promote_ratio=1e9),
+        jobs,
+    )
+    res = sim.run()
+    big = next(j for j in res.jobs if j.job_id == "big")
+    late = next(j for j in res.jobs if j.job_id == "late")
+    # big demoted at t=10 (8 chips x 10 s = 80); late runs [10, 20) ...
+    assert big.preempt_count >= 1
+    assert late.first_start_time == pytest.approx(10.0)
+
+
+def test_dlas_promotion_rescues_starved_job():
+    """A demoted job waiting >= promote_ratio x executed time returns to Q0."""
+    # 1 chip; threshold 5 chip-s; stream of Q0 jobs would starve 'victim'
+    # after its demotion, but promote_ratio=2 brings it back.
+    def make_jobs():
+        return [Job("victim", 0.0, num_chips=1, duration=20.0)] + [
+            Job(f"s{i}", 4.0 + 4.0 * i, num_chips=1, duration=4.9) for i in range(12)
+        ]
+
+    def run_until_30(promote_ratio):
+        sim = Simulator(
+            SimpleCluster(1),
+            make_policy("dlas", thresholds=(5.0,), promote_ratio=promote_ratio),
+            make_jobs(),
+            max_time=30.0,
+        )
+        res = sim.run()
+        return next(j for j in res.jobs if j.job_id == "victim")
+
+    # Without promotion: victim is demoted at t=5 with 5s done and the Q0
+    # stream never lets Q1 run again within the horizon.
+    starved = run_until_30(1e9)
+    assert starved.executed_work == pytest.approx(5.0)
+    # With promotion (waited >= 2 x 5s executed -> back to Q0 at t=15) the
+    # victim gets additional service while the stream is still arriving.
+    rescued = run_until_30(2.0)
+    assert rescued.sched.get("dlas_promotions", 0) >= 1
+    assert rescued.executed_work > 5.0 + 1e-6
+
+
+def test_dlas_gang_aware_preemption_frees_enough_chips():
+    """Preempting a Q1 gang must free the whole gang for a Q0 arrival."""
+    jobs = [
+        Job("old", 0.0, num_chips=8, duration=1000.0),
+        Job("new", 50.0, num_chips=8, duration=10.0),
+    ]
+    sim = Simulator(
+        SimpleCluster(8),
+        make_policy("dlas", thresholds=(100.0,), promote_ratio=1e9),
+        jobs,
+    )
+    res = sim.run()
+    new = next(j for j in res.jobs if j.job_id == "new")
+    # old crossed 100 chip-s at t=12.5 (8 chips), so it sits in Q1 when new
+    # arrives at t=50 in Q0 -> immediate full-gang preemption
+    assert new.first_start_time == pytest.approx(50.0)
+    assert new.end_time == pytest.approx(60.0)
+
+
+def test_dlas_config2_end_to_end_on_slice_allocator():
+    """BASELINE config #2 shape: DLAS on a synthetic trace over a v5e pod."""
+    jobs = generate_poisson_trace(150, seed=13)
+    c = TpuCluster("v5e")
+    sim = Simulator(c, make_policy("dlas"), jobs)
+    res = sim.run()
+    assert res.num_finished == 150
+    assert c.used_chips == 0
+    for j in res.jobs:
+        assert j.executed_work == pytest.approx(j.duration)
+    # determinism (SURVEY.md §4)
+    res2 = Simulator(TpuCluster("v5e"), make_policy("dlas"), generate_poisson_trace(150, seed=13)).run()
+    assert res2.avg_jct == res.avg_jct and res2.makespan == res.makespan
+
+
+def test_dlas_beats_fifo_on_mixed_workload():
+    """The point of LAS: short jobs escape convoys behind long ones."""
+    jobs = generate_poisson_trace(120, seed=17, mean_duration=7200.0)
+
+    def fresh():
+        return generate_poisson_trace(120, seed=17, mean_duration=7200.0)
+
+    fifo = Simulator(TpuCluster("v5e"), make_policy("fifo"), fresh()).run()
+    dlas = Simulator(TpuCluster("v5e"), make_policy("dlas"), fresh()).run()
+    assert dlas.avg_jct < fifo.avg_jct
