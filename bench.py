@@ -1,0 +1,75 @@
+"""Single-chip training-throughput benchmark.
+
+Runs the flagship model's full jitted train step (fwd + bwd + adamw) on the
+real TPU chip, times the median step after warmup/compile, and prints ONE
+JSON line with tokens/s and model FLOPs utilization.
+
+``vs_baseline``: BASELINE.json records no published reference numbers
+(``"published": {}``), so the comparison is against the roofline-derived
+target the north_star implies for this hardware: 30% MFU for a small-model
+single-chip train step.  vs_baseline = achieved_MFU / 0.30; >= 1.0 beats it.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+
+
+MODEL = "transformer-base"
+BATCH = 16
+SEQ = 512
+WARMUP = 3
+ITERS = 20
+TARGET_MFU = 0.30
+
+
+def main() -> None:
+    import jax
+
+    from gpuschedule_tpu.cluster.tpu import GENERATIONS
+    from gpuschedule_tpu.parallel import ShardedTrainer, make_mesh
+
+    dev = jax.devices()[0]
+    mesh = make_mesh(dp=1, sp=1, tp=1, devices=[dev])
+    trainer = ShardedTrainer(MODEL, mesh, batch_size=BATCH, seq_len=SEQ)
+    state = trainer.init(seed=0)
+    tokens = trainer.make_batch(seed=0)
+
+    for _ in range(WARMUP):  # first call compiles (~20-40s)
+        state, loss = trainer.step(state, tokens)
+    jax.block_until_ready(state[0])
+
+    times = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        state, loss = trainer.step(state, tokens)
+        jax.block_until_ready(loss)
+        times.append(time.perf_counter() - t0)
+
+    step_s = statistics.median(times)
+    tokens_per_s = BATCH * SEQ / step_s
+    flops_per_step = trainer.cfg.flops_per_token() * BATCH * SEQ
+    achieved_tflops = flops_per_step / step_s / 1e12
+
+    kind = getattr(dev, "device_kind", "").lower()
+    gen = "v5p" if "v5p" in kind or "v5 pod" in kind else "v5e"
+    peak_tflops = GENERATIONS[gen]["bf16_tflops"]
+    mfu = achieved_tflops / peak_tflops
+
+    print(
+        json.dumps(
+            {
+                "metric": f"{MODEL} train-step tokens/s (b{BATCH}xs{SEQ}, 1 chip, "
+                f"median of {ITERS}; mfu={mfu:.3f} @ {achieved_tflops:.1f} TF on {gen})",
+                "value": round(tokens_per_s, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(mfu / TARGET_MFU, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+"""Benchmark model zoo driven by the profiler and bench harness.
+
+The reference's profiler microbenchmarks run a real model forward/backward
+per candidate world size (SURVEY.md §2 "Throughput profiler"); these are the
+TPU-native equivalents: small flax models with static shapes and bfloat16
+compute so XLA tiles every matmul onto the MXU.  Names match the model
+names emitted by the trace generators (sim/trace.py DEFAULT_MODELS) so a
+simulated job maps directly onto a profilable model.
+"""
+
+from gpuschedule_tpu.models.transformer import (
+    MODEL_CONFIGS,
+    ModelConfig,
+    TransformerLM,
+    build_model,
+)
+
+__all__ = ["MODEL_CONFIGS", "ModelConfig", "TransformerLM", "build_model"]

@@ -1,0 +1,141 @@
+"""Decoder-only transformer LM in flax, written MXU-first.
+
+Design for the hardware (task brief "Design for tpu hardware"):
+
+- **bfloat16 compute, float32 params**: every matmul runs in bf16 on the
+  MXU; the optimizer state and master weights stay f32.
+- **Static shapes everywhere**: batch and sequence length are fixed at
+  trace time so XLA compiles one program; no data-dependent control flow.
+- **Fusible structure**: plain LN → attention → residual → LN → MLP →
+  residual chains that XLA fuses into a handful of kernels; no hand
+  scheduling.
+- **Remat-friendly**: each block is wrapped in ``jax.checkpoint`` when
+  ``remat=True`` so long-sequence configs trade FLOPs for HBM.
+- **Sharding-agnostic**: modules never mention a mesh.  Parallelism comes
+  from the partition specs in :mod:`gpuschedule_tpu.parallel` (megatron-
+  style column/row split of the MLP and attention projections), applied
+  from outside via ``NamedSharding`` — XLA inserts the collectives.
+
+The reference profiles torch models over DDP (SURVEY.md §3.2 starred
+block); this zoo plays that role for the JAX harness.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab: int = 8192
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    d_ff: int = 512
+    max_seq: int = 512
+    remat: bool = False
+
+    @property
+    def param_count(self) -> int:
+        """Approximate parameter count (embeddings + blocks)."""
+        per_block = 4 * self.d_model * self.d_model + 2 * self.d_model * self.d_ff
+        return self.vocab * self.d_model + self.n_layers * per_block
+
+    def flops_per_token(self) -> float:
+        """~6N FLOPs/token for fwd+bwd of an N-param dense LM (the standard
+        estimate the MFU arithmetic in bench.py uses)."""
+        return 6.0 * self.param_count
+
+
+MODEL_CONFIGS: Dict[str, ModelConfig] = {
+    cfg.name: cfg
+    for cfg in (
+        ModelConfig("transformer-tiny", d_model=128, n_layers=2, n_heads=4, d_ff=512),
+        ModelConfig("transformer-small", d_model=256, n_layers=4, n_heads=8, d_ff=1024),
+        ModelConfig("transformer-base", d_model=512, n_layers=8, n_heads=8, d_ff=2048),
+        ModelConfig(
+            "transformer-long",
+            d_model=256,
+            n_layers=4,
+            n_heads=8,
+            d_ff=1024,
+            max_seq=4096,
+            remat=True,
+        ),
+        # "mlp-wide" is a transformer with a fat FFN and thin attention —
+        # keeps one model class while giving the profiler a compute-heavy,
+        # communication-light point in the workload mix.
+        ModelConfig("mlp-wide", d_model=256, n_layers=2, n_heads=2, d_ff=4096),
+    )
+}
+
+
+class Block(nn.Module):
+    """Pre-LN causal self-attention + MLP block, bf16 compute."""
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = self.cfg
+        h = nn.LayerNorm(dtype=jnp.bfloat16, name="ln1")(x)
+        h = nn.SelfAttention(
+            num_heads=c.n_heads,
+            qkv_features=c.d_model,
+            dtype=jnp.bfloat16,
+            param_dtype=jnp.float32,
+            deterministic=True,
+            name="attn",
+        )(h, mask=nn.make_causal_mask(jnp.zeros(h.shape[:2], dtype=jnp.int32)))
+        x = x + h
+        h = nn.LayerNorm(dtype=jnp.bfloat16, name="ln2")(x)
+        h = nn.Dense(c.d_ff, dtype=jnp.bfloat16, param_dtype=jnp.float32, name="up")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(c.d_model, dtype=jnp.bfloat16, param_dtype=jnp.float32, name="down")(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    """Causal LM: embed → blocks → final LN → logits (tied to f32 head)."""
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        c = self.cfg
+        x = nn.Embed(
+            c.vocab, c.d_model, dtype=jnp.bfloat16, param_dtype=jnp.float32, name="embed"
+        )(tokens)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (c.max_seq, c.d_model),
+            jnp.float32,
+        )
+        x = x + pos[None, : tokens.shape[1], :].astype(jnp.bfloat16)
+        block = Block
+        if c.remat:
+            block = nn.remat(Block)  # trade FLOPs for HBM on long sequences
+        for i in range(c.n_layers):
+            x = block(c, name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.bfloat16, name="ln_f")(x)
+        logits = nn.Dense(
+            c.vocab, dtype=jnp.bfloat16, param_dtype=jnp.float32, name="lm_head"
+        )(x)
+        return logits.astype(jnp.float32)  # f32 softmax for stable loss
+
+
+def build_model(name: str) -> Tuple[TransformerLM, ModelConfig]:
+    """Look up a config by trace model name and build its module."""
+    try:
+        cfg = MODEL_CONFIGS[name]
+    except KeyError:
+        raise ValueError(f"unknown model {name!r}; known: {sorted(MODEL_CONFIGS)}") from None
+    return TransformerLM(cfg), cfg

@@ -1,0 +1,14 @@
+"""Parallelism layer: device meshes and sharded train steps.
+
+Where the reference reaches NCCL through ``torch.distributed`` process
+groups (SURVEY.md §2 "Distributed communication backend"), this package is
+pure ``jax.sharding``: build a named Mesh over the devices (ICI within a
+slice), annotate parameter/activation shardings (dp / tp / sp axes), and
+let XLA insert the collectives.  Nothing here spawns processes — under
+``jax.distributed`` the same code runs multi-host unchanged.
+"""
+
+from gpuschedule_tpu.parallel.mesh import make_mesh
+from gpuschedule_tpu.parallel.train import ShardedTrainer, param_partition_spec
+
+__all__ = ["make_mesh", "ShardedTrainer", "param_partition_spec"]

@@ -1,0 +1,46 @@
+"""Device mesh construction.
+
+One mesh, three named axes — ``dp`` (data), ``sp`` (sequence), ``tp``
+(tensor) — covering the parallelism dimensions the framework schedules and
+profiles.  ``make_mesh`` factors however many devices exist (real TPU
+chips, or a virtual CPU mesh under ``--xla_force_host_platform_device_count``)
+into that axis order, putting ``tp`` innermost so tensor-parallel
+collectives ride the fastest ICI hops (the scaling-book layout recipe).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+AXES = ("dp", "sp", "tp")
+
+
+def make_mesh(
+    *,
+    dp: Optional[int] = None,
+    sp: int = 1,
+    tp: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a ``(dp, sp, tp)`` mesh over ``devices`` (default: all).
+
+    ``dp`` defaults to "whatever is left": n_devices // (sp * tp).
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = len(devs)
+    if sp < 1 or tp < 1:
+        raise ValueError(f"axis sizes must be >= 1: sp={sp}, tp={tp}")
+    if n % (sp * tp) != 0:
+        raise ValueError(f"{n} devices not divisible by sp*tp={sp * tp}")
+    inferred_dp = n // (sp * tp)
+    if dp is None:
+        dp = inferred_dp
+    if dp * sp * tp != n:
+        raise ValueError(f"dp*sp*tp={dp * sp * tp} != {n} devices")
+    grid = np.array(devs).reshape(dp, sp, tp)
+    return Mesh(grid, AXES)

@@ -1,0 +1,169 @@
+"""Sharded train step: the framework's unit of measured work.
+
+The profiler measures "one optimizer step of model M on a k-chip slice"
+(SURVEY.md §3.5); this module builds that step the TPU-native way:
+
+- **dp**: batch dim sharded; XLA turns the gradient sum into a psum over
+  the ``dp`` axis (the NCCL-allreduce equivalent, compiled not called).
+- **tp**: megatron-style column/row parameter splits via
+  :func:`param_partition_spec`; XLA inserts the all-gathers/reduce-scatters.
+- **sp**: sequence dim of activations sharded (long-context path); the
+  attention all-to-all/all-gather falls out of the sharding propagation.
+
+Everything is one ``jax.jit`` with NamedShardings — no per-collective
+code, no process groups.  ``donate_argnums`` recycles param/opt buffers so
+HBM holds one copy of the state.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from gpuschedule_tpu.models import build_model
+
+
+def param_partition_spec(path: Tuple, value: Any) -> P:
+    """Megatron-style tp sharding rule for a transformer param.
+
+    ``path`` is a flax param path (tuple of DictKey names).  Column-parallel
+    layers (qkv projections, MLP up-projection, lm head) split their output
+    features over ``tp``; row-parallel layers (attention out, MLP down)
+    split their input features, so the pair needs exactly one collective.
+    Vocab embedding splits over vocab.  Everything else is replicated.
+    """
+    names = [getattr(k, "key", str(k)) for k in path]
+    leaf_shape = getattr(value, "shape", ())
+    ndim = len(leaf_shape)
+
+    def spec_for(axis_idx: int) -> P:
+        parts = [None] * ndim
+        parts[axis_idx] = "tp"
+        return P(*parts)
+
+    if "embed" in names and "embedding" in names:
+        return spec_for(0)  # (vocab, d): shard vocab
+    if "kernel" in names:
+        if any(n in names for n in ("query", "key", "value")):
+            return spec_for(1)  # (d, heads, head_dim): shard heads (column)
+        if "out" in names and "attn" in names:
+            return spec_for(0)  # (heads, head_dim, d): shard heads (row)
+        if "up" in names:
+            return spec_for(ndim - 1)  # (d, ff): column
+        if "down" in names:
+            return spec_for(0)  # (ff, d): row
+        if "lm_head" in names:
+            return spec_for(ndim - 1)  # (d, vocab): column
+    if "bias" in names and "up" in names:
+        return spec_for(0)  # (ff,): follows the column split
+    return P()  # LN scales, pos embed, remaining biases: replicated
+
+
+class ShardedTrainer:
+    """Owns a model + mesh + optimizer and exposes one jitted step.
+
+    This is what the profiler times and what ``__graft_entry__`` dry-runs:
+    construct with a mesh of any (dp, sp, tp) factorization, call
+    :meth:`init` once, then :meth:`step` per iteration.
+    """
+
+    def __init__(
+        self,
+        model_name: str,
+        mesh: Mesh,
+        *,
+        batch_size: int = 8,
+        seq_len: int = 128,
+        learning_rate: float = 1e-3,
+        seq_shard: bool = False,
+    ):
+        self.model, self.cfg = build_model(model_name)
+        self.mesh = mesh
+        if seq_len > self.cfg.max_seq:
+            raise ValueError(f"seq_len {seq_len} > model max_seq {self.cfg.max_seq}")
+        dp = mesh.shape["dp"]
+        sp = mesh.shape["sp"]
+        if batch_size % dp != 0:
+            raise ValueError(f"batch {batch_size} not divisible by dp={dp}")
+        if seq_shard and seq_len % sp != 0:
+            raise ValueError(f"seq {seq_len} not divisible by sp={sp}")
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.tx = optax.adamw(learning_rate)
+        self.batch_sharding = NamedSharding(
+            mesh, P("dp", "sp" if seq_shard and sp > 1 else None)
+        )
+
+        def constrain_params(params):
+            return jax.tree_util.tree_map_with_path(
+                lambda path, v: jax.lax.with_sharding_constraint(
+                    v, NamedSharding(mesh, param_partition_spec(path, v))
+                ),
+                params,
+            )
+
+        self._constrain = constrain_params
+
+        def init_fn(rng):
+            tokens = jnp.zeros((batch_size, seq_len), dtype=jnp.int32)
+            params = self.model.init(rng, tokens)
+            params = constrain_params(params)
+            # opt state leaves are elementwise views of params; sharding
+            # propagates from the constraint above
+            opt_state = self.tx.init(params)
+            return params, opt_state
+
+        self._init = jax.jit(init_fn)
+
+        def loss_fn(params, tokens):
+            logits = self.model.apply(params, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1, :], tokens[:, 1:]
+            ).mean()
+
+        def step_fn(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            params = constrain_params(params)
+            return params, opt_state, loss
+
+        # donate state buffers: one live copy of params/opt in HBM
+        self._step = jax.jit(step_fn, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------ #
+
+    def init(self, seed: int = 0):
+        """Initialize (params, opt_state), sharded per the partition rules."""
+        with self.mesh:
+            return self._init(jax.random.PRNGKey(seed))
+
+    def make_batch(self, seed: int = 0) -> jax.Array:
+        """A device-placed random token batch with the dp/sp sharding."""
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(seed),
+            (self.batch_size, self.seq_len),
+            0,
+            self.cfg.vocab,
+            dtype=jnp.int32,
+        )
+        return jax.device_put(tokens, self.batch_sharding)
+
+    def step(self, state, tokens):
+        """One optimizer step; returns (new_state, loss)."""
+        params, opt_state = state
+        with self.mesh:
+            params, opt_state, loss = self._step(params, opt_state, tokens)
+        return (params, opt_state), loss
+
+    def step_fn_and_args(self, seed: int = 0):
+        """(jitted_fn, example_args) — the __graft_entry__ contract shape."""
+        state = self.init(seed)
+        tokens = self.make_batch(seed)
+        return self._step, (state[0], state[1], tokens)

@@ -92,14 +92,23 @@ class DlasPolicy(Policy):
         return self._next_wakeup(sim, now)
 
     def _next_wakeup(self, sim, now: float) -> Optional[float]:
-        """Earliest future demotion or promotion instant."""
+        """Earliest future demotion or promotion instant.
+
+        Wakeups overshoot the analytic crossing time by 2x the engine's eps:
+        attained service is integrated across multiple advance() segments,
+        so at the exact instant the accumulated value can sit a few ulps
+        below the threshold — the queue would not change and the re-armed
+        wakeup (now + tiny) would be silently dropped by request_wakeup's
+        eps guard, losing the demotion tick entirely.
+        """
+        slack = 2.0 * sim.eps
         candidates = []
         for job in sim.running:
             eff = self._effective_service(job)
             i = bisect.bisect_right(self.thresholds, eff)
             if i < len(self.thresholds) and job.allocated_chips > 0:
                 dt = (self.thresholds[i] - eff) / job.allocated_chips
-                candidates.append(now + job.overhead_remaining + dt)
+                candidates.append(now + job.overhead_remaining + dt + slack)
         for job in sim.pending:
             if job.executed_work > 0.0 and self._queue(job) > 0:
                 t = (
@@ -107,5 +116,5 @@ class DlasPolicy(Policy):
                     + self.promote_ratio * job.executed_work
                 )
                 if t > now:
-                    candidates.append(t)
+                    candidates.append(t + slack)
         return min(candidates) if candidates else None

@@ -1,0 +1,31 @@
+"""Throughput profiler: the reference's NCCL microbenchmark subsystem,
+rebuilt as a JAX/XLA step-time harness (BASELINE.json north_star).
+
+Where the reference launches ``torch.distributed`` DDP + NCCL allreduce
+runs per candidate world size and fits goodput-vs-#GPUs curves (SURVEY.md
+§2 "Throughput profiler", §3.5), this package:
+
+- measures a jitted sharded train step with ``block_until_ready`` wall
+  clock (:mod:`harness`) — the JAX profiler path;
+- models the collective term analytically from slice geometry and ICI
+  bandwidth (:mod:`ici`) so goodput-vs-#chips extends beyond the chips
+  physically present (single-chip calibration, SURVEY.md §7 "Step-time
+  model fidelity");
+- fits the Optimus-family curve and caches parameters on disk
+  (:mod:`goodput`) so trace replay runs device-free (SURVEY.md §4).
+"""
+
+from gpuschedule_tpu.profiler.goodput import (
+    CurveCache,
+    GoodputCurve,
+    fit_step_time_curve,
+)
+from gpuschedule_tpu.profiler.ici import allreduce_seconds, slice_allreduce_seconds
+
+__all__ = [
+    "CurveCache",
+    "GoodputCurve",
+    "fit_step_time_curve",
+    "allreduce_seconds",
+    "slice_allreduce_seconds",
+]

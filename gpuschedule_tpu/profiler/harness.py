@@ -1,0 +1,118 @@
+"""Online measurement harness: jitted step timing over a device mesh.
+
+The TPU-native replacement for the reference's starred process boundary
+(SURVEY.md §3.2: torchrun spawn → DDP step × N iters → NCCL allreduce):
+no processes are launched — the "microbenchmark" is a jitted sharded train
+step executed on whatever mesh the caller provides, timed wall-clock with
+``block_until_ready`` after a compile+warmup phase (SURVEY.md §5
+"Tracing/profiling": the JAX profiler path).
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from typing import Dict, List, Optional, Sequence
+
+from gpuschedule_tpu.models import MODEL_CONFIGS
+from gpuschedule_tpu.profiler.goodput import (
+    CurveCache,
+    GoodputCurve,
+    fit_step_time_curve,
+    synthesize_step_times,
+)
+
+
+def measure_step_time(
+    model_name: str,
+    *,
+    devices: Optional[Sequence] = None,
+    batch_size: int = 8,
+    seq_len: int = 128,
+    warmup: int = 2,
+    iters: int = 10,
+) -> float:
+    """Median seconds per optimizer step on a dp mesh over ``devices``."""
+    import jax
+
+    from gpuschedule_tpu.parallel import ShardedTrainer, make_mesh
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    mesh = make_mesh(dp=len(devs), sp=1, tp=1, devices=devs)
+    bs = batch_size
+    if bs % len(devs) != 0:
+        bs = max(len(devs), bs - bs % len(devs))
+    trainer = ShardedTrainer(model_name, mesh, batch_size=bs, seq_len=seq_len)
+    state = trainer.init(seed=0)
+    tokens = trainer.make_batch(seed=0)
+    for _ in range(warmup):
+        state, loss = trainer.step(state, tokens)
+    jax.block_until_ready(state[0])
+    times: List[float] = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        state, loss = trainer.step(state, tokens)
+        jax.block_until_ready(loss)
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times)
+
+
+def profile_model(
+    model_name: str,
+    *,
+    ks: Sequence[int] = (1, 2, 4, 8, 16, 32, 64),
+    generation: str = "v5e",
+    devices: Optional[Sequence] = None,
+    batch_size: int = 8,
+    seq_len: int = 128,
+    cache: Optional[CurveCache] = None,
+) -> GoodputCurve:
+    """Fit a goodput curve for ``model_name``, measuring what the hardware
+    allows and extending analytically.
+
+    Every k <= len(devices) is measured on a real dp mesh; larger k are
+    synthesized from the single-chip measurement + the analytic ICI
+    allreduce over the slice shape the allocator would grant (SURVEY.md §7
+    "Step-time model fidelity" — the one-chip mitigation).  The fitted
+    curve is stored in ``cache`` when given.
+    """
+    import jax
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    cfg = MODEL_CONFIGS[model_name]
+
+    measured: Dict[int, float] = {}
+    for k in ks:
+        if k <= len(devs):
+            measured[k] = measure_step_time(
+                model_name,
+                devices=devs[:k],
+                batch_size=batch_size,
+                seq_len=seq_len,
+            )
+    if 1 not in measured:
+        measured[1] = measure_step_time(
+            model_name, devices=devs[:1], batch_size=batch_size, seq_len=seq_len
+        )
+
+    synth_ks = [k for k in ks if k not in measured]
+    points = dict(measured)
+    if synth_ks:
+        synth = synthesize_step_times(
+            single_chip_step_s=measured[1],
+            param_count=cfg.param_count,
+            generation=generation,
+            ks=synth_ks,
+        )
+        points.update(dict(zip(synth_ks, synth)))
+
+    curve = fit_step_time_curve(sorted(points), [points[k] for k in sorted(points)])
+    if cache is not None:
+        cache.put(
+            model_name,
+            curve,
+            source=f"measured<= {len(devs)} chips, analytic beyond ({generation})",
+            points=points,
+        )
+        cache.save()
+    return curve

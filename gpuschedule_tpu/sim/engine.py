@@ -257,7 +257,10 @@ class Simulator:
                         # granted here (non-slice size, bigger than a pod).
                         # Reject now instead of letting it wedge priority
                         # schedulers that would reserve budget for it forever.
-                        job.state = JobState.KILLED
+                        # REJECTED is excluded from JCT/makespan aggregates
+                        # (metrics.result), so rejecting clusters don't score
+                        # artificially good headline numbers.
+                        job.state = JobState.REJECTED
                         job.end_time = t
                         self.finished.append(job)
                         self.metrics.record_job(job)

@@ -34,8 +34,10 @@ class JobState(enum.Enum):
     DONE = "done"              # ran to completion (trace status Pass)
     FAILED = "failed"          # trace-declared failure surfaced at completion
     KILLED = "killed"          # trace-declared kill surfaced at completion
+    REJECTED = "rejected"      # admission control: gang size never satisfiable
+                               # on this cluster; excluded from JCT aggregates
 
-END_STATES = (JobState.DONE, JobState.FAILED, JobState.KILLED)
+END_STATES = (JobState.DONE, JobState.FAILED, JobState.KILLED, JobState.REJECTED)
 
 # Map of trace-declared completion statuses (Philly schema, SURVEY.md §5
 # "Failure detection": a faithful replayer must handle failed/killed jobs) to

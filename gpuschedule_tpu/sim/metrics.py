@@ -58,6 +58,7 @@ class SimResult:
     num_unfinished: int
     counters: Dict[str, int]
     end_time: float
+    num_rejected: int = 0
     jobs: List[Job] = field(repr=False, default_factory=list)
 
     def summary(self) -> Dict[str, float]:
@@ -68,6 +69,7 @@ class SimResult:
             "mean_utilization": self.mean_utilization,
             "num_finished": self.num_finished,
             "num_unfinished": self.num_unfinished,
+            "num_rejected": self.num_rejected,
             **{k: float(v) for k, v in self.counters.items()},
         }
 
@@ -122,7 +124,13 @@ class MetricsLog:
 
     # ------------------------------------------------------------------ #
     def result(self, jobs: Sequence[Job], end_time: float) -> SimResult:
-        finished = [j for j in jobs if j.end_time is not None]
+        # Admission-rejected jobs never ran: counting their 0-second "JCT"
+        # would flatter clusters that reject more, so they are excluded from
+        # every aggregate and surfaced via the num_rejected field /
+        # rejected_unsatisfiable counter instead.
+        finished = [
+            j for j in jobs if j.end_time is not None and j.state is not JobState.REJECTED
+        ]
         jcts = [j.jct() for j in finished]
         qdelays = [j.queueing_delay() for j in finished if j.queueing_delay() is not None]
         if finished:
@@ -141,15 +149,17 @@ class MetricsLog:
                     area += (used / total) * (t1 - t0)
                     horizon += t1 - t0
             util = area / horizon if horizon > 0 else 0.0
+        rejected = sum(1 for j in jobs if j.state is JobState.REJECTED)
         return SimResult(
             avg_jct=sum(jcts) / len(jcts) if jcts else 0.0,
             makespan=makespan,
             p95_queueing_delay=_percentile(qdelays, 95.0),
             mean_utilization=util,
             num_finished=len(finished),
-            num_unfinished=len(jobs) - len(finished),
+            num_unfinished=len(jobs) - len(finished) - rejected,
             counters=dict(self.counters),
             end_time=end_time,
+            num_rejected=rejected,
             jobs=list(jobs),
         )
 

@@ -1,13 +1,25 @@
 """Test configuration.
 
-Forces JAX onto a virtual 8-device CPU mesh *before* any test imports jax, so
-multi-chip sharding logic (profiler harness, parallel train steps) is
+Forces JAX onto a virtual 8-device CPU mesh *before* any test imports jax,
+so multi-chip sharding logic (profiler harness, parallel train steps) is
 exercised without TPU hardware.  The pure-Python sim core never imports jax.
+
+Note: this environment registers an `axon` TPU PJRT plugin from
+sitecustomize at interpreter boot, and that registration overrides the
+JAX_PLATFORMS env var — the platform must be forced programmatically before
+the first backend access.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+try:
+    import jax  # noqa: E402  (import after env mutation is the whole point)
+except ImportError:  # jax is the optional [profiler] extra; the pure-Python
+    jax = None       # sim/policy tests must still run without it
+else:
+    jax.config.update("jax_platforms", "cpu")

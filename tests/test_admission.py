@@ -33,8 +33,10 @@ def test_srtf_not_wedged_by_unsatisfiable_job():
     c = TpuCluster("v5e", dims=(4, 4), num_pods=2)
     res = Simulator(c, make_policy("srtf"), jobs).run()
     by_id = {j.job_id: j for j in res.jobs}
-    assert by_id["impossible32"].state is JobState.KILLED
-    assert by_id["impossible32"].jct() == 0.0
+    assert by_id["impossible32"].state is JobState.REJECTED
+    # rejected jobs are excluded from headline aggregates
+    assert res.num_rejected == 1
+    assert res.num_finished == 2
     assert by_id["running16"].state is JobState.DONE
     assert by_id["small4"].state is JobState.DONE
     assert by_id["small4"].first_start_time == pytest.approx(6.0)  # other pod
@@ -48,9 +50,23 @@ def test_dlas_not_starved_by_non_pow2_job():
     ]
     res = Simulator(TpuCluster("v5e", dims=(4, 4)), make_policy("dlas"), jobs).run()
     by_id = {j.job_id: j for j in res.jobs}
-    assert by_id["odd3"].state is JobState.KILLED
+    assert by_id["odd3"].state is JobState.REJECTED
     assert by_id["ok16"].state is JobState.DONE
     assert by_id["ok16"].end_time == pytest.approx(11.0)
+
+
+def test_rejected_jobs_do_not_dilute_jct_aggregates():
+    """Reviewer repro: 1 real 100s job + 9 unsatisfiable jobs used to report
+    avg_jct=10.0 and num_finished=10; rejections must not flatter metrics."""
+    jobs = [Job("real", 0.0, num_chips=4, duration=100.0)] + [
+        Job(f"bad{i}", 0.0, num_chips=3, duration=1.0) for i in range(9)
+    ]
+    res = Simulator(TpuCluster("v5e"), make_policy("fifo"), jobs).run()
+    assert res.num_finished == 1
+    assert res.num_rejected == 9
+    assert res.num_unfinished == 0
+    assert res.avg_jct == pytest.approx(100.0)
+    assert res.makespan == pytest.approx(100.0)
 
 
 def test_fifo_head_of_line_not_blocked_forever_by_rejected_job():
