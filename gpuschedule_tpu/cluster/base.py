@@ -60,7 +60,80 @@ class ClusterBase:
         return 0 < num_chips <= self.total_chips
 
 
-class SimpleCluster(ClusterBase):
+class OverlayMixin:
+    """Shared-allocation ("packing") support for cluster flavors.
+
+    Gandiva co-locates low-utilization jobs on the same devices (SURVEY.md
+    §3.3 "packing").  An *overlay* is an Allocation that shares the chips of
+    a live base allocation: it consumes no extra capacity, must match the
+    base's size, and when the base is freed the oldest overlay is promoted
+    to become the new owner so the remaining packed job keeps its chips.
+
+    Flavors call :meth:`_try_overlay` from ``allocate`` and
+    :meth:`_free_with_overlays` from ``free``; ``_promote`` is the flavor
+    hook that rebinds base-side bookkeeping (nothing for the flat pool,
+    geometry ownership for the slice allocator).
+    """
+
+    def _init_overlays(self) -> None:
+        self._overlays: dict[int, int] = {}  # overlay alloc_id -> base alloc_id
+
+    def _base_id(self, allocation: Allocation) -> int:
+        return self._overlays.get(allocation.alloc_id, allocation.alloc_id)
+
+    def overlay_groups(self) -> dict[int, list[int]]:
+        """base alloc_id -> overlay alloc_ids currently sharing it."""
+        groups: dict[int, list[int]] = {}
+        for o, b in self._overlays.items():
+            groups.setdefault(b, []).append(o)
+        return {b: sorted(os) for b, os in groups.items()}
+
+    def _try_overlay(self, num_chips: int, hint: Optional[dict]):
+        """Return an overlay Allocation if the hint asks for one, None if the
+        hint is absent, or raise if the request is malformed."""
+        if not hint or "overlay" not in hint:
+            return None
+        base: Allocation = hint["overlay"]
+        bid = self._base_id(base)
+        size = self._live_size(bid)
+        if size is None:
+            raise ValueError(f"overlay base {base.alloc_id} is not live")
+        if num_chips != size:
+            raise ValueError(
+                f"overlay must match base size: requested {num_chips}, base has {size}"
+            )
+        alloc = Allocation(next(self._ids), num_chips, detail=self._live_detail(bid))
+        self._overlays[alloc.alloc_id] = bid
+        return alloc
+
+    def _free_with_overlays(self, alloc_id: int) -> bool:
+        """Handle freeing when overlays are involved.  Returns True if the
+        free is fully handled (overlay dropped, or ownership promoted)."""
+        if alloc_id in self._overlays:
+            del self._overlays[alloc_id]
+            return True
+        heirs = sorted(o for o, b in self._overlays.items() if b == alloc_id)
+        if heirs:
+            new_base = heirs[0]
+            del self._overlays[new_base]
+            for o in heirs[1:]:
+                self._overlays[o] = new_base
+            self._promote(alloc_id, new_base)
+            return True
+        return False
+
+    # flavor hooks -------------------------------------------------------
+    def _live_size(self, alloc_id: int) -> Optional[int]:
+        raise NotImplementedError
+
+    def _live_detail(self, alloc_id: int):
+        return None
+
+    def _promote(self, old_base_id: int, new_base_id: int) -> None:
+        raise NotImplementedError
+
+
+class SimpleCluster(OverlayMixin, ClusterBase):
     """Flat chip pool with no topology — the minimal stand-in that makes the
     policy layer runnable before (or without) the slice allocator, equivalent
     to treating the cluster as one big node."""
@@ -70,12 +143,16 @@ class SimpleCluster(ClusterBase):
         self._used = 0
         self._ids = itertools.count()
         self._live: dict[int, int] = {}
+        self._init_overlays()
 
     @property
     def used_chips(self) -> int:
         return self._used
 
     def allocate(self, num_chips: int, *, job=None, hint: Optional[dict] = None):
+        overlay = self._try_overlay(num_chips, hint)
+        if overlay is not None:
+            return overlay
         if num_chips <= 0 or num_chips > self.free_chips:
             return None
         alloc = Allocation(next(self._ids), num_chips)
@@ -86,7 +163,15 @@ class SimpleCluster(ClusterBase):
     def free(self, allocation: Optional[Allocation]) -> None:
         if allocation is None:
             return
+        if self._free_with_overlays(allocation.alloc_id):
+            return
         n = self._live.pop(allocation.alloc_id, None)
         if n is None:
             raise ValueError(f"double free of allocation {allocation.alloc_id}")
         self._used -= n
+
+    def _live_size(self, alloc_id: int) -> Optional[int]:
+        return self._live.get(alloc_id)
+
+    def _promote(self, old_base_id: int, new_base_id: int) -> None:
+        self._live[new_base_id] = self._live.pop(old_base_id)

@@ -44,7 +44,7 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from gpuschedule_tpu.cluster.base import Allocation, ClusterBase
+from gpuschedule_tpu.cluster.base import Allocation, ClusterBase, OverlayMixin
 
 # Modeled per-generation interconnect constants consumed by the profiler's
 # analytic allreduce term (SURVEY.md §7 "Step-time model fidelity").  Values
@@ -151,7 +151,7 @@ class SliceGeometry:
             yield tuple(o + d for o, d in zip(self.origin, offs))
 
 
-class TpuCluster(ClusterBase):
+class TpuCluster(OverlayMixin, ClusterBase):
     """A fleet of identical TPU pods with contiguous slice allocation.
 
     ``allocate(k)`` grants an axis-aligned free box of a valid k-chip shape
@@ -192,6 +192,7 @@ class TpuCluster(ClusterBase):
         self._used = 0
         self._ids = itertools.count()
         self._live: Dict[int, SliceGeometry] = {}
+        self._init_overlays()
         # fragmentation accounting: allocation failures while enough chips
         # were free in aggregate (i.e. failures caused purely by geometry)
         self.fragmentation_failures = 0
@@ -225,6 +226,9 @@ class TpuCluster(ClusterBase):
             orders here; default is lexicographic first-fit).
         """
         self.allocation_attempts += 1
+        overlay = self._try_overlay(num_chips, hint)
+        if overlay is not None:
+            return overlay
         if num_chips <= 0:
             return None
         shapes = valid_slice_shapes(num_chips, self.dims)
@@ -266,11 +270,23 @@ class TpuCluster(ClusterBase):
     def free(self, allocation: Optional[Allocation]) -> None:
         if allocation is None:
             return
+        if self._free_with_overlays(allocation.alloc_id):
+            return
         geom = self._live.pop(allocation.alloc_id, None)
         if geom is None:
             raise ValueError(f"double free of allocation {allocation.alloc_id}")
         self._box(self._occ[geom.pod], geom.origin, geom.shape)[...] = 0
         self._used -= geom.num_chips
+
+    def _live_size(self, alloc_id: int) -> Optional[int]:
+        geom = self._live.get(alloc_id)
+        return None if geom is None else geom.num_chips
+
+    def _live_detail(self, alloc_id: int):
+        return self._live.get(alloc_id)
+
+    def _promote(self, old_base_id: int, new_base_id: int) -> None:
+        self._live[new_base_id] = self._live.pop(old_base_id)
 
     def is_satisfiable(self, num_chips: int) -> bool:
         """True iff some valid slice shape exists for this size at all —

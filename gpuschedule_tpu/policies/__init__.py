@@ -7,9 +7,15 @@ the :class:`gpuschedule_tpu.policies.base.Policy` interface.
 from gpuschedule_tpu.policies.base import Policy
 from gpuschedule_tpu.policies.dlas import DlasPolicy
 from gpuschedule_tpu.policies.fifo import FifoPolicy
+from gpuschedule_tpu.policies.gandiva import GandivaPolicy
 from gpuschedule_tpu.policies.srtf import SrtfPolicy
 
-_REGISTRY = {"fifo": FifoPolicy, "srtf": SrtfPolicy, "dlas": DlasPolicy}
+_REGISTRY = {
+    "fifo": FifoPolicy,
+    "srtf": SrtfPolicy,
+    "dlas": DlasPolicy,
+    "gandiva": GandivaPolicy,
+}
 
 
 def register(name: str, factory) -> None:
@@ -33,6 +39,7 @@ __all__ = [
     "FifoPolicy",
     "SrtfPolicy",
     "DlasPolicy",
+    "GandivaPolicy",
     "make_policy",
     "available",
     "register",

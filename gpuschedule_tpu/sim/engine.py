@@ -165,10 +165,16 @@ class Simulator:
 
     def migrate(self, job: Job, *, overhead: float, placement_hint: Optional[dict] = None) -> bool:
         """Move a running job to a fresh allocation, paying ``overhead``
-        seconds of modeled checkpoint/restore cost (SURVEY.md §3.3 migration)."""
+        seconds of modeled checkpoint/restore cost (SURVEY.md §3.3 migration).
+
+        Returns False — with NO cost charged — when the move didn't happen:
+        the hint was unsatisfiable, or first-fit handed back the very slice
+        the job already held (a job already at its packed position must not
+        be taxed for a no-op "migration")."""
         if job.state is not JobState.RUNNING:
             raise RuntimeError(f"migrate on non-running job {job!r}")
         chips, speed = job.allocated_chips, job.speed
+        old_detail = job.allocation.detail if job.allocation is not None else None
         job.advance(self.now)
         self.cluster.free(job.allocation)
         alloc = self.cluster.allocate(chips, job=job, hint=placement_hint)
@@ -179,6 +185,8 @@ class Simulator:
             job.allocation = alloc
             return False
         job.allocation = alloc
+        if old_detail is not None and alloc.detail == old_detail:
+            return False  # same slice re-granted: no movement, no cost
         job.overhead_remaining += overhead
         job.migration_count += 1
         job.epoch += 1

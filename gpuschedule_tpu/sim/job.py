@@ -65,6 +65,8 @@ class Job:
     iterations: Optional[int] = None    # optional iteration count (Optimus uses it)
     status: str = "Pass"                # trace-declared outcome: Pass|Failed|Killed
     user: str = ""                      # submitting user/vc (Philly has VCs)
+    utilization: float = 1.0            # profiled device utilization in [0,1];
+                                        # Gandiva's packing signal (SURVEY.md §3.3)
 
     # ---- runtime accounting (engine-owned) ----
     state: JobState = JobState.PENDING

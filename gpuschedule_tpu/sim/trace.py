@@ -35,6 +35,7 @@ TRACE_FIELDS = [
     "iterations",
     "status",
     "user",
+    "utilization",
 ]
 
 # Default gang-size mix: mostly small jobs with a tail of large ones, the
@@ -69,6 +70,9 @@ def generate_poisson_trace(
     size_weights: Sequence[tuple[int, float]] = DEFAULT_SIZE_WEIGHTS,
     models: Sequence[str] = DEFAULT_MODELS,
     failure_rate: float = 0.0,            # fraction of jobs ending Failed/Killed
+    util_range: tuple[float, float] = (1.0, 1.0),  # uniform profiled-utilization
+                                          # draw; widen (e.g. (0.3, 1.0)) to give
+                                          # Gandiva packing candidates
 ) -> List[Job]:
     """Generate an open-arrival synthetic trace.
 
@@ -93,6 +97,7 @@ def generate_poisson_trace(
         status = "Pass"
         if failure_rate > 0.0 and rng.random() < failure_rate:
             status = rng.choice(["Failed", "Killed"])
+        lo, hi = util_range
         jobs.append(
             Job(
                 job_id=f"j{i:05d}",
@@ -102,6 +107,7 @@ def generate_poisson_trace(
                 model_name=rng.choice(list(models)),
                 iterations=max(1, int(duration)),  # 1 it/s nominal
                 status=status,
+                utilization=round(rng.uniform(lo, hi), 3),
             )
         )
     return jobs
@@ -125,6 +131,7 @@ def save_trace_csv(jobs: Iterable[Job], path: str | Path) -> None:
                     j.iterations if j.iterations is not None else "",
                     j.status,
                     j.user,
+                    j.utilization,
                 ]
             )
 
@@ -144,6 +151,7 @@ def load_trace_csv(path: str | Path) -> List[Job]:
                     iterations=int(row["iterations"]) if row.get("iterations") else None,
                     status=row.get("status") or "Pass",
                     user=row.get("user") or "",
+                    utilization=float(row["utilization"]) if row.get("utilization") else 1.0,
                 )
             )
     jobs.sort(key=lambda j: (j.submit_time, j.job_id))
