@@ -8,6 +8,7 @@ from gpuschedule_tpu.policies.base import Policy
 from gpuschedule_tpu.policies.dlas import DlasPolicy
 from gpuschedule_tpu.policies.fifo import FifoPolicy
 from gpuschedule_tpu.policies.gandiva import GandivaPolicy
+from gpuschedule_tpu.policies.optimus import OptimusPolicy
 from gpuschedule_tpu.policies.srtf import SrtfPolicy
 
 _REGISTRY = {
@@ -15,6 +16,7 @@ _REGISTRY = {
     "srtf": SrtfPolicy,
     "dlas": DlasPolicy,
     "gandiva": GandivaPolicy,
+    "optimus": OptimusPolicy,
 }
 
 
@@ -40,6 +42,7 @@ __all__ = [
     "SrtfPolicy",
     "DlasPolicy",
     "GandivaPolicy",
+    "OptimusPolicy",
     "make_policy",
     "available",
     "register",

@@ -148,10 +148,11 @@ class GandivaPolicy(Policy):
             if host is None:
                 continue
             hint = {"overlay": host.allocation}
-            combined = host.utilization + job.utilization
-            speed = 1.0 if combined <= 1.0 else 1.0 / combined
+            # started at nominal speed; _update_pack_speeds (invoked right
+            # after in the same schedule pass, zero sim time elapsing) is the
+            # single owner of the contention model for packed groups
             overhead = self.suspend_overhead if job.executed_work > 0.0 else 0.0
-            if sim.try_start(job, overhead=overhead, speed=speed, placement_hint=hint):
+            if sim.try_start(job, overhead=overhead, speed=1.0, placement_hint=hint):
                 job.sched["g_round_start"] = now
                 sim.metrics.count("packings")
                 groups = self._overlay_groups(sim)  # refresh: host now packed

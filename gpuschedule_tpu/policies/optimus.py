@@ -1,0 +1,191 @@
+"""Optimus: goodput-driven elastic allocation by marginal gain.
+
+The Optimus scheduler (EuroSys'18; SURVEY.md §3.2) re-plans the whole
+cluster each round from per-model goodput curves:
+
+1. every active job's remaining time at k chips is estimated from its
+   fitted step-time curve (remaining work scaled by the curve ratio);
+2. chips are assigned greedily — every job seeds at ``min_chips``, then
+   the upgrade with the best **marginal gain** (remaining-time reduction
+   per added chip) wins the next doubling, until the pod is exhausted or
+   no upgrade helps (the curve's latency term makes oversized slices
+   genuinely unprofitable, so the greedy loop self-terminates);
+3. the plan is enacted through the engine: shrink/preempt first to free
+   chips, then grow, then start — growth is a slice-size doubling because
+   TPU allocations are power-of-two sub-meshes, where the reference grew
+   GPU counts one at a time.
+
+Curves come from a :class:`~gpuschedule_tpu.profiler.CurveCache` (device-
+free replay, SURVEY.md §4 "pre-fitted curve files") or, with
+``online=True``, from the live JAX harness the first time each model is
+seen — the reference's "launch a profiling run when a new job type
+arrives" loop with jitted step timing instead of NCCL microbenchmarks
+(BASELINE.json config #4).
+"""
+
+from __future__ import annotations
+
+import heapq
+import math
+from typing import Dict, Optional
+
+from gpuschedule_tpu.policies.base import Policy
+from gpuschedule_tpu.profiler.goodput import CurveCache, GoodputCurve
+from gpuschedule_tpu.sim.job import Job, JobState
+
+# Fallback when no curve exists and online profiling is off: near-ideal DP
+# scaling with a whisper of latency so oversizing still has a cost.
+DEFAULT_CURVE = GoodputCurve((1.0, 0.0, 1e-4))
+
+
+class OptimusPolicy(Policy):
+    name = "optimus"
+
+    def __init__(
+        self,
+        *,
+        curve_cache: Optional[CurveCache] = None,
+        online: bool = False,
+        round_interval: float = 60.0,
+        resize_overhead: float = 10.0,
+        min_chips: int = 1,
+        profile_ks=(1, 2, 4),
+        profile_batch: int = 2,
+        profile_seq: int = 32,
+    ):
+        self.cache = curve_cache
+        self.online = online
+        self.round_interval = round_interval
+        self.resize_overhead = resize_overhead
+        self.min_chips = min_chips
+        self.profile_ks = tuple(profile_ks)
+        self.profile_batch = profile_batch
+        self.profile_seq = profile_seq
+        self._curves: Dict[str, GoodputCurve] = {}
+
+    # ------------------------------------------------------------------ #
+    # curves
+
+    def _curve(self, model_name: str) -> GoodputCurve:
+        curve = self._curves.get(model_name)
+        if curve is not None:
+            return curve
+        if self.cache is not None and model_name in self.cache:
+            curve = self.cache.get(model_name)
+        elif self.online:
+            # the reference's online-profiling boundary (SURVEY.md §3.2 ★):
+            # a real measured run, here a jitted train step on live devices
+            from gpuschedule_tpu.profiler.harness import profile_model
+
+            curve = profile_model(
+                model_name,
+                ks=self.profile_ks,
+                batch_size=self.profile_batch,
+                seq_len=self.profile_seq,
+                cache=self.cache,
+            )
+        else:
+            curve = DEFAULT_CURVE
+        self._curves[model_name] = curve
+        return curve
+
+    # ------------------------------------------------------------------ #
+
+    def schedule(self, sim) -> Optional[float]:
+        active = [j for j in sim.pending + sim.running if not j.finished]
+        if not active:
+            return None
+        plan = self._plan(sim, active)
+        self._enact(sim, plan)
+        # Anchor the next tick to the global round grid, NOT now + interval:
+        # per-event offsets never coincide, so free-running chains seeded by
+        # every arrival/completion would multiply into O(events x horizon)
+        # tick storms; grid-aligned ticks land on equal timestamps and the
+        # engine batches them into one policy invocation.
+        return (math.floor(sim.now / self.round_interval) + 1) * self.round_interval
+
+    # ------------------------------------------------------------------ #
+    # planning
+
+    def _remaining_at(self, job: Job, k: int) -> float:
+        """Wall seconds to finish job on k chips per its curve (the curve
+        ratio rescales the trace-declared reference-speed work)."""
+        curve = self._curve(job.model_name)
+        return job.remaining_work * curve.step_time(k) / curve.step_time(job.num_chips)
+
+    def _gain(self, job: Job, k: int) -> float:
+        """Marginal remaining-time reduction per chip for doubling k."""
+        return (self._remaining_at(job, k) - self._remaining_at(job, 2 * k)) / k
+
+    def _max_chips(self, sim, job: Job) -> int:
+        cap = getattr(sim.cluster, "pod_chips", sim.cluster.total_chips)
+        return cap
+
+    def _plan(self, sim, active) -> Dict[str, int]:
+        """Greedy marginal-gain chip assignment; returns job_id -> chips."""
+        budget = sim.cluster.total_chips
+        ordered = sorted(active, key=lambda j: j.arrival_seq)
+        plan: Dict[str, int] = {}
+        by_id: Dict[str, Job] = {}
+        for job in ordered:
+            by_id[job.job_id] = job
+            k0 = self.min_chips
+            if budget >= k0 and sim.cluster.is_satisfiable(k0):
+                plan[job.job_id] = k0
+                budget -= k0
+            else:
+                plan[job.job_id] = 0
+
+        h: list = []
+        for job in ordered:
+            k = plan[job.job_id]
+            if k > 0:
+                g = self._gain(job, k)
+                if g > 0:
+                    heapq.heappush(h, (-g, job.arrival_seq, job.job_id))
+        while h and budget > 0:
+            _, seq, jid = heapq.heappop(h)
+            job = by_id[jid]
+            k = plan[jid]
+            nk = 2 * k
+            cost = nk - k
+            if (
+                cost > budget
+                or nk > self._max_chips(sim, job)
+                or not sim.cluster.is_satisfiable(nk)
+            ):
+                continue
+            plan[jid] = nk
+            budget -= cost
+            g = self._gain(job, nk)
+            if g > 0:
+                heapq.heappush(h, (-g, seq, jid))
+        return plan
+
+    # ------------------------------------------------------------------ #
+    # enactment
+
+    def _speed(self, job: Job, k: int) -> float:
+        return self._curve(job.model_name).speed_factor(k, job.num_chips)
+
+    def _enact(self, sim, plan: Dict[str, int]) -> None:
+        # shrink & evict first: frees chips (and boxes) for the growers
+        for job in list(sim.running):
+            k = plan.get(job.job_id, 0)
+            if k == 0:
+                sim.preempt(job, suspend=False)
+            elif k < job.allocated_chips:
+                sim.resize(
+                    job, chips=k, speed=self._speed(job, k), overhead=self.resize_overhead
+                )
+        for job in list(sim.running):
+            k = plan.get(job.job_id, 0)
+            if k > job.allocated_chips:
+                sim.resize(
+                    job, chips=k, speed=self._speed(job, k), overhead=self.resize_overhead
+                )
+        for job in sorted(sim.pending, key=lambda j: j.arrival_seq):
+            k = plan.get(job.job_id, 0)
+            if k > 0:
+                overhead = self.resize_overhead if job.executed_work > 0.0 else 0.0
+                sim.try_start(job, chips=k, speed=self._speed(job, k), overhead=overhead)

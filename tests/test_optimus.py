@@ -1,0 +1,143 @@
+"""Optimus policy tests: marginal-gain planning, elastic enactment through
+engine.resize, curve-cache replay, and the online-profiling loop on the
+CPU mesh (BASELINE config #4).
+"""
+
+import pytest
+
+from gpuschedule_tpu.cluster import SimpleCluster, TpuCluster
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.policies.optimus import OptimusPolicy
+from gpuschedule_tpu.profiler import CurveCache, GoodputCurve
+from gpuschedule_tpu.sim import Job, JobState, Simulator
+from gpuschedule_tpu.sim.trace import generate_poisson_trace
+
+
+def cache_with(tmp_path, **curves):
+    c = CurveCache(tmp_path / "curves.json")
+    for name, theta in curves.items():
+        c.put(name, GoodputCurve(theta))
+    return c
+
+
+def test_single_job_gets_whole_cluster_under_ideal_scaling(tmp_path):
+    """With near-linear speedup and an empty cluster, Optimus grows the one
+    job to the full pod and it finishes ~num_chips*duration/pod faster."""
+    cache = cache_with(tmp_path, **{"transformer-tiny": (1.0, 0.0, 1e-6)})
+    job = Job("solo", 0.0, num_chips=4, duration=400.0, model_name="transformer-tiny")
+    sim = Simulator(
+        TpuCluster("v5e", dims=(4, 4)),
+        OptimusPolicy(curve_cache=cache, resize_overhead=0.0),
+        [job],
+    )
+    res = sim.run()
+    (j,) = res.jobs
+    assert j.state is JobState.DONE
+    # grown to 16 chips at ~4x the reference speed -> ~100s
+    assert j.end_time < 140.0
+    assert j.executed_work == pytest.approx(400.0)
+
+
+def test_latency_term_caps_growth(tmp_path):
+    """A strong latency term makes big slices unprofitable: the plan stops
+    doubling even with free chips available."""
+    cache = cache_with(tmp_path, **{"transformer-tiny": (1.0, 0.0, 0.2)})
+    pol = OptimusPolicy(curve_cache=cache)
+    job = Job("j", 0.0, num_chips=4, duration=100.0, model_name="transformer-tiny")
+    sim = Simulator(TpuCluster("v5e", dims=(4, 4)), pol, [job])
+    plan = pol._plan(sim, [job])
+    # step_time: 1/k + 0.2(k-1): minimum at k=2 (0.7) vs k=1 (1.0), k=4 (0.85)
+    assert plan["j"] == 2
+
+
+def test_chips_flow_to_highest_marginal_gain(tmp_path):
+    """A strongly-scaling model outbids a latency-bound one for chips."""
+    cache = cache_with(
+        tmp_path,
+        **{
+            "transformer-base": (1.0, 0.0, 1e-6),   # scales nearly linearly
+            "mlp-wide": (1.0, 0.0, 0.5),            # stops paying at k=2
+        },
+    )
+    jobs = [
+        Job("scaler", 0.0, num_chips=4, duration=1000.0, model_name="transformer-base"),
+        Job("bound", 0.0, num_chips=4, duration=1000.0, model_name="mlp-wide"),
+    ]
+    pol = OptimusPolicy(curve_cache=cache)
+    sim = Simulator(TpuCluster("v5e", dims=(4, 4)), pol, jobs)
+    plan = pol._plan(sim, jobs)
+    assert plan["scaler"] > plan["bound"]
+    assert plan["scaler"] + plan["bound"] <= 16
+
+
+def test_elastic_shrink_on_new_arrival(tmp_path):
+    """An incumbent holding the pod shrinks when a second job arrives."""
+    cache = cache_with(tmp_path, **{"transformer-tiny": (1.0, 0.0, 1e-6)})
+    jobs = [
+        Job("first", 0.0, num_chips=4, duration=500.0, model_name="transformer-tiny"),
+        Job("second", 50.0, num_chips=4, duration=500.0, model_name="transformer-tiny"),
+    ]
+    sim = Simulator(
+        TpuCluster("v5e", dims=(4, 4)),
+        OptimusPolicy(curve_cache=cache, resize_overhead=5.0),
+        jobs,
+    )
+    res = sim.run()
+    first = next(j for j in res.jobs if j.job_id == "first")
+    second = next(j for j in res.jobs if j.job_id == "second")
+    assert second.first_start_time == pytest.approx(50.0)  # no queueing
+    assert all(j.executed_work == pytest.approx(j.duration) for j in res.jobs)
+    # the incumbent was resized (grown to pod, shrunk on arrival, regrown)
+    assert res.counters.get("migrations", 0) == 0
+    assert first.state is JobState.DONE and second.state is JobState.DONE
+
+
+def test_work_conservation_and_determinism_poisson(tmp_path):
+    cache = cache_with(
+        tmp_path,
+        **{
+            "transformer-tiny": (1.0, 0.01, 1e-4),
+            "transformer-small": (1.0, 0.01, 1e-4),
+            "transformer-base": (1.0, 0.02, 1e-4),
+            "mlp-wide": (1.0, 0.0, 1e-3),
+        },
+    )
+
+    def run():
+        return Simulator(
+            TpuCluster("v5e"),
+            OptimusPolicy(curve_cache=cache, round_interval=120.0),
+            generate_poisson_trace(120, seed=37),
+        ).run()
+
+    res = run()
+    assert res.num_finished == 120
+    for j in res.jobs:
+        assert j.executed_work == pytest.approx(j.duration)
+    res2 = run()
+    assert res2.avg_jct == res.avg_jct and res2.makespan == res.makespan
+
+
+def test_registry_constructs_optimus():
+    pol = make_policy("optimus")
+    assert isinstance(pol, OptimusPolicy)
+
+
+def test_online_profiling_in_the_loop(tmp_path):
+    """BASELINE config #4: the online JAX profiler feeds curves mid-run.
+
+    One tiny model on the CPU mesh; the first schedule() call triggers a
+    real measured profile (jitted steps at k=1,2), whose curve then drives
+    planning; the fitted curve lands in the cache file.
+    """
+    pytest.importorskip("jax", reason="online profiling needs the [profiler] extra")
+    cache = CurveCache(tmp_path / "curves.json")
+    jobs = [
+        Job("a", 0.0, num_chips=2, duration=50.0, model_name="transformer-tiny"),
+        Job("b", 0.0, num_chips=2, duration=50.0, model_name="transformer-tiny"),
+    ]
+    pol = OptimusPolicy(curve_cache=cache, online=True, profile_ks=(1, 2))
+    res = Simulator(SimpleCluster(8), pol, jobs).run()
+    assert res.num_finished == 2
+    assert all(j.executed_work == pytest.approx(j.duration) for j in res.jobs)
+    assert "transformer-tiny" in CurveCache(tmp_path / "curves.json")
