@@ -1,0 +1,208 @@
+"""Microsoft Philly trace ingestion.
+
+The reference replays the Philly cluster trace (SURVEY.md §2 "Trace data";
+BASELINE.json configs #2/#5).  The published trace (Philly ATC'19 [P])
+records, per job: an id, the virtual cluster (vc), a submission timestamp,
+the requested GPU count, the run duration, and a completion status in
+{Pass, Killed, Failed} — a faithful replayer must surface those statuses
+as terminal states rather than treating every job as successful
+(SURVEY.md §5 "Failure detection").
+
+Two TPU-specific concerns live here, at ingestion (SURVEY.md §7 "Philly
+trace fidelity"):
+
+- **#GPU → slice mapping**: Philly gang sizes are arbitrary ints (1, 2,
+  3, 5, 8, 24, ...); TPU slices are power-of-two sub-meshes.  Requests
+  are rounded UP to the next valid slice size — capacity is never taken
+  away from a job — with the raw GPU count kept in ``job.sched
+  ["philly_num_gpus"]`` so analysis can compare against the original
+  workload.  Jobs larger than ``max_chips`` (one pod by default) are
+  clamped to it: the reference cluster ran jobs up to full-rack size and
+  a slice cannot span pods.
+- **Timestamps**: submission times may be absolute datetimes or float
+  seconds; both parse to seconds relative to the trace origin so replay
+  starts at t=0.
+
+No reference file:line citations possible (/root/reference is an empty
+mount — SURVEY.md §0).
+"""
+
+from __future__ import annotations
+
+import csv
+import random
+from datetime import datetime, timezone
+from pathlib import Path
+from typing import List, Optional
+
+from gpuschedule_tpu.cluster.tpu import next_pow2
+from gpuschedule_tpu.sim.job import Job
+
+# Philly-schema CSV columns.  Aliases cover the column spellings that
+# appear across published derivatives of the trace.
+PHILLY_FIELDS = ["jobid", "status", "vc", "submitted_time", "num_gpus", "duration"]
+_ALIASES = {
+    "jobid": ("jobid", "job_id", "id"),
+    "status": ("status", "state"),
+    "vc": ("vc", "user", "queue"),
+    "submitted_time": ("submitted_time", "submit_time", "submitted"),
+    "num_gpus": ("num_gpus", "num_gpu", "gpus"),
+    "duration": ("duration", "run_time", "runtime"),
+}
+
+_TIME_FORMATS = ("%Y-%m-%d %H:%M:%S", "%Y-%m-%dT%H:%M:%S")
+
+# Philly statuses (case-insensitive) -> native trace statuses.
+_STATUS = {"pass": "Pass", "killed": "Killed", "failed": "Failed"}
+
+
+def _parse_time(raw: str) -> float:
+    """Float seconds, or a datetime string converted to epoch seconds."""
+    try:
+        return float(raw)
+    except ValueError:
+        pass
+    for fmt in _TIME_FORMATS:
+        try:
+            # UTC, not host-local: a naive .timestamp() shifts across DST
+            # transitions and varies by machine, distorting replay spacing
+            return datetime.strptime(raw, fmt).replace(tzinfo=timezone.utc).timestamp()
+        except ValueError:
+            continue
+    raise ValueError(f"unparseable submitted_time {raw!r}")
+
+
+def _get(row: dict, field: str) -> Optional[str]:
+    for alias in _ALIASES[field]:
+        if alias in row and row[alias] not in (None, ""):
+            return row[alias]
+    return None
+
+
+def load_philly_csv(
+    path: str | Path,
+    *,
+    max_chips: int = 256,
+    model_name: str = "transformer-small",
+) -> List[Job]:
+    """Parse a Philly-schema CSV into Jobs, mapped onto valid slice sizes.
+
+    ``max_chips`` caps a single gang at one pod (BASELINE.json's v5p-256
+    replay target).  Submission times are shifted so the earliest job
+    submits at t=0.
+    """
+    rows = []
+    with open(path, newline="") as f:
+        for row in csv.DictReader(f):
+            jobid = _get(row, "jobid")
+            raw_time = _get(row, "submitted_time")
+            duration = _get(row, "duration")
+            if jobid is None or raw_time is None or duration is None:
+                continue  # malformed row: trace derivatives contain a few
+            status = _STATUS.get((_get(row, "status") or "pass").lower())
+            if status is None:
+                continue  # unknown status (e.g. still-running at capture)
+            try:
+                parsed_time = _parse_time(raw_time)
+                num_gpus = int(float(_get(row, "num_gpus") or 1))
+                parsed_duration = max(1.0, float(duration))
+            except ValueError:
+                continue  # unparseable values are malformed rows too
+            if num_gpus < 1:
+                num_gpus = 1
+            rows.append(
+                (
+                    jobid,
+                    parsed_time,
+                    num_gpus,
+                    parsed_duration,
+                    status,
+                    _get(row, "vc") or "",
+                )
+            )
+    if not rows:
+        return []
+    origin = min(r[1] for r in rows)
+    # clamp to the largest power of two <= max_chips: a raw min() against a
+    # non-pow2 cap would produce a size no slice shape can realize
+    cap = 1 << (max(1, max_chips).bit_length() - 1)
+    jobs: List[Job] = []
+    for jobid, t, num_gpus, duration, status, vc in rows:
+        chips = min(next_pow2(num_gpus), cap)
+        job = Job(
+            job_id=str(jobid),
+            submit_time=round(t - origin, 3),
+            num_chips=chips,
+            duration=duration,
+            model_name=model_name,
+            status=status,
+            user=vc,
+        )
+        job.sched["philly_num_gpus"] = num_gpus
+        jobs.append(job)
+    jobs.sort(key=lambda j: (j.submit_time, j.job_id))
+    return jobs
+
+
+def save_philly_csv(jobs, path: str | Path) -> None:
+    """Write jobs in the Philly schema (used for checked-in samples)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(PHILLY_FIELDS)
+        for j in jobs:
+            w.writerow(
+                [
+                    j.job_id,
+                    j.status,
+                    j.user,
+                    j.submit_time,
+                    j.sched.get("philly_num_gpus", j.num_chips),
+                    j.duration,
+                ]
+            )
+
+
+def generate_philly_like_trace(
+    num_jobs: int,
+    *,
+    seed: int = 0,
+    arrival_rate: float = 1.0 / 45.0,
+) -> List[Job]:
+    """Synthetic trace with the Philly workload's published shape [P]:
+
+    - gang sizes heavily skewed to 1 GPU with a distributed tail, drawn
+      from the raw (non-pow2) sizes Philly records so the slice-mapping
+      path is exercised;
+    - heavy-tailed durations (lognormal, minutes to days);
+    - ~30% of jobs not Passing (Killed/Failed mix);
+    - bursty arrivals (exponential with daytime burst factor).
+    """
+    rng = random.Random(seed)
+    # (num_gpus, weight): raw Philly-style sizes incl. non-powers of two
+    size_vals, size_weights = zip(*[
+        (1, 0.55), (2, 0.12), (3, 0.03), (4, 0.10), (5, 0.02),
+        (8, 0.10), (12, 0.02), (16, 0.04), (24, 0.01), (32, 0.01),
+    ])
+    status_vals, status_weights = zip(*[("Pass", 0.69), ("Killed", 0.17), ("Failed", 0.14)])
+    jobs: List[Job] = []
+    t = 0.0
+    for i in range(num_jobs):
+        burst = 0.4 if (int(t) // 3600) % 24 < 12 else 1.6  # bursty half-days
+        t += rng.expovariate(arrival_rate) * burst
+        num_gpus = rng.choices(size_vals, size_weights)[0]
+        duration = max(60.0, rng.lognormvariate(7.0, 1.6))  # median ~18min
+        status = rng.choices(status_vals, status_weights)[0]
+        job = Job(
+            job_id=f"phil{i:05d}",
+            submit_time=round(t, 3),
+            num_chips=next_pow2(num_gpus),
+            duration=round(duration, 3),
+            model_name="transformer-small",
+            status=status,
+            user=f"vc{rng.randrange(6)}",
+        )
+        job.sched["philly_num_gpus"] = num_gpus
+        jobs.append(job)
+    return jobs

@@ -1,0 +1,155 @@
+"""Philly trace loader tests: schema parsing, status fidelity, GPU->slice
+mapping, timestamp handling, and BASELINE config #2 (DLAS on the Philly
+trace) end-to-end on the checked-in sample.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from gpuschedule_tpu.cluster import TpuCluster
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.sim import JobState, Simulator
+from gpuschedule_tpu.sim.philly import (
+    generate_philly_like_trace,
+    load_philly_csv,
+    save_philly_csv,
+)
+
+SAMPLE = Path(__file__).resolve().parent.parent / "data" / "philly_sample.csv"
+
+
+def test_loader_parses_schema_and_maps_sizes(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text(
+        "jobid,status,vc,submitted_time,num_gpus,duration\n"
+        "a,Pass,vc1,100.0,3,600\n"
+        "b,Killed,vc2,160.0,5,60\n"
+        "c,Failed,vc1,220.0,24,120\n"
+    )
+    jobs = load_philly_csv(p)
+    by_id = {j.job_id: j for j in jobs}
+    # times shifted to origin 0
+    assert by_id["a"].submit_time == 0.0
+    assert by_id["b"].submit_time == 60.0
+    # raw GPU counts rounded up to valid slice sizes, original retained
+    assert by_id["a"].num_chips == 4 and by_id["a"].sched["philly_num_gpus"] == 3
+    assert by_id["b"].num_chips == 8 and by_id["b"].sched["philly_num_gpus"] == 5
+    assert by_id["c"].num_chips == 32
+    # status fidelity
+    assert by_id["a"].status == "Pass"
+    assert by_id["b"].status == "Killed"
+    assert by_id["c"].status == "Failed"
+
+
+def test_loader_parses_datetime_timestamps(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text(
+        "jobid,status,vc,submitted_time,num_gpus,duration\n"
+        "a,Pass,vc1,2017-10-03 17:15:11,1,60\n"
+        "b,Pass,vc1,2017-10-03 17:16:11,1,60\n"
+    )
+    jobs = load_philly_csv(p)
+    assert jobs[0].submit_time == 0.0
+    assert jobs[1].submit_time == 60.0
+
+
+def test_loader_skips_malformed_and_unknown_rows(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text(
+        "jobid,status,vc,submitted_time,num_gpus,duration\n"
+        "ok,Pass,vc1,0,1,60\n"
+        "running,Running,vc1,10,1,60\n"     # unknown status: skipped
+        "broken,Pass,vc1,,1,\n"             # missing fields: skipped
+    )
+    jobs = load_philly_csv(p)
+    assert [j.job_id for j in jobs] == ["ok"]
+
+
+def test_loader_caps_at_max_chips(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text(
+        "jobid,status,vc,submitted_time,num_gpus,duration\n"
+        "whale,Pass,vc1,0,500,60\n"
+    )
+    (job,) = load_philly_csv(p, max_chips=256)
+    assert job.num_chips == 256  # clamped to one pod
+    # a non-pow2 cap clamps to the largest valid slice size below it
+    (job,) = load_philly_csv(p, max_chips=100)
+    assert job.num_chips == 64
+
+
+def test_loader_skips_unparseable_values(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text(
+        "jobid,status,vc,submitted_time,num_gpus,duration\n"
+        "ok,Pass,vc1,0,1,60\n"
+        "badtime,Pass,vc1,unknown,1,60\n"
+        "baddur,Pass,vc1,10,1,n/a\n"
+        "badgpus,Pass,vc1,10,many,60\n"
+    )
+    jobs = load_philly_csv(p)
+    assert [j.job_id for j in jobs] == ["ok"]
+
+
+def test_datetime_parsing_is_utc_not_host_local(tmp_path, monkeypatch):
+    """Spacing across the 2017 US DST fall-back must stay 60s regardless of
+    the host timezone."""
+    import time as time_mod
+
+    p = tmp_path / "t.csv"
+    p.write_text(
+        "jobid,status,vc,submitted_time,num_gpus,duration\n"
+        "a,Pass,vc1,2017-11-05 08:59:30,1,60\n"   # straddles 2am ET fall-back
+        "b,Pass,vc1,2017-11-05 09:00:30,1,60\n"
+    )
+    monkeypatch.setenv("TZ", "America/New_York")
+    time_mod.tzset()
+    try:
+        jobs = load_philly_csv(p)
+        assert jobs[1].submit_time - jobs[0].submit_time == pytest.approx(60.0)
+    finally:
+        monkeypatch.delenv("TZ")
+        time_mod.tzset()
+
+
+def test_alias_columns(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("job_id,state,user,submit_time,num_gpu,run_time\nx,pass,u,5,2,30\n")
+    (job,) = load_philly_csv(p)
+    assert job.job_id == "x" and job.num_chips == 2 and job.duration == 30.0
+
+
+def test_generator_deterministic_and_roundtrips(tmp_path):
+    t1 = generate_philly_like_trace(100, seed=9)
+    t2 = generate_philly_like_trace(100, seed=9)
+    assert [(j.job_id, j.submit_time, j.num_chips, j.status) for j in t1] == [
+        (j.job_id, j.submit_time, j.num_chips, j.status) for j in t2
+    ]
+    p = tmp_path / "round.csv"
+    save_philly_csv(t1, p)
+    loaded = load_philly_csv(p)
+    # the loader re-bases times to origin 0; relative spacing is preserved
+    base = t1[0].submit_time
+    assert [(j.job_id, round(j.submit_time - base, 3), j.num_chips, j.status) for j in t1] == [
+        (j.job_id, j.submit_time, j.num_chips, j.status) for j in loaded
+    ]
+
+
+def test_config2_dlas_on_philly_sample():
+    """BASELINE config #2: SRTF / Tiresias-LAS on the Philly trace."""
+    assert SAMPLE.exists(), "checked-in sample trace missing"
+    jobs = load_philly_csv(SAMPLE)
+    assert len(jobs) == 300
+    res = Simulator(TpuCluster("v5e"), make_policy("dlas"), jobs).run()
+    assert res.num_finished == 300
+    # status fidelity survives replay
+    states = {}
+    for j in res.jobs:
+        states[j.state.value] = states.get(j.state.value, 0) + 1
+    assert states.get("killed", 0) > 0 and states.get("failed", 0) > 0
+    for j in res.jobs:
+        assert j.executed_work == pytest.approx(j.duration)
+
+    srtf = Simulator(TpuCluster("v5e"), make_policy("srtf"), load_philly_csv(SAMPLE)).run()
+    assert srtf.num_finished == 300
