@@ -25,13 +25,29 @@ ITERS = 20
 TARGET_MFU = 0.30
 
 
+def _first_device(attempts: int = 3, wait_s: float = 30.0):
+    """The axon TPU tunnel claims a chip from a pool at first backend touch;
+    transient UNAVAILABLE errors are worth a couple of retries before
+    giving up on the round's perf signal."""
+    import jax
+
+    for i in range(attempts):
+        try:
+            return jax.devices()[0]
+        except RuntimeError as e:
+            if "UNAVAILABLE" not in str(e) or i == attempts - 1:
+                raise
+            time.sleep(wait_s)
+    raise RuntimeError("unreachable")
+
+
 def main() -> None:
     import jax
 
     from gpuschedule_tpu.cluster.tpu import GENERATIONS
     from gpuschedule_tpu.parallel import ShardedTrainer, make_mesh
 
-    dev = jax.devices()[0]
+    dev = _first_device()
     mesh = make_mesh(dp=1, sp=1, tp=1, devices=[dev])
     trainer = ShardedTrainer(MODEL, mesh, batch_size=BATCH, seq_len=SEQ)
     state = trainer.init(seed=0)

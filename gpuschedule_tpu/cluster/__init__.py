@@ -8,6 +8,7 @@ topology-aware comparison config (BASELINE.json config #5).
 """
 
 from gpuschedule_tpu.cluster.base import Allocation, ClusterBase, SimpleCluster
+from gpuschedule_tpu.cluster.gpu import GpuCluster, GpuPlacement
 from gpuschedule_tpu.cluster.tpu import (
     GENERATIONS,
     SliceGeometry,
@@ -20,6 +21,8 @@ __all__ = [
     "Allocation",
     "ClusterBase",
     "SimpleCluster",
+    "GpuCluster",
+    "GpuPlacement",
     "TpuCluster",
     "SliceGeometry",
     "GENERATIONS",

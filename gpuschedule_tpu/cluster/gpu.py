@@ -1,0 +1,225 @@
+"""GPU cluster model: switch → node → GPU tree with NVLink locality.
+
+This is the reference's cluster shape (SURVEY.md §2 "Cluster model":
+switch/node/GPU hierarchy, NVLink vs PCIe distinction), kept in the TPU
+framework for exactly one purpose: the BASELINE config #5 comparison —
+**NVLink GPU nodes vs contiguous TPU slices** for topology-aware gang
+scheduling.
+
+The modeling contrast with :class:`~gpuschedule_tpu.cluster.tpu.TpuCluster`:
+
+- a GPU gang can always be *scattered* across nodes/switches, but pays for
+  it — the allocation's ``speed_factor`` reflects its locality tier
+  (single node via NVLink = 1.0, single switch = 0.9, cross-switch =
+  0.75), and the engine multiplies job progress by it;
+- a TPU slice is contiguous by construction, so its speed factor is always
+  1.0 — geometry can *reject* an allocation but never degrade one.  That
+  trade (fragmentation blocking vs locality degradation) is what config
+  #5 measures.
+
+Placement schemes (SURVEY.md §2 "Placement schemes") choose WHICH GPUs:
+``consolidated`` (fewest nodes, YARN-ish), ``random``, ``greedy``
+(first-fit scan), ``topology`` (strict NVLink islands: refuse allocations
+that would cross a locality boundary).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from gpuschedule_tpu.cluster.base import Allocation, ClusterBase
+
+NodeId = Tuple[int, int]  # (switch, node)
+
+DEFAULT_LOCALITY_SPEED = {"nvlink": 1.0, "switch": 0.9, "cross": 0.75}
+
+SCHEMES = ("consolidated", "random", "greedy", "topology")
+
+
+@dataclass(frozen=True)
+class GpuPlacement:
+    """Where a gang landed: per-node GPU counts + the locality tier."""
+
+    nodes: Tuple[Tuple[NodeId, int], ...]
+    locality: str           # nvlink | switch | cross
+    speed_factor: float     # engine multiplies job progress by this
+
+
+class GpuCluster(ClusterBase):
+    """Switch → node → GPU tree with per-scheme placement."""
+
+    def __init__(
+        self,
+        *,
+        num_switches: int = 2,
+        nodes_per_switch: int = 4,
+        gpus_per_node: int = 8,
+        scheme: str = "consolidated",
+        locality_speed: Optional[Dict[str, float]] = None,
+        seed: int = 0,
+    ):
+        if scheme not in SCHEMES:
+            raise ValueError(f"unknown scheme {scheme!r}; known: {SCHEMES}")
+        self.num_switches = num_switches
+        self.nodes_per_switch = nodes_per_switch
+        self.gpus_per_node = gpus_per_node
+        self.scheme = scheme
+        self.locality_speed = dict(locality_speed or DEFAULT_LOCALITY_SPEED)
+        self.total_chips = num_switches * nodes_per_switch * gpus_per_node
+        self._free: Dict[NodeId, int] = {
+            (s, n): gpus_per_node
+            for s in range(num_switches)
+            for n in range(nodes_per_switch)
+        }
+        self._used = 0
+        self._ids = itertools.count()
+        self._live: Dict[int, GpuPlacement] = {}
+        self._rng = random.Random(seed)
+        self.fragmentation_failures = 0  # topology-strict refusals
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def used_chips(self) -> int:
+        return self._used
+
+    def is_satisfiable(self, num_chips: int) -> bool:
+        if num_chips <= 0:
+            return False
+        if self.scheme == "topology":
+            # strict locality never crosses a switch: a gang larger than one
+            # switch can NEVER be placed and must be rejected at admission
+            return num_chips <= self.nodes_per_switch * self.gpus_per_node
+        return num_chips <= self.total_chips
+
+    def allocate(self, num_chips: int, *, job=None, hint: Optional[dict] = None):
+        if num_chips <= 0 or num_chips > self.free_chips:
+            return None
+        scheme = (hint or {}).get("scheme", self.scheme)
+        sel = self._select(num_chips, scheme)
+        if sel is None:
+            if num_chips <= self.free_chips:
+                self.fragmentation_failures += 1
+            return None
+        for node, count in sel:
+            self._free[node] -= count
+        placement = self._placement(sel)
+        alloc = Allocation(next(self._ids), num_chips, detail=placement)
+        self._live[alloc.alloc_id] = placement
+        self._used += num_chips
+        return alloc
+
+    def free(self, allocation: Optional[Allocation]) -> None:
+        if allocation is None:
+            return
+        placement = self._live.pop(allocation.alloc_id, None)
+        if placement is None:
+            raise ValueError(f"double free of allocation {allocation.alloc_id}")
+        for node, count in placement.nodes:
+            self._free[node] += count
+        self._used -= allocation.num_chips
+
+    # ------------------------------------------------------------------ #
+    # scheme implementations
+
+    def _placement(self, sel: List[Tuple[NodeId, int]]) -> GpuPlacement:
+        switches = {node[0] for node, _ in sel}
+        if len(sel) == 1:
+            locality = "nvlink"
+        elif len(switches) == 1:
+            locality = "switch"
+        else:
+            locality = "cross"
+        return GpuPlacement(
+            nodes=tuple(sorted(sel)),
+            locality=locality,
+            speed_factor=self.locality_speed[locality],
+        )
+
+    def _select(self, n: int, scheme: str) -> Optional[List[Tuple[NodeId, int]]]:
+        if scheme == "consolidated":
+            return self._select_consolidated(n)
+        if scheme == "random":
+            return self._select_random(n)
+        if scheme == "greedy":
+            return self._select_greedy(n)
+        if scheme == "topology":
+            return self._select_topology(n)
+        raise ValueError(f"unknown scheme {scheme!r}")
+
+    def _select_consolidated(self, n: int) -> Optional[List[Tuple[NodeId, int]]]:
+        """Fewest nodes: best-fit a single node, else fill fullest-first."""
+        fits = [(f, node) for node, f in self._free.items() if f >= n]
+        if fits:
+            f, node = min(fits)  # tightest fit limits future fragmentation
+            return [(node, n)]
+        sel, need = [], n
+        # fullest nodes first -> minimal node count; switch-major grouping
+        for node, f in sorted(self._free.items(), key=lambda kv: (-kv[1], kv[0])):
+            if f <= 0:
+                continue
+            take = min(f, need)
+            sel.append((node, take))
+            need -= take
+            if need == 0:
+                return sel
+        return None
+
+    def _select_random(self, n: int) -> Optional[List[Tuple[NodeId, int]]]:
+        nodes = [node for node, f in self._free.items() if f > 0]
+        self._rng.shuffle(nodes)
+        sel, need = [], n
+        for node in nodes:
+            take = min(self._free[node], need)
+            sel.append((node, take))
+            need -= take
+            if need == 0:
+                return sel
+        return None
+
+    def _select_greedy(self, n: int) -> Optional[List[Tuple[NodeId, int]]]:
+        sel, need = [], n
+        for node in sorted(self._free):  # first-fit scan in tree order
+            f = self._free[node]
+            if f <= 0:
+                continue
+            take = min(f, need)
+            sel.append((node, take))
+            need -= take
+            if need == 0:
+                return sel
+        return None
+
+    def _select_topology(self, n: int) -> Optional[List[Tuple[NodeId, int]]]:
+        """Strict NVLink islands: a gang that fits one node must get one
+        node; a bigger gang must stay on one switch; else refuse."""
+        if n <= self.gpus_per_node:
+            fits = [(f, node) for node, f in self._free.items() if f >= n]
+            if not fits:
+                return None
+            f, node = min(fits)
+            return [(node, n)]
+        for s in range(self.num_switches):
+            nodes = [
+                ((s, i), self._free[(s, i)])
+                for i in range(self.nodes_per_switch)
+                if self._free[(s, i)] > 0
+            ]
+            if sum(f for _, f in nodes) >= n:
+                sel, need = [], n
+                for node, f in sorted(nodes, key=lambda kv: (-kv[1], kv[0])):
+                    take = min(f, need)
+                    sel.append((node, take))
+                    need -= take
+                    if need == 0:
+                        return sel
+        return None
+
+    def __repr__(self) -> str:
+        return (
+            f"GpuCluster({self.num_switches}sw x {self.nodes_per_switch}n x "
+            f"{self.gpus_per_node}g, scheme={self.scheme}, used={self._used}/{self.total_chips})"
+        )

@@ -1,0 +1,65 @@
+"""Scheme wiring for both cluster flavors."""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional, Tuple
+
+from gpuschedule_tpu.cluster.gpu import SCHEMES as GPU_SCHEMES
+from gpuschedule_tpu.cluster.gpu import GpuCluster
+from gpuschedule_tpu.cluster.tpu import TpuCluster
+
+TPU_SCHEMES = ("consolidated", "random", "spread")
+
+Origin = Tuple[int, ...]
+
+
+class PlacedTpuCluster:
+    """TpuCluster wrapper that injects an origin-order hint per scheme.
+
+    Delegates everything else to the wrapped cluster, so it satisfies the
+    ClusterBase surface (and OverlayMixin's) by forwarding.  Policy-supplied
+    hints (overlay, shape, pod) always win over the scheme's origin order.
+    """
+
+    def __init__(self, cluster: TpuCluster, scheme: str = "consolidated", seed: int = 0):
+        if scheme not in TPU_SCHEMES:
+            raise ValueError(f"unknown TPU scheme {scheme!r}; known: {TPU_SCHEMES}")
+        self.inner = cluster
+        self.scheme = scheme
+        self._rng = random.Random(seed)
+
+    def _origin_order(self, origins: List[Origin]) -> List[Origin]:
+        if self.scheme == "random":
+            picked = list(origins)
+            self._rng.shuffle(picked)
+            return picked
+        if self.scheme == "spread":
+            return sorted(origins, reverse=True)  # far corner first
+        return origins  # consolidated: allocator's lexicographic first-fit
+
+    def allocate(self, num_chips: int, *, job=None, hint: Optional[dict] = None):
+        merged = {} if self.scheme == "consolidated" else {"origin_order": self._origin_order}
+        if hint:
+            merged.update(hint)  # policy hints (overlay etc.) take precedence
+        return self.inner.allocate(num_chips, job=job, hint=merged or None)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def __repr__(self) -> str:
+        return f"PlacedTpuCluster({self.scheme}, {self.inner!r})"
+
+
+def with_placement(cluster, scheme: str, *, seed: int = 0):
+    """Attach a placement scheme to a cluster (flavor-appropriate)."""
+    if isinstance(cluster, GpuCluster):
+        if scheme not in GPU_SCHEMES:
+            raise ValueError(f"unknown GPU scheme {scheme!r}; known: {GPU_SCHEMES}")
+        cluster.scheme = scheme
+        return cluster
+    if isinstance(cluster, TpuCluster):
+        if scheme == "consolidated":
+            return cluster  # the allocator default; no wrapper needed
+        return PlacedTpuCluster(cluster, scheme, seed=seed)
+    raise TypeError(f"no placement schemes for cluster type {type(cluster).__name__}")

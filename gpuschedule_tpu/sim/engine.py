@@ -94,6 +94,13 @@ class Simulator:
         for job in self.running:
             job.advance(t)
 
+    @staticmethod
+    def _bind_allocation(job: Job, alloc) -> None:
+        """Attach a granted allocation to a job, deriving every allocation-
+        dependent field (single site: placement quality feeds progress)."""
+        job.allocation = alloc
+        job.locality_factor = getattr(alloc.detail, "speed_factor", 1.0)
+
     # ------------------------------------------------------------------ #
     # policy-facing mutation API
 
@@ -120,7 +127,7 @@ class Simulator:
         if alloc is None:
             return False
         job.advance(self.now)
-        job.allocation = alloc
+        self._bind_allocation(job, alloc)
         job.allocated_chips = chips
         job.state = JobState.RUNNING
         job.speed = speed
@@ -145,6 +152,7 @@ class Simulator:
         job.allocation = None
         job.allocated_chips = 0
         job.speed = 0.0
+        job.locality_factor = 1.0
         job.epoch += 1
         job.preempt_count += 1
         job.state = JobState.SUSPENDED if suspend else JobState.PENDING
@@ -182,9 +190,14 @@ class Simulator:
             alloc = self.cluster.allocate(chips, job=job)
             if alloc is None:
                 raise RuntimeError(f"allocation vanished during migration of {job!r}")
-            job.allocation = alloc
+            # "in place" may still land differently (e.g. a better GPU
+            # locality tier): re-derive the factor and re-predict completion,
+            # or the stale event computed at the old rate stands
+            self._bind_allocation(job, alloc)
+            job.epoch += 1
+            self._schedule_completion(job)
             return False
-        job.allocation = alloc
+        self._bind_allocation(job, alloc)
         if old_detail is not None and alloc.detail == old_detail:
             return False  # same slice re-granted: no movement, no cost
         job.overhead_remaining += overhead
@@ -210,11 +223,11 @@ class Simulator:
             alloc = self.cluster.allocate(job.allocated_chips, job=job)
             if alloc is None:
                 raise RuntimeError(f"allocation vanished during resize of {job!r}")
-            job.allocation = alloc
+            self._bind_allocation(job, alloc)
             job.epoch += 1
             self._schedule_completion(job)
             return False
-        job.allocation = alloc
+        self._bind_allocation(job, alloc)
         job.allocated_chips = chips
         job.speed = speed
         job.overhead_remaining += overhead

@@ -72,7 +72,12 @@ class Job:
     state: JobState = JobState.PENDING
     executed_work: float = 0.0          # reference-speed seconds of work done
     attained_service: float = 0.0       # chip-seconds of service received
-    speed: float = 0.0                  # current progress rate (0 unless RUNNING)
+    speed: float = 0.0                  # policy-set progress rate (0 unless RUNNING)
+    locality_factor: float = 1.0        # allocation-quality multiplier set by the
+                                        # engine from the granted placement: 1.0 on
+                                        # TPU slices (contiguous by construction),
+                                        # <1.0 for scattered GPU gangs (NVLink vs
+                                        # PCIe vs cross-switch, cluster/gpu.py)
     overhead_remaining: float = 0.0     # modeled restart cost still to burn (s)
     allocation: Optional[Any] = None    # cluster allocation handle when RUNNING
     allocated_chips: int = 0            # chips currently held (elastic != num_chips)
@@ -105,11 +110,16 @@ class Job:
         """Terminal state declared by the trace for when this job completes."""
         return STATUS_TO_END_STATE.get(self.status, JobState.DONE)
 
+    @property
+    def effective_speed(self) -> float:
+        """Actual progress rate: policy speed degraded by placement quality."""
+        return self.speed * self.locality_factor
+
     def remaining_runtime(self) -> float:
         """Wall-clock seconds to completion at the current speed (inf if idle)."""
-        if self.speed <= 0.0:
+        if self.effective_speed <= 0.0:
             return float("inf")
-        return self.overhead_remaining + self.remaining_work / self.speed
+        return self.overhead_remaining + self.remaining_work / self.effective_speed
 
     def advance(self, now: float) -> None:
         """Integrate progress from ``last_update_time`` to ``now``.
@@ -131,7 +141,7 @@ class Job:
             self.overhead_remaining -= burned
             dt -= burned
         if dt > 0.0:
-            self.executed_work += self.speed * dt
+            self.executed_work += self.effective_speed * dt
             self.attained_service += self.allocated_chips * dt
 
     def jct(self) -> Optional[float]:

@@ -1,0 +1,261 @@
+"""Placement tests: GpuCluster scheme selection + locality tiers, the
+engine's locality speed integration, TPU origin-order schemes, and the
+config #5 contrast (NVLink degradation vs slice rejection).
+"""
+
+import pytest
+
+from gpuschedule_tpu.cluster import GpuCluster, TpuCluster
+from gpuschedule_tpu.placement import with_placement
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.sim import Job, JobState, Simulator
+from gpuschedule_tpu.sim.trace import generate_poisson_trace
+
+
+# --------------------------------------------------------------------- #
+# GpuCluster selection
+
+
+def test_consolidated_prefers_single_node_best_fit():
+    c = GpuCluster(num_switches=2, nodes_per_switch=2, gpus_per_node=8)
+    a = c.allocate(4)
+    assert a.detail.locality == "nvlink"
+    assert len(a.detail.nodes) == 1
+    # next 4-gang best-fits into the half-full node, not a fresh one
+    b = c.allocate(4)
+    assert b.detail.nodes[0][0] == a.detail.nodes[0][0]
+
+
+def test_consolidated_spills_with_fewest_nodes():
+    c = GpuCluster(num_switches=2, nodes_per_switch=2, gpus_per_node=8)
+    a = c.allocate(12)  # must span two nodes
+    assert len(a.detail.nodes) == 2
+    assert a.detail.locality in ("switch", "cross")
+
+
+def test_locality_tiers_and_speed_factors():
+    c = GpuCluster(num_switches=2, nodes_per_switch=2, gpus_per_node=8)
+    one_node = c.allocate(8)
+    assert one_node.detail.locality == "nvlink"
+    assert one_node.detail.speed_factor == 1.0
+    same_switch = c.allocate(16, hint={"scheme": "consolidated"})
+    # 16 GPUs = 2 full nodes; consolidated fills fullest-first, same switch
+    assert same_switch.detail.locality in ("switch", "cross")
+    assert same_switch.detail.speed_factor < 1.0
+
+
+def test_topology_scheme_refuses_cross_island():
+    c = GpuCluster(num_switches=2, nodes_per_switch=2, gpus_per_node=8, scheme="topology")
+    # Fragment: a 5-GPU gang in every node leaves 3 free each -> 12 free
+    # chips in total but no node with 8
+    frags = [c.allocate(5) for _ in range(4)]
+    assert all(f is not None for f in frags)
+    before = c.fragmentation_failures
+    assert c.allocate(8) is None  # 12 free chips but no NVLink island
+    assert c.fragmentation_failures == before + 1
+    for f in frags:
+        c.free(f)
+    a = c.allocate(8)
+    assert a is not None and a.detail.locality == "nvlink"
+
+
+def test_topology_scheme_multi_node_stays_on_one_switch():
+    c = GpuCluster(num_switches=2, nodes_per_switch=4, gpus_per_node=8, scheme="topology")
+    a = c.allocate(24)
+    switches = {node[0] for node, _ in a.detail.nodes}
+    assert len(switches) == 1
+    assert a.detail.locality == "switch"
+
+
+def test_random_scheme_deterministic_per_seed():
+    def run(seed):
+        c = GpuCluster(num_switches=2, nodes_per_switch=4, gpus_per_node=8,
+                       scheme="random", seed=seed)
+        return [c.allocate(4).detail.nodes for _ in range(6)]
+
+    assert run(1) == run(1)
+    assert run(1) != run(2)
+
+
+def test_gpu_alloc_free_conservation():
+    c = GpuCluster(num_switches=2, nodes_per_switch=4, gpus_per_node=8)
+    allocs = [c.allocate(n) for n in (1, 2, 3, 5, 8, 13, 16)]
+    assert c.used_chips == sum(a.num_chips for a in allocs if a)
+    for a in allocs:
+        c.free(a)
+    assert c.used_chips == 0
+    with pytest.raises(ValueError):
+        c.free(allocs[0])
+
+
+# --------------------------------------------------------------------- #
+# engine locality integration
+
+
+def test_scattered_gang_runs_slower():
+    """Config #5 mechanism: a cross-node GPU gang pays in wall-clock."""
+    c = GpuCluster(num_switches=2, nodes_per_switch=2, gpus_per_node=8)
+    job = Job("scatter", 0.0, num_chips=12, duration=100.0)
+    res = Simulator(c, make_policy("fifo"), [job]).run()
+    (j,) = res.jobs
+    assert j.state is JobState.DONE
+    assert j.executed_work == pytest.approx(100.0)
+    # 12 GPUs span nodes -> 0.9 factor -> 100/0.9 wall seconds
+    assert j.end_time == pytest.approx(100.0 / 0.9)
+
+
+def test_nvlink_gang_runs_at_full_speed():
+    c = GpuCluster(num_switches=2, nodes_per_switch=2, gpus_per_node=8)
+    job = Job("local", 0.0, num_chips=8, duration=100.0)
+    res = Simulator(c, make_policy("fifo"), [job]).run()
+    assert res.jobs[0].end_time == pytest.approx(100.0)
+
+
+def test_tpu_slice_never_degrades():
+    job = Job("slice", 0.0, num_chips=8, duration=100.0)
+    res = Simulator(TpuCluster("v5e"), make_policy("fifo"), [job]).run()
+    assert res.jobs[0].end_time == pytest.approx(100.0)
+    assert res.jobs[0].locality_factor == 1.0
+
+
+# --------------------------------------------------------------------- #
+# TPU origin-order schemes
+
+
+def test_tpu_spread_scheme_places_far_corner():
+    c = with_placement(TpuCluster("v5e"), "spread")
+    a = c.allocate(4)
+    # far corner, not origin
+    assert a.detail.origin != (0, 0)
+    assert all(o + s == d for o, s, d in zip(a.detail.origin, a.detail.shape, (16, 16)))
+
+
+def test_tpu_random_scheme_deterministic():
+    def run(seed):
+        c = with_placement(TpuCluster("v5e"), "random", seed=seed)
+        return [c.allocate(4).detail.origin for _ in range(5)]
+
+    assert run(3) == run(3)
+    assert run(3) != run(4)
+
+
+def test_tpu_consolidated_passthrough():
+    c = with_placement(TpuCluster("v5e"), "consolidated")
+    assert isinstance(c, TpuCluster)  # no wrapper needed
+    assert c.allocate(4).detail.origin == (0, 0)
+
+
+def test_placed_cluster_delegates_everything():
+    c = with_placement(TpuCluster("v5e"), "spread")
+    assert c.total_chips == 256
+    assert c.is_satisfiable(64) and not c.is_satisfiable(3)
+    a = c.allocate(8)
+    over = c.allocate(8, hint={"overlay": a})  # policy hint wins through wrapper
+    assert over is not None
+    c.free(over)
+    c.free(a)
+    assert c.used_chips == 0
+
+
+def test_topology_scheme_rejects_gangs_larger_than_a_switch():
+    """Reviewer repro: a 48-gang on a 2x(4x8) topology cluster can never be
+    placed (one switch holds 32) — admission must reject it, not let it
+    head-of-line block forever."""
+    c = GpuCluster(num_switches=2, nodes_per_switch=4, gpus_per_node=8, scheme="topology")
+    assert not c.is_satisfiable(48)
+    assert c.is_satisfiable(32)
+    jobs = [
+        Job("whale", 0.0, num_chips=48, duration=10.0),
+        Job("ok", 1.0, num_chips=8, duration=10.0),
+    ]
+    res = Simulator(c, make_policy("fifo"), jobs).run()
+    by_id = {j.job_id: j for j in res.jobs}
+    assert by_id["whale"].state is JobState.REJECTED
+    assert by_id["ok"].state is JobState.DONE
+
+
+def test_migrate_restore_repredicts_completion_at_new_locality():
+    """Reviewer repro: a failed hinted migrate whose in-place restore lands
+    on a BETTER locality tier must reschedule the completion event, or the
+    job finishes at the stale (slower) prediction."""
+    import itertools
+
+    from gpuschedule_tpu.cluster.base import Allocation, ClusterBase
+    from gpuschedule_tpu.policies.base import Policy
+
+    class TierDetail:
+        def __init__(self, speed_factor):
+            self.speed_factor = speed_factor
+
+    class StubCluster(ClusterBase):
+        """First grant is cross-tier (0.75); re-grants are nvlink (1.0)."""
+
+        def __init__(self):
+            self.total_chips = 8
+            self._used = 0
+            self._ids = itertools.count()
+            self._grants = 0
+
+        @property
+        def used_chips(self):
+            return self._used
+
+        def allocate(self, num_chips, *, job=None, hint=None):
+            if hint and hint.get("refuse"):
+                return None
+            if num_chips > self.free_chips:
+                return None
+            self._grants += 1
+            factor = 0.75 if self._grants == 1 else 1.0
+            self._used += num_chips
+            return Allocation(next(self._ids), num_chips, detail=TierDetail(factor))
+
+        def free(self, allocation):
+            if allocation is not None:
+                self._used -= allocation.num_chips
+
+    class MigrateOnce(Policy):
+        def __init__(self):
+            self.done = False
+
+        def schedule(self, sim):
+            for job in list(sim.pending):
+                sim.try_start(job)
+            if not self.done and sim.running:
+                self.done = True
+                assert sim.migrate(sim.running[0], overhead=0.0,
+                                   placement_hint={"refuse": True}) is False
+            return None
+
+    job = Job("j", 0.0, num_chips=8, duration=90.0)
+    res = Simulator(StubCluster(), MigrateOnce(), [job]).run()
+    (j,) = res.jobs
+    # restored allocation runs at 1.0, so the job must finish at t=90 —
+    # not at the stale 0.75-rate prediction of t=120
+    assert j.end_time == pytest.approx(90.0)
+    assert j.executed_work == pytest.approx(90.0)
+
+
+# --------------------------------------------------------------------- #
+# config #5 shape: same workload, GPU schemes vs TPU slices
+
+
+def test_config5_topology_comparison_runs():
+    trace_args = dict(num_jobs=80, seed=51)
+
+    def jobs():
+        return generate_poisson_trace(**trace_args)
+
+    results = {}
+    for name, cluster in [
+        ("gpu-consolidated", GpuCluster(num_switches=4, nodes_per_switch=4,
+                                        gpus_per_node=8, scheme="consolidated")),
+        ("gpu-random", GpuCluster(num_switches=4, nodes_per_switch=4,
+                                  gpus_per_node=8, scheme="random")),
+        ("tpu-v5p", TpuCluster("v5p", dims=(8, 4, 4))),
+    ]:
+        res = Simulator(cluster, make_policy("fifo"), jobs()).run()
+        assert res.num_finished == 80, name
+        results[name] = res.avg_jct
+    # random scattering degrades locality -> no better than consolidated
+    assert results["gpu-random"] >= results["gpu-consolidated"] * 0.999
