@@ -1,0 +1,73 @@
+"""Result analysis: JCT CDFs, policy/topology comparison reports.
+
+The reference ships Jupyter notebooks that run experiment grids and plot
+JCT CDFs / makespan bars (SURVEY.md §2 "Notebooks", §3.4).  This module is
+the library form of those notebooks — pure functions over SimResults that
+the CLI's ``compare`` / ``report`` commands and any notebook can call;
+outputs are plain dict/CSV so pandas/matplotlib consumption is one line.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from gpuschedule_tpu.sim.metrics import SimResult
+
+
+def jct_cdf(result: SimResult, *, points: int = 100) -> List[Tuple[float, float]]:
+    """(jct_seconds, cumulative_fraction) pairs — the notebook headline plot."""
+    jcts = sorted(j.jct() for j in result.jobs if j.jct() is not None and j.state.value != "rejected")
+    if not jcts:
+        return []
+    n = len(jcts)
+    step = max(1, n // points)
+    out = [(jcts[i], (i + 1) / n) for i in range(0, n, step)]
+    # ensure the curve reaches 1.0 even when the max JCT value is tied with
+    # the last sampled point (comparing values instead of fractions here
+    # used to leave the CDF topping out below 1)
+    if out[-1][1] != 1.0:
+        if out[-1][0] == jcts[-1]:
+            out[-1] = (jcts[-1], 1.0)
+        else:
+            out.append((jcts[-1], 1.0))
+    return out
+
+
+def summarize(results: Dict[str, SimResult]) -> Dict[str, dict]:
+    """name -> headline metrics, for grid experiments."""
+    return {name: res.summary() for name, res in results.items()}
+
+
+def write_report(
+    results: Dict[str, SimResult],
+    out_dir: str | Path,
+    *,
+    prefix: str = "",
+) -> None:
+    """Persist a comparison: summary JSON + per-config JCT CDF CSVs +
+    a markdown table (the notebook's bar-chart data in text form)."""
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    summary = summarize(results)
+    with open(out / f"{prefix}summary.json", "w") as f:
+        json.dump(summary, f, indent=2, sort_keys=True)
+    for name, res in results.items():
+        with open(out / f"{prefix}cdf_{name}.csv", "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["jct_seconds", "cum_fraction"])
+            w.writerows(jct_cdf(res))
+    lines = [
+        "| config | avg JCT (s) | makespan (s) | p95 queue (s) | util | finished | rejected |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for name in sorted(results):
+        s = summary[name]
+        lines.append(
+            f"| {name} | {s['avg_jct']:.1f} | {s['makespan']:.1f} | "
+            f"{s['p95_queueing_delay']:.1f} | {s['mean_utilization']:.3f} | "
+            f"{int(s['num_finished'])} | {int(s.get('num_rejected', 0))} |"
+        )
+    (out / f"{prefix}report.md").write_text("\n".join(lines) + "\n")

@@ -1,0 +1,258 @@
+"""Command-line entry points.
+
+The reference is driven by a flags-parsing ``run_sim`` script (SURVEY.md §2
+"Sim entry / main loop", §3.1: ``run_sim --schedule=dlas --trace_file=...
+--cluster_spec=...``).  This is the equivalent surface:
+
+    python -m gpuschedule_tpu.cli run --policy dlas --cluster tpu-v5e \\
+        --philly data/philly_sample.csv --out results/
+
+    python -m gpuschedule_tpu.cli run --policy fifo --cluster simple \\
+        --chips 64 --synthetic 200 --seed 42 --out results/   # config #1
+
+    python -m gpuschedule_tpu.cli gen-trace --num-jobs 500 --philly-like \\
+        --out trace.csv
+
+    python -m gpuschedule_tpu.cli compare-topology --philly data/... \\
+        --out results/topo/                                   # config #5
+
+    python -m gpuschedule_tpu.cli profile --model transformer-tiny \\
+        --curves curves.json                                  # fit goodput
+
+Each ``run`` prints the summary as one JSON line on stdout and writes the
+per-job/utilization CSVs (MetricsLog.write) when ``--out`` is given.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from gpuschedule_tpu.cluster import GpuCluster, SimpleCluster, TpuCluster
+from gpuschedule_tpu.placement import with_placement
+from gpuschedule_tpu.policies import available, make_policy
+from gpuschedule_tpu.sim import Simulator
+from gpuschedule_tpu.sim.philly import generate_philly_like_trace, load_philly_csv, save_philly_csv
+from gpuschedule_tpu.sim.trace import generate_poisson_trace, load_trace_csv, save_trace_csv
+
+
+def _parse_dims(raw: str) -> tuple:
+    return tuple(int(x) for x in raw.lower().split("x"))
+
+
+def build_cluster(args) -> object:
+    if args.cluster == "simple":
+        cluster = SimpleCluster(args.chips)
+    elif args.cluster in ("tpu-v5e", "tpu-v5p"):
+        gen = args.cluster.split("-")[1]
+        dims = _parse_dims(args.dims) if args.dims else None
+        cluster = TpuCluster(gen, dims=dims, num_pods=args.pods)
+    elif args.cluster == "gpu":
+        sw, npsw, gpn = _parse_dims(args.gpu_shape)
+        cluster = GpuCluster(
+            num_switches=sw, nodes_per_switch=npsw, gpus_per_node=gpn,
+            seed=args.placement_seed,
+        )
+    else:
+        raise SystemExit(f"unknown cluster {args.cluster!r}")
+    if args.placement != "consolidated" and not isinstance(cluster, SimpleCluster):
+        # with_placement validates per flavor — an unknown/mismatched scheme
+        # must error, not silently run a different experiment than requested
+        try:
+            cluster = with_placement(cluster, args.placement, seed=args.placement_seed)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
+    return cluster
+
+
+def load_jobs(args) -> List:
+    if args.philly:
+        return load_philly_csv(args.philly, max_chips=args.max_job_chips)
+    if args.trace:
+        return load_trace_csv(args.trace)
+    if args.synthetic:
+        return generate_poisson_trace(
+            args.synthetic,
+            seed=args.seed,
+            arrival_rate=args.arrival_rate,
+            mean_duration=args.mean_duration,
+            failure_rate=args.failure_rate,
+            util_range=(args.util_min, 1.0),
+        )
+    raise SystemExit("provide one of --philly / --trace / --synthetic N")
+
+
+def build_policy(args):
+    kwargs = {}
+    for kv in args.policy_arg or []:
+        k, _, v = kv.partition("=")
+        try:
+            parsed = json.loads(v)
+        except json.JSONDecodeError:
+            parsed = v
+        kwargs[k.replace("-", "_")] = parsed
+    if args.policy == "optimus" and args.curves:
+        from gpuschedule_tpu.profiler import CurveCache
+
+        kwargs.setdefault("curve_cache", CurveCache(args.curves))
+        if args.online:
+            kwargs.setdefault("online", True)
+    return make_policy(args.policy, **kwargs)
+
+
+def cmd_run(args) -> int:
+    cluster = build_cluster(args)
+    jobs = load_jobs(args)
+    sim = Simulator(
+        cluster, build_policy(args), jobs, max_time=args.max_time or float("inf")
+    )
+    res = sim.run()
+    print(json.dumps(res.summary(), sort_keys=True))
+    if args.out:
+        sim.metrics.write(args.out, prefix=args.prefix)
+    return 0
+
+
+def cmd_gen_trace(args) -> int:
+    if args.philly_like:
+        jobs = generate_philly_like_trace(args.num_jobs, seed=args.seed)
+        save_philly_csv(jobs, args.out)
+    else:
+        jobs = generate_poisson_trace(
+            args.num_jobs,
+            seed=args.seed,
+            arrival_rate=args.arrival_rate,
+            mean_duration=args.mean_duration,
+            failure_rate=args.failure_rate,
+            util_range=(args.util_min, 1.0),
+        )
+        save_trace_csv(jobs, args.out)
+    print(f"wrote {len(jobs)} jobs to {args.out}")
+    return 0
+
+
+def cmd_compare_topology(args) -> int:
+    """BASELINE config #5: NVLink GPU nodes vs contiguous TPU slices."""
+    from gpuschedule_tpu.analysis import write_report
+
+    def jobs():
+        if args.philly:
+            return load_philly_csv(args.philly)
+        return generate_poisson_trace(args.synthetic or 200, seed=args.seed)
+
+    gpu_shape = _parse_dims(args.gpu_shape)
+    configs = {
+        "gpu-consolidated": GpuCluster(
+            num_switches=gpu_shape[0], nodes_per_switch=gpu_shape[1],
+            gpus_per_node=gpu_shape[2], scheme="consolidated"),
+        "gpu-random": GpuCluster(
+            num_switches=gpu_shape[0], nodes_per_switch=gpu_shape[1],
+            gpus_per_node=gpu_shape[2], scheme="random"),
+        "gpu-topology": GpuCluster(
+            num_switches=gpu_shape[0], nodes_per_switch=gpu_shape[1],
+            gpus_per_node=gpu_shape[2], scheme="topology"),
+        "tpu-v5p": TpuCluster("v5p"),
+        "tpu-v5e": TpuCluster("v5e"),
+    }
+    results = {}
+    for name, cluster in configs.items():
+        results[name] = Simulator(cluster, make_policy(args.policy), jobs()).run()
+    print(json.dumps({k: v.summary() for k, v in results.items()}, sort_keys=True))
+    if args.out:
+        write_report(results, args.out)
+    return 0
+
+
+def cmd_profile(args) -> int:
+    from gpuschedule_tpu.profiler import CurveCache
+    from gpuschedule_tpu.profiler.harness import profile_model
+
+    cache = CurveCache(args.curves)
+    for model in args.model:
+        curve = profile_model(
+            model,
+            ks=tuple(int(k) for k in args.ks.split(",")),
+            generation=args.generation,
+            batch_size=args.batch_size,
+            seq_len=args.seq_len,
+            cache=cache,
+        )
+        print(json.dumps({"model": model, "theta": list(curve.theta)}))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="gpuschedule_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="replay a trace under a policy")
+    run.add_argument("--policy", choices=available(), default="fifo")
+    run.add_argument("--policy-arg", action="append", metavar="K=V",
+                     help="policy constructor kwarg (JSON values)")
+    run.add_argument("--cluster", default="tpu-v5e",
+                     choices=("simple", "tpu-v5e", "tpu-v5p", "gpu"))
+    run.add_argument("--chips", type=int, default=64, help="simple cluster size")
+    run.add_argument("--dims", help="TPU pod dims, e.g. 16x16 / 8x8x4")
+    run.add_argument("--pods", type=int, default=1)
+    run.add_argument("--gpu-shape", default="2x4x8",
+                     help="switches x nodes x gpus for --cluster gpu")
+    run.add_argument("--placement", default="consolidated",
+                     help="consolidated|random|greedy|topology (gpu) / "
+                          "consolidated|random|spread (tpu)")
+    run.add_argument("--placement-seed", type=int, default=0)
+    run.add_argument("--philly", help="Philly-schema trace CSV")
+    run.add_argument("--trace", help="native-schema trace CSV")
+    run.add_argument("--synthetic", type=int, metavar="N",
+                     help="generate N-job Poisson trace")
+    run.add_argument("--seed", type=int, default=0)
+    run.add_argument("--arrival-rate", type=float, default=1.0 / 60.0)
+    run.add_argument("--mean-duration", type=float, default=3600.0)
+    run.add_argument("--failure-rate", type=float, default=0.0)
+    run.add_argument("--util-min", type=float, default=1.0)
+    run.add_argument("--max-job-chips", type=int, default=256)
+    run.add_argument("--max-time", type=float)
+    run.add_argument("--curves", help="goodput curve cache (optimus)")
+    run.add_argument("--online", action="store_true",
+                     help="profile unseen models live (optimus)")
+    run.add_argument("--out", help="directory for jobs/utilization CSVs")
+    run.add_argument("--prefix", default="")
+    run.set_defaults(fn=cmd_run)
+
+    gen = sub.add_parser("gen-trace", help="write a synthetic trace CSV")
+    gen.add_argument("--num-jobs", type=int, required=True)
+    gen.add_argument("--seed", type=int, default=0)
+    gen.add_argument("--philly-like", action="store_true")
+    gen.add_argument("--arrival-rate", type=float, default=1.0 / 60.0)
+    gen.add_argument("--mean-duration", type=float, default=3600.0)
+    gen.add_argument("--failure-rate", type=float, default=0.0)
+    gen.add_argument("--util-min", type=float, default=1.0)
+    gen.add_argument("--out", required=True)
+    gen.set_defaults(fn=cmd_gen_trace)
+
+    cmp_ = sub.add_parser("compare-topology",
+                          help="config #5: GPU placement schemes vs TPU slices")
+    cmp_.add_argument("--policy", choices=available(), default="fifo")
+    cmp_.add_argument("--philly")
+    cmp_.add_argument("--synthetic", type=int)
+    cmp_.add_argument("--seed", type=int, default=0)
+    cmp_.add_argument("--gpu-shape", default="4x8x8")
+    cmp_.add_argument("--out")
+    cmp_.set_defaults(fn=cmd_compare_topology)
+
+    prof = sub.add_parser("profile", help="fit goodput curves on live devices")
+    prof.add_argument("--model", action="append", required=True)
+    prof.add_argument("--ks", default="1,2,4,8,16,32,64")
+    prof.add_argument("--generation", default="v5e")
+    prof.add_argument("--batch-size", type=int, default=8)
+    prof.add_argument("--seq-len", type=int, default=128)
+    prof.add_argument("--curves", required=True)
+    prof.set_defaults(fn=cmd_profile)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,8 +101,9 @@ class GpuCluster(ClusterBase):
         scheme = (hint or {}).get("scheme", self.scheme)
         sel = self._select(num_chips, scheme)
         if sel is None:
-            if num_chips <= self.free_chips:
-                self.fragmentation_failures += 1
+            # enough chips in aggregate (guarded above), placement refused:
+            # a locality/fragmentation failure by definition
+            self.fragmentation_failures += 1
             return None
         for node, count in sel:
             self._free[node] -= count
@@ -150,15 +151,11 @@ class GpuCluster(ClusterBase):
             return self._select_topology(n)
         raise ValueError(f"unknown scheme {scheme!r}")
 
-    def _select_consolidated(self, n: int) -> Optional[List[Tuple[NodeId, int]]]:
-        """Fewest nodes: best-fit a single node, else fill fullest-first."""
-        fits = [(f, node) for node, f in self._free.items() if f >= n]
-        if fits:
-            f, node = min(fits)  # tightest fit limits future fragmentation
-            return [(node, n)]
+    def _fill_fullest_first(
+        self, nodes: List[Tuple[NodeId, int]], n: int
+    ) -> Optional[List[Tuple[NodeId, int]]]:
         sel, need = [], n
-        # fullest nodes first -> minimal node count; switch-major grouping
-        for node, f in sorted(self._free.items(), key=lambda kv: (-kv[1], kv[0])):
+        for node, f in sorted(nodes, key=lambda kv: (-kv[1], kv[0])):
             if f <= 0:
                 continue
             take = min(f, need)
@@ -167,6 +164,26 @@ class GpuCluster(ClusterBase):
             if need == 0:
                 return sel
         return None
+
+    def _select_consolidated(self, n: int) -> Optional[List[Tuple[NodeId, int]]]:
+        """Fewest nodes: best-fit a single node; else prefer a single-switch
+        fill (the 0.9x tier) over an equally-compact cross-switch one."""
+        fits = [(f, node) for node, f in self._free.items() if f >= n]
+        if fits:
+            f, node = min(fits)  # tightest fit limits future fragmentation
+            return [(node, n)]
+        # same-switch candidates first: pick the switch needing fewest nodes
+        best: Optional[List[Tuple[NodeId, int]]] = None
+        for s in range(self.num_switches):
+            nodes = [((s, i), self._free[(s, i)]) for i in range(self.nodes_per_switch)]
+            if sum(f for _, f in nodes) < n:
+                continue
+            sel = self._fill_fullest_first(nodes, n)
+            if sel is not None and (best is None or len(sel) < len(best)):
+                best = sel
+        if best is not None:
+            return best
+        return self._fill_fullest_first(list(self._free.items()), n)
 
     def _select_random(self, n: int) -> Optional[List[Tuple[NodeId, int]]]:
         nodes = [node for node, f in self._free.items() if f > 0]
@@ -203,19 +220,11 @@ class GpuCluster(ClusterBase):
             f, node = min(fits)
             return [(node, n)]
         for s in range(self.num_switches):
-            nodes = [
-                ((s, i), self._free[(s, i)])
-                for i in range(self.nodes_per_switch)
-                if self._free[(s, i)] > 0
-            ]
+            nodes = [((s, i), self._free[(s, i)]) for i in range(self.nodes_per_switch)]
             if sum(f for _, f in nodes) >= n:
-                sel, need = [], n
-                for node, f in sorted(nodes, key=lambda kv: (-kv[1], kv[0])):
-                    take = min(f, need)
-                    sel.append((node, take))
-                    need -= take
-                    if need == 0:
-                        return sel
+                sel = self._fill_fullest_first(nodes, n)
+                if sel is not None:
+                    return sel
         return None
 
     def __repr__(self) -> str:

@@ -57,6 +57,9 @@ def with_placement(cluster, scheme: str, *, seed: int = 0):
         if scheme not in GPU_SCHEMES:
             raise ValueError(f"unknown GPU scheme {scheme!r}; known: {GPU_SCHEMES}")
         cluster.scheme = scheme
+        # the caller's seed must govern the scheme's randomness, or seed
+        # sweeps through this entry point collapse to one replicate
+        cluster._rng = random.Random(seed)
         return cluster
     if isinstance(cluster, TpuCluster):
         if scheme == "consolidated":

@@ -1,0 +1,134 @@
+"""CLI + analysis tests: every subcommand runs end-to-end in-process."""
+
+import csv
+import json
+from pathlib import Path
+
+import pytest
+
+from gpuschedule_tpu.cli import main
+
+PHILLY = str(Path(__file__).resolve().parent.parent / "data" / "philly_sample.csv")
+
+
+def run_cli(capsys, *argv):
+    rc = main(list(argv))
+    out = capsys.readouterr().out.strip().splitlines()
+    return rc, out
+
+
+def test_run_config1(tmp_path, capsys):
+    rc, out = run_cli(
+        capsys,
+        "run", "--policy", "fifo", "--cluster", "simple", "--chips", "64",
+        "--synthetic", "50", "--seed", "42", "--out", str(tmp_path),
+    )
+    assert rc == 0
+    summary = json.loads(out[-1])
+    assert summary["num_finished"] == 50
+    with open(tmp_path / "jobs.csv") as f:
+        assert len(list(csv.DictReader(f))) == 50
+    assert (tmp_path / "utilization.csv").exists()
+    assert (tmp_path / "counters.json").exists()
+
+
+def test_run_philly_on_tpu(capsys):
+    rc, out = run_cli(
+        capsys,
+        "run", "--policy", "dlas", "--cluster", "tpu-v5e", "--philly", PHILLY,
+    )
+    assert rc == 0
+    assert json.loads(out[-1])["num_finished"] == 300
+
+
+def test_run_policy_args_and_placement(capsys):
+    rc, out = run_cli(
+        capsys,
+        "run", "--policy", "gandiva", "--policy-arg", "round_length=120.0",
+        "--policy-arg", "packing=false",
+        "--cluster", "tpu-v5e", "--placement", "spread",
+        "--synthetic", "40", "--seed", "7",
+    )
+    assert rc == 0
+    assert json.loads(out[-1])["num_finished"] == 40
+
+
+def test_run_gpu_cluster_topology(capsys):
+    rc, out = run_cli(
+        capsys,
+        "run", "--policy", "srtf", "--cluster", "gpu", "--gpu-shape", "2x4x8",
+        "--placement", "topology", "--synthetic", "40", "--seed", "3",
+    )
+    assert rc == 0
+    summary = json.loads(out[-1])
+    assert summary["num_finished"] + summary["num_rejected"] == 40
+
+
+def test_gen_trace_roundtrip(tmp_path, capsys):
+    out_file = tmp_path / "t.csv"
+    rc, _ = run_cli(capsys, "gen-trace", "--num-jobs", "30", "--out", str(out_file))
+    assert rc == 0
+    rc, out = run_cli(
+        capsys, "run", "--policy", "fifo", "--cluster", "tpu-v5e",
+        "--trace", str(out_file),
+    )
+    assert json.loads(out[-1])["num_finished"] == 30
+
+
+def test_gen_philly_like_trace(tmp_path, capsys):
+    out_file = tmp_path / "p.csv"
+    rc, _ = run_cli(
+        capsys, "gen-trace", "--num-jobs", "30", "--philly-like", "--out", str(out_file)
+    )
+    rc, out = run_cli(
+        capsys, "run", "--policy", "fifo", "--cluster", "tpu-v5e",
+        "--philly", str(out_file),
+    )
+    assert json.loads(out[-1])["num_finished"] == 30
+
+
+def test_compare_topology_writes_report(tmp_path, capsys):
+    rc, out = run_cli(
+        capsys,
+        "compare-topology", "--synthetic", "40", "--seed", "5",
+        "--gpu-shape", "2x4x8", "--out", str(tmp_path),
+    )
+    assert rc == 0
+    summary = json.loads(out[-1])
+    assert set(summary) == {
+        "gpu-consolidated", "gpu-random", "gpu-topology", "tpu-v5p", "tpu-v5e"
+    }
+    assert (tmp_path / "summary.json").exists()
+    assert (tmp_path / "report.md").exists()
+    assert (tmp_path / "cdf_tpu-v5p.csv").exists()
+
+
+def test_max_time_cutoff(capsys):
+    rc, out = run_cli(
+        capsys,
+        "run", "--policy", "fifo", "--cluster", "simple", "--chips", "8",
+        "--synthetic", "50", "--seed", "1", "--max-time", "1000",
+    )
+    summary = json.loads(out[-1])
+    # an 8-chip pool rejects the trace's 16+-chip gangs at admission
+    total = summary["num_finished"] + summary["num_unfinished"] + summary["num_rejected"]
+    assert total == 50
+    assert summary["num_unfinished"] > 0
+
+
+def test_jct_cdf_shape():
+    from gpuschedule_tpu.analysis import jct_cdf
+    from gpuschedule_tpu.cluster import SimpleCluster
+    from gpuschedule_tpu.policies import make_policy
+    from gpuschedule_tpu.sim import Simulator
+    from gpuschedule_tpu.sim.trace import generate_poisson_trace
+
+    res = Simulator(
+        SimpleCluster(64), make_policy("fifo"), generate_poisson_trace(60, seed=2)
+    ).run()
+    cdf = jct_cdf(res)
+    assert cdf[-1][1] == 1.0
+    jcts = [x for x, _ in cdf]
+    fracs = [y for _, y in cdf]
+    assert jcts == sorted(jcts)
+    assert fracs == sorted(fracs)

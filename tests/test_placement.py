@@ -33,6 +33,20 @@ def test_consolidated_spills_with_fewest_nodes():
     assert a.detail.locality in ("switch", "cross")
 
 
+def test_consolidated_prefers_same_switch_spill():
+    """Reviewer repro: free (0,0)=8,(0,1)=2,(1,0)=6,(1,1)=6; a 12-gang must
+    land on switch 1 (two nodes, 0.9x) — not (0,0)+(1,0) cross-switch."""
+    c = GpuCluster(num_switches=2, nodes_per_switch=2, gpus_per_node=8)
+    # white-box: shape the free map directly to the repro's layout
+    c._free[(0, 1)] = 2
+    c._used = 6
+    a = c.allocate(12)
+    switches = {node[0] for node, _ in a.detail.nodes}
+    assert switches == {1}
+    assert a.detail.locality == "switch"
+    assert a.detail.speed_factor == pytest.approx(0.9)
+
+
 def test_locality_tiers_and_speed_factors():
     c = GpuCluster(num_switches=2, nodes_per_switch=2, gpus_per_node=8)
     one_node = c.allocate(8)
