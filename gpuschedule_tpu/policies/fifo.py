@@ -22,10 +22,17 @@ class FifoPolicy(Policy):
         self.backfill = backfill
 
     def schedule(self, sim) -> Optional[float]:
-        queue = sorted(sim.pending, key=lambda j: (j.submit_time, j.arrival_seq))
-        for job in queue:
-            if sim.try_start(job):
-                continue
-            if not self.backfill:
-                break  # head-of-line blocks
+        # ``sim.pending`` iterates in arrival order by construction (jobset.py
+        # invariant; FIFO never preempts, so no job is ever re-appended out of
+        # order) — no per-event sort.
+        if not self.backfill:
+            # Head-of-line: peek the oldest pending job; each successful start
+            # removes it from the set, so this is O(1) per start and O(1) per
+            # blocked event — no snapshot of a possibly-huge backlog.
+            while sim.pending:
+                if not sim.try_start(sim.pending[0]):
+                    break  # head-of-line blocks
+            return None
+        for job in list(sim.pending):  # backfill scans past blocked heads
+            sim.try_start(job)
         return None

@@ -5,6 +5,7 @@ accelerator in the loop (BASELINE.json north_star; SURVEY.md §4).
 """
 
 from gpuschedule_tpu.sim.job import Job, JobState
+from gpuschedule_tpu.sim.jobset import JobSet
 from gpuschedule_tpu.sim.engine import Simulator, SimResult
 
-__all__ = ["Job", "JobState", "Simulator", "SimResult"]
+__all__ = ["Job", "JobState", "JobSet", "Simulator", "SimResult"]

@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 from gpuschedule_tpu.sim.job import END_STATES, Job, JobState
+from gpuschedule_tpu.sim.jobset import JobSet
 from gpuschedule_tpu.sim.metrics import MetricsLog, SimResult
 
 # Event kinds, in processing-priority order at equal timestamps: completions
@@ -64,8 +65,11 @@ class Simulator:
         self.eps = eps
 
         self.now: float = 0.0
-        self.pending: List[Job] = []      # submitted, not running, not finished
-        self.running: List[Job] = []      # holding allocations
+        # Insertion-ordered, O(1)-mutation sets (see jobset.py): pending keeps
+        # arrival order for non-preemptive policies; both make start/preempt/
+        # finish constant-time at Philly scale.
+        self.pending: JobSet = JobSet()   # submitted, not running, not finished
+        self.running: JobSet = JobSet()   # holding allocations
         self.finished: List[Job] = []
         self._heap: list = []
         self._seq = itertools.count()

@@ -1,0 +1,73 @@
+"""Insertion-ordered job set with O(1) membership, append, and remove.
+
+The engine's ``pending``/``running`` collections were plain lists in the
+first cut; ``list.remove`` made every start/preempt/finish O(n), which turns
+Philly-scale replays (10^5 jobs) into O(n^2) hot loops (SURVEY.md §3.1 "hot
+spot": placement search + queue re-sort per step).  This dict-backed set
+keeps the list API the policies already use (iteration in insertion order,
+``len``, truthiness, ``in``, indexing, ``+``) while making the engine's
+mutations constant-time.
+
+Insertion order is a real invariant, not an accident: arrivals enter
+``pending`` in (submit_time, arrival_seq) order because the event heap pops
+them that way, so a non-preemptive policy (FIFO) can consume ``pending`` in
+arrival order with no per-event sort.  Preemptive policies re-append
+preempted jobs at the tail and impose their own priority order anyway.
+"""
+
+from __future__ import annotations
+
+from itertools import islice
+from typing import Dict, Iterable, Iterator, List
+
+from gpuschedule_tpu.sim.job import Job
+
+
+class JobSet:
+    """Ordered set of jobs keyed by identity."""
+
+    __slots__ = ("_jobs",)
+
+    def __init__(self, jobs: Iterable[Job] = ()):
+        self._jobs: Dict[int, Job] = {id(j): j for j in jobs}
+
+    def append(self, job: Job) -> None:
+        self._jobs[id(job)] = job  # re-append moves nothing: dict keeps first slot
+
+    def remove(self, job: Job) -> None:
+        try:
+            del self._jobs[id(job)]
+        except KeyError:
+            raise ValueError(f"{job!r} not in JobSet") from None
+
+    def __contains__(self, job: Job) -> bool:
+        return id(job) in self._jobs
+
+    def __iter__(self) -> Iterator[Job]:
+        return iter(self._jobs.values())
+
+    def __len__(self) -> int:
+        return len(self._jobs)
+
+    def __bool__(self) -> bool:
+        return bool(self._jobs)
+
+    def __getitem__(self, index: int) -> Job:
+        """Positional access in insertion order (O(index); used by tests and
+        debugging, never by the engine hot path)."""
+        n = len(self._jobs)
+        if index < 0:
+            index += n
+        if not 0 <= index < n:
+            raise IndexError(index)
+        return next(islice(iter(self._jobs.values()), index, None))
+
+    def __add__(self, other: Iterable[Job]) -> List[Job]:
+        """``pending + running`` — the policies' idiom for the active set."""
+        return [*self, *other]
+
+    def __radd__(self, other: Iterable[Job]) -> List[Job]:
+        return [*other, *self]
+
+    def __repr__(self) -> str:
+        return f"JobSet({[j.job_id for j in self]})"

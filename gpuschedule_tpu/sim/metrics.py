@@ -75,14 +75,30 @@ class SimResult:
 
 
 class MetricsLog:
-    """Accumulates job records and utilization samples during a run."""
+    """Accumulates job records and utilization samples during a run.
 
-    def __init__(self) -> None:
+    The time-weighted utilization summary is integrated incrementally at
+    every :meth:`sample` call, so it stays exact regardless of how many
+    samples are *stored*: storage is capped at ``max_util_samples`` by
+    stride-doubling decimation (keep every 2nd, then every 4th, ...), which
+    bounds memory on Philly-scale traces (10^5 jobs -> ~10^6 event samples)
+    while the persisted utilization.csv remains a uniform subsample.
+    """
+
+    def __init__(self, *, max_util_samples: int = 200_000) -> None:
         self.job_rows: List[dict] = []
         self.util_samples: List[tuple] = []  # (t, used, total, running, pending)
         self.counters: Counter = Counter()
         self._all_jobs: Sequence[Job] = ()   # set by attach_jobs(); lets write()
                                              # emit rows for unfinished jobs too
+        self.max_util_samples = max(2, max_util_samples)
+        self._stride = 1                     # store every _stride-th sample
+        self._sample_calls = 0
+        self._last_t: Optional[float] = None
+        self._last_frac = 0.0                # used/total at the previous sample
+        self._util_area = 0.0                # integral of (used/total) dt
+        self._util_horizon = 0.0             # total dt with total > 0
+        self._tail: Optional[tuple] = None   # most recent sample, always kept
 
     def attach_jobs(self, jobs: Sequence[Job]) -> None:
         """Register the full job list (engine does this at construction) so
@@ -118,12 +134,36 @@ class MetricsLog:
         self.job_rows.append(self._job_row(job))
 
     def sample(self, t: float, cluster, num_running: int, num_pending: int) -> None:
-        self.util_samples.append(
-            (t, cluster.used_chips, cluster.total_chips, num_running, num_pending)
-        )
+        used, total = cluster.used_chips, cluster.total_chips
+        # Exact piecewise-constant integral: occupancy over [last_t, t) is
+        # whatever the previous sample observed.
+        if self._last_t is not None and total > 0 and t > self._last_t:
+            dt = t - self._last_t
+            self._util_area += self._last_frac * dt
+            self._util_horizon += dt
+        self._last_t = t
+        self._last_frac = used / total if total > 0 else 0.0
+
+        self._tail = (t, used, total, num_running, num_pending)
+        if self._sample_calls % self._stride == 0:
+            self.util_samples.append(self._tail)
+            if len(self.util_samples) > self.max_util_samples:
+                self.util_samples = self.util_samples[::2]
+                self._stride *= 2
+        self._sample_calls += 1
+
+    def _flush_tail(self) -> None:
+        """Ensure the final observed sample is stored: once decimation raises
+        the stride, the last call is usually not a stride multiple, and the
+        persisted log would end before the simulation does."""
+        if self._tail is not None and (
+            not self.util_samples or self.util_samples[-1] != self._tail
+        ):
+            self.util_samples.append(self._tail)
 
     # ------------------------------------------------------------------ #
     def result(self, jobs: Sequence[Job], end_time: float) -> SimResult:
+        self._flush_tail()
         # Admission-rejected jobs never ran: counting their 0-second "JCT"
         # would flatter clusters that reject more, so they are excluded from
         # every aggregate and surfaced via the num_rejected field /
@@ -138,17 +178,9 @@ class MetricsLog:
             makespan = max(j.end_time for j in finished) - start
         else:
             makespan = 0.0
-        # Time-weighted mean utilization over the sampled horizon.
-        util = 0.0
-        if len(self.util_samples) >= 2:
-            area, horizon = 0.0, 0.0
-            for (t0, used, total, _, _), (t1, *_rest) in zip(
-                self.util_samples, self.util_samples[1:]
-            ):
-                if total > 0:
-                    area += (used / total) * (t1 - t0)
-                    horizon += t1 - t0
-            util = area / horizon if horizon > 0 else 0.0
+        # Time-weighted mean utilization, integrated incrementally in sample()
+        # (exact even when the stored sample list has been decimated).
+        util = self._util_area / self._util_horizon if self._util_horizon > 0 else 0.0
         rejected = sum(1 for j in jobs if j.state is JobState.REJECTED)
         return SimResult(
             avg_jct=sum(jcts) / len(jcts) if jcts else 0.0,
@@ -166,6 +198,7 @@ class MetricsLog:
     # ------------------------------------------------------------------ #
     def write(self, out_dir: str | Path, *, prefix: str = "") -> None:
         """Write job-level and utilization CSVs plus a counters JSON."""
+        self._flush_tail()
         out = Path(out_dir)
         out.mkdir(parents=True, exist_ok=True)
         # Finished jobs were recorded incrementally; unfinished jobs (horizon

