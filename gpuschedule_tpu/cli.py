@@ -103,10 +103,16 @@ def build_policy(args):
 
 
 def cmd_run(args) -> int:
+    from gpuschedule_tpu.sim.metrics import MetricsLog
+
+    if args.events and not args.out:
+        raise SystemExit("--events requires --out (the stream is only persisted)")
     cluster = build_cluster(args)
     jobs = load_jobs(args)
     sim = Simulator(
-        cluster, build_policy(args), jobs, max_time=args.max_time or float("inf")
+        cluster, build_policy(args), jobs,
+        metrics=MetricsLog(record_events=args.events),
+        max_time=args.max_time or float("inf"),
     )
     res = sim.run()
     print(json.dumps(res.summary(), sort_keys=True))
@@ -218,6 +224,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                      help="profile unseen models live (optimus)")
     run.add_argument("--out", help="directory for jobs/utilization CSVs")
     run.add_argument("--prefix", default="")
+    run.add_argument("--events", action="store_true",
+                     help="record a structured events.jsonl stream (opt-in: "
+                          "~1 record per state transition)")
     run.set_defaults(fn=cmd_run)
 
     gen = sub.add_parser("gen-trace", help="write a synthetic trace CSV")

@@ -143,6 +143,9 @@ class Simulator:
             self.pending.remove(job)
         self.running.append(job)
         self._schedule_completion(job)
+        self.metrics.event(
+            "start", self.now, job, chips=chips, speed=speed, overhead=overhead
+        )
         return True
 
     def preempt(self, job: Job, *, suspend: bool = True) -> None:
@@ -163,6 +166,7 @@ class Simulator:
         self.running.remove(job)
         self.pending.append(job)
         self.metrics.count("preemptions")
+        self.metrics.event("preempt", self.now, job, suspend=suspend)
 
     def set_speed(self, job: Job, speed: float) -> None:
         """Change a running job's progress rate (elastic resize effect)."""
@@ -174,6 +178,7 @@ class Simulator:
         job.speed = speed
         job.epoch += 1
         self._schedule_completion(job)
+        self.metrics.event("speed", self.now, job, speed=speed)
 
     def migrate(self, job: Job, *, overhead: float, placement_hint: Optional[dict] = None) -> bool:
         """Move a running job to a fresh allocation, paying ``overhead``
@@ -209,6 +214,7 @@ class Simulator:
         job.epoch += 1
         self._schedule_completion(job)
         self.metrics.count("migrations")
+        self.metrics.event("migrate", self.now, job, overhead=overhead)
         return True
 
     def resize(self, job: Job, *, chips: int, speed: float, overhead: float = 0.0) -> bool:
@@ -237,6 +243,7 @@ class Simulator:
         job.overhead_remaining += overhead
         job.epoch += 1
         self._schedule_completion(job)
+        self.metrics.event("resize", self.now, job, chips=chips, speed=speed)
         return True
 
     # ------------------------------------------------------------------ #
@@ -254,6 +261,7 @@ class Simulator:
         self.running.remove(job)
         self.finished.append(job)
         self.metrics.record_job(job)
+        self.metrics.event("finish", self.now, job, end_state=job.state.value)
 
     def run(self) -> SimResult:
         """Drive the event loop to completion and return summary metrics."""
@@ -290,8 +298,10 @@ class Simulator:
                         self.finished.append(job)
                         self.metrics.record_job(job)
                         self.metrics.count("rejected_unsatisfiable")
+                        self.metrics.event("reject", t, job, chips=job.num_chips)
                     else:
                         self.pending.append(job)
+                        self.metrics.event("arrival", t, job, chips=job.num_chips)
                     dirty = True
                 elif kind == _COMPLETION:
                     job = payload

@@ -85,8 +85,15 @@ class MetricsLog:
     while the persisted utilization.csv remains a uniform subsample.
     """
 
-    def __init__(self, *, max_util_samples: int = 200_000) -> None:
+    def __init__(
+        self, *, max_util_samples: int = 200_000, record_events: bool = False
+    ) -> None:
         self.job_rows: List[dict] = []
+        # Structured event stream (SURVEY.md §5 "Metrics/logging": CSVs plus
+        # a structured JSONL event log).  Off by default: at Philly scale the
+        # stream is ~10^6 dicts, so it is opt-in (CLI --events).
+        self.record_events = record_events
+        self.events: List[dict] = []
         self.util_samples: List[tuple] = []  # (t, used, total, running, pending)
         self.counters: Counter = Counter()
         self._all_jobs: Sequence[Job] = ()   # set by attach_jobs(); lets write()
@@ -109,6 +116,16 @@ class MetricsLog:
     # ------------------------------------------------------------------ #
     def count(self, key: str, n: int = 1) -> None:
         self.counters[key] += n
+
+    def event(self, kind: str, t: float, job: Optional[Job] = None, **extra) -> None:
+        """Append one structured event (no-op unless ``record_events``)."""
+        if not self.record_events:
+            return
+        rec: dict = {"t": t, "event": kind}
+        if job is not None:
+            rec["job"] = job.job_id
+        rec.update(extra)
+        self.events.append(rec)
 
     @staticmethod
     def _job_row(job: Job) -> dict:
@@ -218,3 +235,7 @@ class MetricsLog:
             w.writerows(self.util_samples)
         with open(out / f"{prefix}counters.json", "w") as f:
             json.dump(dict(self.counters), f, indent=2, sort_keys=True)
+        if self.record_events:
+            with open(out / f"{prefix}events.jsonl", "w") as f:
+                for rec in self.events:
+                    f.write(json.dumps(rec) + "\n")

@@ -1,0 +1,58 @@
+"""Structured JSONL event log (SURVEY.md §5 metrics row: CSVs + JSONL)."""
+
+from __future__ import annotations
+
+import json
+
+from gpuschedule_tpu.cluster.base import SimpleCluster
+from gpuschedule_tpu.policies.dlas import DlasPolicy
+from gpuschedule_tpu.policies.fifo import FifoPolicy
+from gpuschedule_tpu.sim import Simulator
+from gpuschedule_tpu.sim.metrics import MetricsLog
+from gpuschedule_tpu.sim.trace import generate_poisson_trace
+
+
+def _run(policy, *, chips=8, n=60, record_events=True):
+    jobs = generate_poisson_trace(n, seed=13, mean_duration=600.0)
+    metrics = MetricsLog(record_events=record_events)
+    sim = Simulator(SimpleCluster(chips), policy, jobs, metrics=metrics)
+    return sim.run(), metrics
+
+
+def test_events_cover_lifecycle_and_match_counters():
+    res, metrics = _run(DlasPolicy(thresholds=(600.0,)))
+    kinds = [e["event"] for e in metrics.events]
+    assert kinds.count("finish") == res.num_finished
+    assert kinds.count("preempt") == res.counters.get("preemptions", 0)
+    assert kinds.count("arrival") + kinds.count("reject") == 60
+    # every start has the chips/speed fields; every event is timestamped and
+    # non-decreasing in time (the stream is an ordered transition log)
+    times = [e["t"] for e in metrics.events]
+    assert times == sorted(times)
+    for e in metrics.events:
+        if e["event"] == "start":
+            assert e["chips"] >= 1 and e["speed"] > 0
+        assert "job" in e
+
+
+def test_events_off_by_default_and_written_as_jsonl(tmp_path):
+    res, metrics = _run(FifoPolicy(), record_events=False)
+    assert metrics.events == []
+
+    res, metrics = _run(FifoPolicy(), record_events=True)
+    metrics.write(tmp_path)
+    lines = (tmp_path / "events.jsonl").read_text().splitlines()
+    assert len(lines) == len(metrics.events) > 0
+    parsed = [json.loads(line) for line in lines]
+    assert parsed[0]["event"] == "arrival"
+
+
+def test_cli_events_flag(tmp_path):
+    from gpuschedule_tpu.cli import main
+
+    rc = main([
+        "run", "--policy", "fifo", "--cluster", "simple", "--chips", "16",
+        "--synthetic", "40", "--seed", "2", "--events", "--out", str(tmp_path),
+    ])
+    assert rc == 0
+    assert (tmp_path / "events.jsonl").exists()
