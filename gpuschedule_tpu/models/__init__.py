@@ -6,13 +6,21 @@ TPU-native equivalents: small flax models with static shapes and bfloat16
 compute so XLA tiles every matmul onto the MXU.  Names match the model
 names emitted by the trace generators (sim/trace.py DEFAULT_MODELS) so a
 simulated job maps directly onto a profilable model.
+
+Configs (:mod:`config`) are jax-free and import eagerly; the flax modules
+load lazily on first attribute access so the sim layer can consume
+``MODEL_CONFIGS`` (param counts for overhead/goodput models) without
+pulling in the accelerator stack.
 """
 
-from gpuschedule_tpu.models.transformer import (
-    MODEL_CONFIGS,
-    ModelConfig,
-    TransformerLM,
-    build_model,
-)
+from gpuschedule_tpu.models.config import MODEL_CONFIGS, ModelConfig
 
 __all__ = ["MODEL_CONFIGS", "ModelConfig", "TransformerLM", "build_model"]
+
+
+def __getattr__(name: str):
+    if name in ("TransformerLM", "build_model"):
+        from gpuschedule_tpu.models import transformer
+
+        return getattr(transformer, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,114 @@
+"""Model configs — jax-free so the sim layer can import them.
+
+The dataclasses here carry everything the *simulator* needs about a model
+(parameter count, FLOPs estimate) without touching flax/jax; the actual
+modules live in :mod:`gpuschedule_tpu.models.transformer` and are imported
+lazily by the package ``__getattr__`` (the sim core must stay importable
+with no accelerator stack present — SURVEY.md §4 "no GPU in the loop").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab: int = 8192
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    d_ff: int = 512
+    max_seq: int = 512
+    remat: bool = False
+
+    @property
+    def param_count(self) -> int:
+        """Approximate parameter count (embeddings + blocks)."""
+        per_block = 4 * self.d_model * self.d_model + 2 * self.d_model * self.d_ff
+        return self.vocab * self.d_model + self.n_layers * per_block
+
+    def flops_per_token(self) -> float:
+        """~6N FLOPs/token for fwd+bwd of an N-param dense LM (the standard
+        estimate the MFU arithmetic in bench.py uses)."""
+        return 6.0 * self.param_count
+
+
+@dataclass(frozen=True)
+class CnnConfig:
+    """Residual CNN classifier — the vision-model family.
+
+    Philly's workload is dominated by CNN training jobs, and the reference
+    profiles real vision models through its DDP microbenchmarks (SURVEY.md
+    §2 "Throughput profiler"); this config family plays that role.  Stages
+    halve resolution and grow channels ResNet-style.
+    """
+
+    name: str
+    channels: tuple = (64, 128, 256)
+    blocks_per_stage: int = 2
+    image_size: int = 32
+    num_classes: int = 100
+
+    @property
+    def param_count(self) -> int:
+        """Approximate parameter count (3x3 conv pairs per block + head)."""
+        total = 3 * 3 * 3 * self.channels[0]          # stem
+        prev = self.channels[0]
+        for ch in self.channels:
+            # per stage: entry conv (prev->ch) + (2*blocks - 1) ch->ch convs
+            total += 3 * 3 * prev * ch
+            total += (2 * self.blocks_per_stage - 1) * 3 * 3 * ch * ch
+            prev = ch
+        return total + prev * self.num_classes        # linear head
+
+    def flops_per_token(self) -> float:
+        """FLOPs per *sample* (fwd+bwd); named for interface parity with
+        :class:`ModelConfig` so MFU/goodput arithmetic is uniform.  Conv
+        FLOPs = 2 * k*k * cin * cout * H*W per layer, x3 for fwd+bwd."""
+        hw = self.image_size * self.image_size
+        fl = 2 * 3 * 3 * 3 * self.channels[0] * hw
+        prev = self.channels[0]
+        for ch in self.channels:
+            fl += 2 * 3 * 3 * prev * ch * hw
+            fl += (2 * self.blocks_per_stage - 1) * 2 * 3 * 3 * ch * ch * hw
+            hw //= 4  # stage downsamples 2x in each spatial dim
+            prev = ch
+        return 3.0 * fl
+
+
+# Both families expose the same estimate interface — ``param_count`` and
+# ``flops_per_token()`` (per-token for LMs, per-SAMPLE for CNNs) — which the
+# goodput, overhead, and bench arithmetic depend on.
+MODEL_CONFIGS: Dict[str, "ModelConfig | CnnConfig"] = {
+    cfg.name: cfg
+    for cfg in (
+        CnnConfig("resnet-tiny", channels=(32, 64), blocks_per_stage=1),
+        CnnConfig("resnet-mid", channels=(64, 128, 256), blocks_per_stage=2),
+        ModelConfig("transformer-tiny", d_model=128, n_layers=2, n_heads=4, d_ff=512),
+        ModelConfig("transformer-small", d_model=256, n_layers=4, n_heads=8, d_ff=1024),
+        ModelConfig("transformer-base", d_model=512, n_layers=8, n_heads=8, d_ff=2048),
+        # Flagship bench config: sized so the per-layer matmuls fill the MXU
+        # on one chip — measured 62% MFU at (b8, s512) on v5e vs 33% for
+        # transformer-base, the knee of the d_model sweep (1024: 47%,
+        # 1536x8: 59%, 2048x8: 60%, 1536x12: 62%).
+        ModelConfig(
+            "transformer-large", d_model=1536, n_layers=12, n_heads=16, d_ff=6144
+        ),
+        ModelConfig(
+            "transformer-long",
+            d_model=256,
+            n_layers=4,
+            n_heads=8,
+            d_ff=1024,
+            max_seq=4096,
+            remat=True,
+        ),
+        # "mlp-wide" is a transformer with a fat FFN and thin attention —
+        # keeps one model class while giving the profiler a compute-heavy,
+        # communication-light point in the workload mix.
+        ModelConfig("mlp-wide", d_model=256, n_layers=2, n_heads=2, d_ff=4096),
+    )
+}

@@ -22,66 +22,11 @@ block); this zoo plays that role for the JAX harness.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from functools import partial
-from typing import Any, Dict, Tuple
-
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-
-@dataclass(frozen=True)
-class ModelConfig:
-    name: str
-    vocab: int = 8192
-    d_model: int = 128
-    n_layers: int = 2
-    n_heads: int = 4
-    d_ff: int = 512
-    max_seq: int = 512
-    remat: bool = False
-
-    @property
-    def param_count(self) -> int:
-        """Approximate parameter count (embeddings + blocks)."""
-        per_block = 4 * self.d_model * self.d_model + 2 * self.d_model * self.d_ff
-        return self.vocab * self.d_model + self.n_layers * per_block
-
-    def flops_per_token(self) -> float:
-        """~6N FLOPs/token for fwd+bwd of an N-param dense LM (the standard
-        estimate the MFU arithmetic in bench.py uses)."""
-        return 6.0 * self.param_count
-
-
-MODEL_CONFIGS: Dict[str, ModelConfig] = {
-    cfg.name: cfg
-    for cfg in (
-        ModelConfig("transformer-tiny", d_model=128, n_layers=2, n_heads=4, d_ff=512),
-        ModelConfig("transformer-small", d_model=256, n_layers=4, n_heads=8, d_ff=1024),
-        ModelConfig("transformer-base", d_model=512, n_layers=8, n_heads=8, d_ff=2048),
-        # Flagship bench config: sized so the per-layer matmuls fill the MXU
-        # on one chip — measured 62% MFU at (b8, s512) on v5e vs 33% for
-        # transformer-base, the knee of the d_model sweep (1024: 47%,
-        # 1536x8: 59%, 2048x8: 60%, 1536x12: 62%).
-        ModelConfig(
-            "transformer-large", d_model=1536, n_layers=12, n_heads=16, d_ff=6144
-        ),
-        ModelConfig(
-            "transformer-long",
-            d_model=256,
-            n_layers=4,
-            n_heads=8,
-            d_ff=1024,
-            max_seq=4096,
-            remat=True,
-        ),
-        # "mlp-wide" is a transformer with a fat FFN and thin attention —
-        # keeps one model class while giving the profiler a compute-heavy,
-        # communication-light point in the workload mix.
-        ModelConfig("mlp-wide", d_model=256, n_layers=2, n_heads=2, d_ff=4096),
-    )
-}
+from gpuschedule_tpu.models.config import MODEL_CONFIGS, CnnConfig, ModelConfig
 
 
 class Block(nn.Module):
@@ -139,10 +84,15 @@ class TransformerLM(nn.Module):
         return logits.astype(jnp.float32)  # f32 softmax for stable loss
 
 
-def build_model(name: str) -> Tuple[TransformerLM, ModelConfig]:
-    """Look up a config by trace model name and build its module."""
+def build_model(name: str):
+    """Look up a config by trace model name and build its module
+    (transformer LM or CNN classifier, per the config family)."""
     try:
         cfg = MODEL_CONFIGS[name]
     except KeyError:
         raise ValueError(f"unknown model {name!r}; known: {sorted(MODEL_CONFIGS)}") from None
+    if isinstance(cfg, CnnConfig):
+        from gpuschedule_tpu.models.cnn import ResNet
+
+        return ResNet(cfg), cfg
     return TransformerLM(cfg), cfg

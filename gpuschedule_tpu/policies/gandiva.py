@@ -31,6 +31,7 @@ from typing import List, Optional
 
 from gpuschedule_tpu.policies.base import Policy
 from gpuschedule_tpu.sim.job import Job, JobState
+from gpuschedule_tpu.sim.overhead import resolve_overhead
 
 
 class GandivaPolicy(Policy):
@@ -40,14 +41,20 @@ class GandivaPolicy(Policy):
         self,
         *,
         round_length: float = 300.0,
-        suspend_overhead: float = 30.0,
-        migration_overhead: float = 45.0,
+        suspend_overhead: float | str = 30.0,
+        migration_overhead: float | str = 45.0,
         packing: bool = True,
         pack_util_threshold: float = 1.25,
         max_migrations_per_event: int = 2,
     ):
         if round_length <= 0:
             raise ValueError("round_length must be positive")
+        # Overhead knobs take a constant (seconds) or "auto": derive the
+        # cost from the job's model size and slice shape (sim/overhead.py —
+        # checkpoint costs parameterized per slice size).
+        for knob in (suspend_overhead, migration_overhead):
+            if knob != "auto":
+                float(knob)
         self.round_length = round_length
         self.suspend_overhead = suspend_overhead
         self.migration_overhead = migration_overhead
@@ -122,10 +129,14 @@ class GandivaPolicy(Policy):
             sim.preempt(job, suspend=True)
             job.sched["g_wait_since"] = now
 
+    def _resume_overhead(self, sim, job: Job) -> float:
+        if job.executed_work <= 0.0:
+            return 0.0  # first start: nothing to restore
+        return resolve_overhead(self.suspend_overhead, job, sim.cluster)
+
     def _start_waiters(self, sim, now: float) -> None:
         for job in self._waiters(sim):
-            overhead = self.suspend_overhead if job.executed_work > 0.0 else 0.0
-            if sim.try_start(job, overhead=overhead):
+            if sim.try_start(job, overhead=self._resume_overhead(sim, job)):
                 job.sched["g_round_start"] = now
 
     # ------------------------------------------------------------------ #
@@ -151,7 +162,7 @@ class GandivaPolicy(Policy):
             # started at nominal speed; _update_pack_speeds (invoked right
             # after in the same schedule pass, zero sim time elapsing) is the
             # single owner of the contention model for packed groups
-            overhead = self.suspend_overhead if job.executed_work > 0.0 else 0.0
+            overhead = self._resume_overhead(sim, job)
             if sim.try_start(job, overhead=overhead, speed=1.0, placement_hint=hint):
                 job.sched["g_round_start"] = now
                 sim.metrics.count("packings")
@@ -223,5 +234,8 @@ class GandivaPolicy(Policy):
         for job in movable:
             if budget == 0 or cluster.can_allocate(k):
                 break
-            if sim.migrate(job, overhead=self.migration_overhead):
+            overhead = resolve_overhead(
+                self.migration_overhead, job, cluster, migration=True
+            )
+            if sim.migrate(job, overhead=overhead):
                 budget -= 1

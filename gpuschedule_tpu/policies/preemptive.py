@@ -19,20 +19,22 @@ from __future__ import annotations
 from typing import Callable, List, Sequence
 
 from gpuschedule_tpu.sim.job import Job, JobState
+from gpuschedule_tpu.sim.overhead import resolve_overhead
 
 
 def apply_priority_schedule(
     sim,
     ordered: Sequence[Job],
     *,
-    restart_overhead: float = 0.0,
+    restart_overhead: float | str = 0.0,
 ) -> None:
     """Make the running set match the highest-priority prefix that fits.
 
     ``ordered`` lists schedulable jobs (PENDING/SUSPENDED/RUNNING), highest
     priority first.  ``restart_overhead`` seconds are charged to a job that
     resumes after having run before (modeled checkpoint/restore, SURVEY.md
-    §5 "Checkpoint / resume").
+    §5 "Checkpoint / resume"); pass ``"auto"`` to derive the cost from the
+    job's model size and slice shape (sim/overhead.py).
     """
     budget = sim.cluster.total_chips
     keep: List[Job] = []
@@ -52,7 +54,11 @@ def apply_priority_schedule(
     for job in keep:
         if job.state is JobState.RUNNING:
             continue
-        overhead = restart_overhead if job.executed_work > 0.0 else 0.0
+        overhead = (
+            resolve_overhead(restart_overhead, job, sim.cluster)
+            if job.executed_work > 0.0
+            else 0.0
+        )
         sim.try_start(job, overhead=overhead)
 
 

@@ -1,0 +1,101 @@
+"""Checkpoint/restore cost model parameterized per model and slice size."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+import pytest
+
+from gpuschedule_tpu.cluster.tpu import TpuCluster
+from gpuschedule_tpu.policies.gandiva import GandivaPolicy
+from gpuschedule_tpu.policies.srtf import SrtfPolicy
+from gpuschedule_tpu.sim import Job, Simulator
+from gpuschedule_tpu.sim.overhead import (
+    DEFAULT_BASE_S,
+    ckpt_bytes,
+    migrate_seconds,
+    resolve_overhead,
+    restore_seconds,
+)
+from gpuschedule_tpu.sim.trace import generate_poisson_trace
+
+
+def test_cost_grows_with_model_and_shrinks_with_slice():
+    small = restore_seconds("transformer-tiny", 8)
+    large = restore_seconds("transformer-large", 8)
+    assert large > small > DEFAULT_BASE_S
+    # more hosts pull shards in parallel -> transfer term shrinks
+    assert restore_seconds("transformer-large", 64) < restore_seconds(
+        "transformer-large", 8
+    )
+    # the base term is a floor, not scaled away
+    assert restore_seconds("transformer-tiny", 256) > DEFAULT_BASE_S
+
+
+def test_migration_pays_double_transfer():
+    chips = 8
+    resume = restore_seconds("transformer-large", chips)
+    migrate = migrate_seconds("transformer-large", chips)
+    assert migrate == pytest.approx(DEFAULT_BASE_S + 2 * (resume - DEFAULT_BASE_S))
+
+
+def test_unknown_model_falls_back_not_crashes():
+    assert ckpt_bytes("resnet50-from-philly-trace") > 0
+    assert restore_seconds("no-such-model", 4) > 0
+
+
+def test_resolve_overhead_auto_uses_cluster_generation():
+    job = Job("j", 0.0, num_chips=8, duration=100.0, model_name="transformer-base")
+    v5e = resolve_overhead("auto", job, TpuCluster("v5e"))
+    assert v5e > 0
+    assert resolve_overhead(12.5, job, TpuCluster("v5e")) == 12.5
+    assert resolve_overhead("auto", job, object()) == v5e  # default gen fallback
+
+
+def test_policies_run_with_auto_overheads():
+    jobs = generate_poisson_trace(80, seed=21, util_range=(0.4, 1.0))
+    res = Simulator(
+        TpuCluster("v5e", dims=(8, 8)),
+        GandivaPolicy(suspend_overhead="auto", migration_overhead="auto",
+                      round_length=600.0),
+        jobs,
+    ).run()
+    assert res.num_finished == 80
+
+    jobs = generate_poisson_trace(80, seed=22)
+    res = Simulator(
+        TpuCluster("v5e", dims=(8, 8)),
+        SrtfPolicy(restart_overhead="auto"),
+        jobs,
+    ).run()
+    assert res.num_finished == 80
+
+
+def test_sim_layer_stays_jax_free():
+    """Importing the sim core + policies + overhead model must not pull jax
+    (SURVEY.md §4: replay runs with no accelerator in the loop)."""
+    # This image's sitecustomize preloads jax at interpreter startup, so
+    # "jax not in sys.modules" can never hold; instead evict it and install
+    # an import blocker — any gpuschedule module importing jax then raises.
+    code = """
+import importlib.abc, sys
+for mod in [m for m in sys.modules if m == 'jax' or m.startswith(('jax.', 'jaxlib', 'flax'))]:
+    del sys.modules[mod]
+
+class Blocker(importlib.abc.MetaPathFinder):
+    def find_spec(self, name, path=None, target=None):
+        if name in ('jax', 'flax') or name.startswith(('jax.', 'flax.')):
+            raise ImportError(f'sim layer tried to import {name}')
+        return None
+
+sys.meta_path.insert(0, Blocker())
+import gpuschedule_tpu.sim.overhead, gpuschedule_tpu.policies
+import gpuschedule_tpu.sim, gpuschedule_tpu.cluster
+print('jax-free ok')
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=120
+    )
+    assert out.returncode == 0, out.stderr
+    assert "jax-free ok" in out.stdout
