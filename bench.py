@@ -59,8 +59,12 @@ def main() -> None:
                  # on the axon tunnel (see profiler/harness.py docstring)
 
     step_s, state = time_steps(trainer.step, state, tokens, iters=ITERS)
-    tokens_per_s = BATCH * SEQ / step_s
-    flops_per_step = trainer.cfg.flops_per_token() * BATCH * SEQ
+    # flops_per_token() is per-token for LMs, per-SAMPLE for CNN configs
+    # (models/config.py) — scale by the matching unit count.
+    units = BATCH if trainer.is_image else BATCH * SEQ
+    unit_name = "samples" if trainer.is_image else "tokens"
+    tokens_per_s = units / step_s
+    flops_per_step = trainer.cfg.flops_per_token() * units
     achieved_tflops = flops_per_step / step_s / 1e12
 
     kind = getattr(dev, "device_kind", "").lower()
@@ -71,10 +75,10 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": f"{MODEL} train-step tokens/s (b{BATCH}xs{SEQ}, 1 chip, "
+                "metric": f"{MODEL} train-step {unit_name}/s (b{BATCH}xs{SEQ}, 1 chip, "
                 f"median of {ITERS}; mfu={mfu:.3f} @ {achieved_tflops:.1f} TF on {gen})",
                 "value": round(tokens_per_s, 1),
-                "unit": "tokens/s",
+                "unit": f"{unit_name}/s",
                 "vs_baseline": round(mfu / TARGET_MFU, 3),
             }
         )

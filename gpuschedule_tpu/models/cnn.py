@@ -1,0 +1,74 @@
+"""Residual CNN classifier in flax, MXU-first.
+
+The vision-model family of the zoo (see :class:`~gpuschedule_tpu.models
+.config.CnnConfig`): Philly's workload is CNN-heavy and the reference's
+profiler benchmarks real vision models (SURVEY.md §2 "Throughput
+profiler").  Same hardware rules as the transformer zoo: bf16 compute /
+f32 params so convs tile onto the MXU, static shapes, GroupNorm instead of
+BatchNorm so ``apply`` is pure (no mutable batch stats — the train step
+stays a plain ``jax.jit`` with donated state, and normalization is
+independent of the dp shard size).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from gpuschedule_tpu.models.config import CnnConfig
+
+
+class ResBlock(nn.Module):
+    """3x3-3x3 residual block, pre-norm, bf16 compute."""
+
+    ch: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        h = nn.GroupNorm(num_groups=8, dtype=jnp.bfloat16, name="gn1")(x)
+        h = nn.relu(h)
+        h = nn.Conv(
+            self.ch, (3, 3), strides=(self.stride, self.stride),
+            dtype=jnp.bfloat16, param_dtype=jnp.float32, name="conv1",
+        )(h)
+        h = nn.GroupNorm(num_groups=8, dtype=jnp.bfloat16, name="gn2")(h)
+        h = nn.relu(h)
+        h = nn.Conv(
+            self.ch, (3, 3), dtype=jnp.bfloat16, param_dtype=jnp.float32,
+            name="conv2",
+        )(h)
+        if x.shape[-1] != self.ch or self.stride != 1:
+            x = nn.Conv(
+                self.ch, (1, 1), strides=(self.stride, self.stride),
+                dtype=jnp.bfloat16, param_dtype=jnp.float32, name="proj",
+            )(x)
+        return x + h
+
+
+class ResNet(nn.Module):
+    """Stem → stages (downsample 2x, widen) → pooled linear head."""
+
+    cfg: CnnConfig
+
+    @nn.compact
+    def __call__(self, images: jax.Array) -> jax.Array:
+        c = self.cfg
+        x = images.astype(jnp.bfloat16)
+        x = nn.Conv(
+            c.channels[0], (3, 3), dtype=jnp.bfloat16, param_dtype=jnp.float32,
+            name="stem",
+        )(x)
+        for si, ch in enumerate(c.channels):
+            for bi in range(c.blocks_per_stage):
+                stride = 2 if (bi == 0 and si > 0) else 1
+                x = ResBlock(ch, stride, name=f"s{si}b{bi}")(x)
+        x = nn.GroupNorm(num_groups=8, dtype=jnp.bfloat16, name="gn_f")(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        logits = nn.Dense(
+            c.num_classes, dtype=jnp.bfloat16, param_dtype=jnp.float32,
+            name="head",
+        )(x)
+        return logits.astype(jnp.float32)

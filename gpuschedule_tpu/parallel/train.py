@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from gpuschedule_tpu.models import build_model
+from gpuschedule_tpu.models.config import CnnConfig
 
 
 def param_partition_spec(path: Tuple, value: Any) -> P:
@@ -84,21 +85,31 @@ class ShardedTrainer:
         seq_shard: bool = False,
     ):
         self.model, self.cfg = build_model(model_name)
+        self.is_image = isinstance(self.cfg, CnnConfig)
         self.mesh = mesh
-        if seq_len > self.cfg.max_seq:
+        if not self.is_image and seq_len > self.cfg.max_seq:
             raise ValueError(f"seq_len {seq_len} > model max_seq {self.cfg.max_seq}")
         dp = mesh.shape["dp"]
         sp = mesh.shape["sp"]
         if batch_size % dp != 0:
             raise ValueError(f"batch {batch_size} not divisible by dp={dp}")
+        if seq_shard and self.is_image:
+            raise ValueError("seq_shard applies to LM sequences, not images")
         if seq_shard and seq_len % sp != 0:
             raise ValueError(f"seq {seq_len} not divisible by sp={sp}")
         self.batch_size = batch_size
         self.seq_len = seq_len
         self.tx = optax.adamw(learning_rate)
-        self.batch_sharding = NamedSharding(
-            mesh, P("dp", "sp" if seq_shard and sp > 1 else None)
-        )
+        if self.is_image:
+            # (images bhwc, labels b): batch dim sharded over dp
+            self.batch_sharding = (
+                NamedSharding(mesh, P("dp", None, None, None)),
+                NamedSharding(mesh, P("dp")),
+            )
+        else:
+            self.batch_sharding = NamedSharding(
+                mesh, P("dp", "sp" if seq_shard and sp > 1 else None)
+            )
 
         def constrain_params(params):
             return jax.tree_util.tree_map_with_path(
@@ -110,9 +121,14 @@ class ShardedTrainer:
 
         self._constrain = constrain_params
 
+        def example_input():
+            if self.is_image:
+                s = self.cfg.image_size
+                return jnp.zeros((batch_size, s, s, 3), dtype=jnp.float32)
+            return jnp.zeros((batch_size, seq_len), dtype=jnp.int32)
+
         def init_fn(rng):
-            tokens = jnp.zeros((batch_size, seq_len), dtype=jnp.int32)
-            params = self.model.init(rng, tokens)
+            params = self.model.init(rng, example_input())
             params = constrain_params(params)
             # opt state leaves are elementwise views of params; sharding
             # propagates from the constraint above
@@ -121,10 +137,16 @@ class ShardedTrainer:
 
         self._init = jax.jit(init_fn)
 
-        def loss_fn(params, tokens):
-            logits = self.model.apply(params, tokens)
+        def loss_fn(params, batch):
+            if self.is_image:
+                images, labels = batch
+                logits = self.model.apply(params, images)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, labels
+                ).mean()
+            logits = self.model.apply(params, batch)
             return optax.softmax_cross_entropy_with_integer_labels(
-                logits[:, :-1, :], tokens[:, 1:]
+                logits[:, :-1, :], batch[:, 1:]
             ).mean()
 
         def step_fn(params, opt_state, tokens):
@@ -144,10 +166,22 @@ class ShardedTrainer:
         with self.mesh:
             return self._init(jax.random.PRNGKey(seed))
 
-    def make_batch(self, seed: int = 0) -> jax.Array:
-        """A device-placed random token batch with the dp/sp sharding."""
+    def make_batch(self, seed: int = 0):
+        """A device-placed random batch with the dp(/sp) sharding: a token
+        array for LMs, an (images, labels) pair for the CNN family."""
+        key = jax.random.PRNGKey(seed)
+        if self.is_image:
+            s = self.cfg.image_size
+            k1, k2 = jax.random.split(key)
+            images = jax.random.normal(
+                k1, (self.batch_size, s, s, 3), dtype=jnp.float32
+            )
+            labels = jax.random.randint(
+                k2, (self.batch_size,), 0, self.cfg.num_classes, dtype=jnp.int32
+            )
+            return jax.device_put((images, labels), self.batch_sharding)
         tokens = jax.random.randint(
-            jax.random.PRNGKey(seed),
+            key,
             (self.batch_size, self.seq_len),
             0,
             self.cfg.vocab,

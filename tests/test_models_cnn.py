@@ -1,0 +1,66 @@
+"""CNN model family: configs, forward shapes, sharded training, profiling.
+
+The vision family plays the role of the reference's CNN-heavy Philly
+workload in the profiler microbenchmarks (SURVEY.md §2 "Throughput
+profiler").  Runs on the conftest 8-device CPU mesh.
+"""
+
+import pytest
+
+jax = pytest.importorskip("jax", reason="CNN tests need the [profiler] extra")
+import jax.numpy as jnp  # noqa: E402
+
+from gpuschedule_tpu.models import MODEL_CONFIGS, build_model
+from gpuschedule_tpu.models.config import CnnConfig
+from gpuschedule_tpu.parallel import ShardedTrainer, make_mesh
+
+
+def test_cnn_registry_and_estimates():
+    assert isinstance(MODEL_CONFIGS["resnet-tiny"], CnnConfig)
+    cfg = MODEL_CONFIGS["resnet-mid"]
+    assert cfg.param_count > MODEL_CONFIGS["resnet-tiny"].param_count > 0
+    assert cfg.flops_per_token() > 0  # per-sample FLOPs, shared interface
+
+
+def test_cnn_forward_shapes():
+    model, cfg = build_model("resnet-tiny")
+    images = jnp.zeros((2, cfg.image_size, cfg.image_size, 3), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), images)
+    logits = model.apply(params, images)
+    assert logits.shape == (2, cfg.num_classes)
+    assert logits.dtype == jnp.float32
+
+
+def test_cnn_trainer_loss_decreases_on_dp_mesh():
+    tr = ShardedTrainer("resnet-tiny", make_mesh(), batch_size=8)
+    state = tr.init(seed=0)
+    batch = tr.make_batch(seed=0)
+    losses = []
+    for _ in range(4):
+        state, loss = tr.step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert all(l == l for l in losses)
+
+
+def test_cnn_rejects_seq_shard():
+    with pytest.raises(ValueError, match="seq_shard"):
+        ShardedTrainer(
+            "resnet-tiny", make_mesh(sp=2), batch_size=8, seq_shard=True
+        )
+
+
+def test_cnn_profiles_through_harness(tmp_path):
+    from gpuschedule_tpu.profiler import CurveCache
+    from gpuschedule_tpu.profiler.harness import profile_model
+
+    cache = CurveCache(tmp_path / "curves.json")
+    # k=1 measured on a CPU device; 16/64 from the analytic ICI extension.
+    # (Measured k=2 on the virtual CPU mesh is excluded: both shards run on
+    # the same host, so dp "scaling" there is noise, not signal.)
+    curve = profile_model(
+        "resnet-tiny", ks=(1, 16, 64), batch_size=2, cache=cache
+    )
+    assert curve.step_time(1) > 0
+    assert curve.step_time(16) < curve.step_time(1)
+    assert "resnet-tiny" in CurveCache(tmp_path / "curves.json")
