@@ -22,6 +22,8 @@ block); this zoo plays that role for the JAX harness.
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -29,23 +31,57 @@ import jax.numpy as jnp
 from gpuschedule_tpu.models.config import MODEL_CONFIGS, CnnConfig, ModelConfig
 
 
+class ProjectedAttention(nn.Module):
+    """QKV/out projections around an externally supplied attention core
+    (ring attention for sequence-sharded long context).  Param names mirror
+    ``nn.SelfAttention`` (query/key/value/out) so the megatron tp partition
+    rules in :func:`gpuschedule_tpu.parallel.train.param_partition_spec`
+    apply unchanged."""
+
+    cfg: ModelConfig
+    attn_fn: Any
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = self.cfg
+        if c.d_model % c.n_heads != 0:
+            # nn.SelfAttention enforces this on the dense path; keep the
+            # ring path structurally identical instead of silently flooring
+            raise ValueError(
+                f"d_model {c.d_model} not divisible by n_heads {c.n_heads}"
+            )
+        head = c.d_model // c.n_heads
+        proj = dict(dtype=jnp.bfloat16, param_dtype=jnp.float32)
+        q = nn.DenseGeneral(features=(c.n_heads, head), name="query", **proj)(x)
+        k = nn.DenseGeneral(features=(c.n_heads, head), name="key", **proj)(x)
+        v = nn.DenseGeneral(features=(c.n_heads, head), name="value", **proj)(x)
+        o = self.attn_fn(q, k, v)  # (B, S, H, head)
+        return nn.DenseGeneral(
+            features=c.d_model, axis=(-2, -1), name="out", **proj
+        )(o)
+
+
 class Block(nn.Module):
     """Pre-LN causal self-attention + MLP block, bf16 compute."""
 
     cfg: ModelConfig
+    attn_fn: Any = None  # None -> dense SelfAttention; else (q,k,v)->out core
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         c = self.cfg
         h = nn.LayerNorm(dtype=jnp.bfloat16, name="ln1")(x)
-        h = nn.SelfAttention(
-            num_heads=c.n_heads,
-            qkv_features=c.d_model,
-            dtype=jnp.bfloat16,
-            param_dtype=jnp.float32,
-            deterministic=True,
-            name="attn",
-        )(h, mask=nn.make_causal_mask(jnp.zeros(h.shape[:2], dtype=jnp.int32)))
+        if self.attn_fn is not None:
+            h = ProjectedAttention(c, self.attn_fn, name="attn")(h)
+        else:
+            h = nn.SelfAttention(
+                num_heads=c.n_heads,
+                qkv_features=c.d_model,
+                dtype=jnp.bfloat16,
+                param_dtype=jnp.float32,
+                deterministic=True,
+                name="attn",
+            )(h, mask=nn.make_causal_mask(jnp.zeros(h.shape[:2], dtype=jnp.int32)))
         x = x + h
         h = nn.LayerNorm(dtype=jnp.bfloat16, name="ln2")(x)
         h = nn.Dense(c.d_ff, dtype=jnp.bfloat16, param_dtype=jnp.float32, name="up")(h)
@@ -58,6 +94,7 @@ class TransformerLM(nn.Module):
     """Causal LM: embed → blocks → final LN → logits (tied to f32 head)."""
 
     cfg: ModelConfig
+    attn_fn: Any = None  # optional attention core (e.g. ring attention)
 
     @nn.compact
     def __call__(self, tokens: jax.Array) -> jax.Array:
@@ -76,7 +113,7 @@ class TransformerLM(nn.Module):
         if c.remat:
             block = nn.remat(Block)  # trade FLOPs for HBM on long sequences
         for i in range(c.n_layers):
-            x = block(c, name=f"block{i}")(x)
+            x = block(c, self.attn_fn, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=jnp.bfloat16, name="ln_f")(x)
         logits = nn.Dense(
             c.vocab, dtype=jnp.bfloat16, param_dtype=jnp.float32, name="lm_head"
@@ -84,15 +121,20 @@ class TransformerLM(nn.Module):
         return logits.astype(jnp.float32)  # f32 softmax for stable loss
 
 
-def build_model(name: str):
+def build_model(name: str, *, attn_fn=None):
     """Look up a config by trace model name and build its module
-    (transformer LM or CNN classifier, per the config family)."""
+    (transformer LM or CNN classifier, per the config family).
+
+    ``attn_fn`` swaps the LM attention core — the trainer passes ring
+    attention here for sequence-sharded long-context runs."""
     try:
         cfg = MODEL_CONFIGS[name]
     except KeyError:
         raise ValueError(f"unknown model {name!r}; known: {sorted(MODEL_CONFIGS)}") from None
     if isinstance(cfg, CnnConfig):
+        if attn_fn is not None:
+            raise ValueError("attn_fn applies to transformer LMs, not CNNs")
         from gpuschedule_tpu.models.cnn import ResNet
 
         return ResNet(cfg), cfg
-    return TransformerLM(cfg), cfg
+    return TransformerLM(cfg, attn_fn), cfg

@@ -9,6 +9,7 @@ let XLA insert the collectives.  Nothing here spawns processes — under
 """
 
 from gpuschedule_tpu.parallel.mesh import make_mesh
+from gpuschedule_tpu.parallel.ringattn import ring_attention
 from gpuschedule_tpu.parallel.train import ShardedTrainer, param_partition_spec
 
-__all__ = ["make_mesh", "ShardedTrainer", "param_partition_spec"]
+__all__ = ["make_mesh", "ring_attention", "ShardedTrainer", "param_partition_spec"]

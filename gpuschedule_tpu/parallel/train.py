@@ -83,8 +83,18 @@ class ShardedTrainer:
         seq_len: int = 128,
         learning_rate: float = 1e-3,
         seq_shard: bool = False,
+        ring_attn: bool = False,
     ):
-        self.model, self.cfg = build_model(model_name)
+        attn_fn = None
+        if ring_attn:
+            # Long-context core: sequence-sharded ring attention over the
+            # sp axis (parallel/ringattn.py) instead of dense attention.
+            if not seq_shard:
+                raise ValueError("ring_attn requires seq_shard=True")
+            from gpuschedule_tpu.parallel.ringattn import ring_attention
+
+            attn_fn = partial(ring_attention, mesh=mesh, causal=True)
+        self.model, self.cfg = build_model(model_name, attn_fn=attn_fn)
         self.is_image = isinstance(self.cfg, CnnConfig)
         self.mesh = mesh
         if not self.is_image and seq_len > self.cfg.max_seq:
