@@ -347,3 +347,73 @@ def test_gandiva_config3_end_to_end():
         generate_poisson_trace(150, seed=23, util_range=(0.3, 1.0)),
     ).run()
     assert res2.avg_jct == res.avg_jct and res2.makespan == res.makespan
+
+
+# --------------------------------------------------------------------- #
+# grow-shrink
+
+
+class TestGrowShrink:
+    def _cluster(self):
+        from gpuschedule_tpu.cluster import TpuCluster
+
+        return TpuCluster("v5e", dims=(8, 8))  # 64 chips
+
+    def test_lone_job_grows_into_idle_chips(self):
+        from gpuschedule_tpu.policies.gandiva import GandivaPolicy
+        from gpuschedule_tpu.sim import Job, Simulator
+
+        job = Job("solo", 0.0, num_chips=4, duration=10_000.0)
+        sim = Simulator(self._cluster(), GandivaPolicy(grow_overhead=0.0), [job])
+        res = sim.run()
+        assert res.counters.get("grows", 0) >= 1
+        # near-linear growth onto 64 chips: finishes far faster than alone
+        # at 4 chips (10000s); even one doubling would give <= ~5000s
+        assert job.end_time < 5000.0
+
+    def test_growth_disabled_keeps_requested_size(self):
+        from gpuschedule_tpu.policies.gandiva import GandivaPolicy
+        from gpuschedule_tpu.sim import Job, Simulator
+
+        job = Job("solo", 0.0, num_chips=4, duration=1000.0)
+        sim = Simulator(
+            self._cluster(), GandivaPolicy(grow_shrink=False), [job]
+        )
+        res = sim.run()
+        assert res.counters.get("grows", 0) == 0
+        assert job.end_time == pytest.approx(1000.0)
+
+    def test_grown_job_shrinks_when_demand_arrives(self):
+        from gpuschedule_tpu.policies.gandiva import GandivaPolicy
+        from gpuschedule_tpu.sim import Job, JobState, Simulator
+
+        early = Job("early", 0.0, num_chips=8, duration=50_000.0)
+        late = Job("late", 1000.0, num_chips=32, duration=500.0)
+        sim = Simulator(
+            self._cluster(), GandivaPolicy(grow_overhead=0.0), [early, late]
+        )
+        res = sim.run()
+        # the early job grew over the whole pod; the late 32-chip gang can
+        # only start if the grown job shrank back on its arrival
+        assert late.first_start_time is not None
+        assert late.first_start_time == pytest.approx(1000.0, abs=1.0)
+        assert res.num_finished == 2
+
+    def test_growth_speed_uses_curve_not_linear(self):
+        from gpuschedule_tpu.policies.gandiva import GandivaPolicy
+        from gpuschedule_tpu.profiler import GoodputCurve
+        from gpuschedule_tpu.sim import Job, Simulator
+
+        # saturating curve: beyond 8 chips the latency term dominates and
+        # growth stops paying, so the job must NOT be grown to the full pod
+        curve = GoodputCurve((1.0, 0.0, 0.02))
+        job = Job("solo", 0.0, num_chips=8, duration=1000.0)
+        sim = Simulator(
+            self._cluster(),
+            GandivaPolicy(grow_overhead=0.0, growth_curve=curve),
+            [job],
+        )
+        sim.run()
+        # speed_factor(16, 8) with theta2=0.02: step(8)=0.285, step(16)=0.3625
+        # -> 0.786 < 1.0, growth never helps; job runs at requested size
+        assert job.end_time == pytest.approx(1000.0)

@@ -52,10 +52,23 @@ def test_golden_config2_dlas_philly():
 
 
 def test_golden_config3_gandiva():
-    """Config #3: Gandiva time-slicing + packing + migration."""
+    """Config #3: Gandiva time-slicing + packing + migration + grow-shrink.
+
+    Re-pinned when grow-shrink landed (it cuts avg JCT on this trace to a
+    third: 3253.0 -> 994.8); the no-growth behavior stays pinned below."""
     res = Simulator(
         TpuCluster("v5e"),
         make_policy("gandiva"),
+        generate_poisson_trace(150, seed=23, util_range=(0.3, 1.0)),
+    ).run()
+    pin(res, 994.7660773665356, 12298.289062599059)
+
+
+def test_golden_config3_gandiva_no_growth():
+    """Config #3 with grow_shrink off — the pre-growth pinned behavior."""
+    res = Simulator(
+        TpuCluster("v5e"),
+        make_policy("gandiva", grow_shrink=False),
         generate_poisson_trace(150, seed=23, util_range=(0.3, 1.0)),
     ).run()
     pin(res, 3253.003149994193, 28459.42)
