@@ -173,7 +173,7 @@ def cmd_compare_topology(args) -> int:
 
 def cmd_profile(args) -> int:
     from gpuschedule_tpu.profiler import CurveCache
-    from gpuschedule_tpu.profiler.harness import profile_model
+    from gpuschedule_tpu.profiler.harness import capture_trace, profile_model
 
     cache = CurveCache(args.curves)
     for model in args.model:
@@ -186,6 +186,14 @@ def cmd_profile(args) -> int:
             cache=cache,
         )
         print(json.dumps({"model": model, "theta": list(curve.theta)}))
+        if args.trace_dir:
+            path = capture_trace(
+                model,
+                f"{args.trace_dir}/{model}",
+                batch_size=args.batch_size,
+                seq_len=args.seq_len,
+            )
+            print(json.dumps({"model": model, "xprof_trace": path}))
     return 0
 
 
@@ -257,6 +265,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     prof.add_argument("--batch-size", type=int, default=8)
     prof.add_argument("--seq-len", type=int, default=128)
     prof.add_argument("--curves", required=True)
+    prof.add_argument("--trace-dir",
+                      help="also capture an xprof trace of the step here")
     prof.set_defaults(fn=cmd_profile)
 
     args = p.parse_args(argv)

@@ -53,6 +53,28 @@ def time_steps(step_fn, state, tokens, *, iters: int, repeats: int = 3):
     return statistics.median(block_times), state
 
 
+def _dp_trainer(model_name, devices, batch_size, seq_len, *, warmup=1):
+    """Shared setup for measurement and trace capture: dp mesh over the
+    devices, batch rounded down to a device multiple (one fallback formula,
+    so the traced step is exactly the measured step), compile fenced."""
+    import jax
+
+    from gpuschedule_tpu.parallel import ShardedTrainer, make_mesh
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    mesh = make_mesh(dp=len(devs), sp=1, tp=1, devices=devs)
+    bs = batch_size
+    if bs % len(devs) != 0:
+        bs = max(len(devs), bs - bs % len(devs))
+    trainer = ShardedTrainer(model_name, mesh, batch_size=bs, seq_len=seq_len)
+    state = trainer.init(seed=0)
+    batch = trainer.make_batch(seed=0)
+    for _ in range(max(1, warmup)):  # first step compiles
+        state, loss = trainer.step(state, batch)
+    float(loss)  # fence warmup/compile
+    return trainer, state, batch
+
+
 def measure_step_time(
     model_name: str,
     *,
@@ -67,24 +89,38 @@ def measure_step_time(
 
     ``repeats=1`` keeps live-profiling device time at ``iters`` steps per
     (model, k) point; bench.py uses more blocks for a stabler median."""
+    trainer, state, batch = _dp_trainer(
+        model_name, devices, batch_size, seq_len, warmup=warmup
+    )
+    step_s, _ = time_steps(trainer.step, state, batch, iters=iters, repeats=repeats)
+    return step_s
+
+
+def capture_trace(
+    model_name: str,
+    out_dir,
+    *,
+    devices: Optional[Sequence] = None,
+    batch_size: int = 8,
+    seq_len: int = 128,
+    steps: int = 3,
+) -> str:
+    """Capture an xprof (TensorBoard-viewable) trace of the train step.
+
+    The deep-inspection path of the tracing subsystem (SURVEY.md §5
+    "Tracing/profiling": ``jax.profiler.trace`` around jitted steps):
+    wall-clock medians come from :func:`time_steps`; this produces the
+    per-op timeline for when a number needs explaining.  Returns the
+    directory path; view with ``tensorboard --logdir`` or xprof.
+    """
     import jax
 
-    from gpuschedule_tpu.parallel import ShardedTrainer, make_mesh
-
-    devs = list(devices) if devices is not None else list(jax.devices())
-    mesh = make_mesh(dp=len(devs), sp=1, tp=1, devices=devs)
-    bs = batch_size
-    if bs % len(devs) != 0:
-        bs = max(len(devs), bs - bs % len(devs))
-    trainer = ShardedTrainer(model_name, mesh, batch_size=bs, seq_len=seq_len)
-    state = trainer.init(seed=0)
-    tokens = trainer.make_batch(seed=0)
-    for _ in range(warmup):
-        state, loss = trainer.step(state, tokens)
-    if warmup:
-        float(loss)  # fence warmup/compile before the clock starts
-    step_s, _ = time_steps(trainer.step, state, tokens, iters=iters, repeats=repeats)
-    return step_s
+    trainer, state, batch = _dp_trainer(model_name, devices, batch_size, seq_len)
+    with jax.profiler.trace(str(out_dir)):
+        for _ in range(steps):
+            state, loss = trainer.step(state, batch)
+        float(loss)  # host fence inside the trace window
+    return str(out_dir)
 
 
 def profile_model(

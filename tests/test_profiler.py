@@ -151,3 +151,20 @@ def test_profile_model_on_cpu_mesh(tmp_path):
     # cache persisted
     cache2 = CurveCache(tmp_path / "curves.json")
     assert "transformer-tiny" in cache2
+
+
+def test_capture_trace_writes_xprof_files(tmp_path):
+    pytest.importorskip("jax")
+    from gpuschedule_tpu.profiler.harness import capture_trace
+
+    out = capture_trace(
+        "transformer-tiny", tmp_path / "trace", batch_size=2, seq_len=32, steps=2
+    )
+    import os
+
+    files = [
+        os.path.join(r, f) for r, _, fs in os.walk(out) for f in fs
+    ]
+    assert files, "xprof trace directory is empty"
+    # xprof writes .xplane.pb event files under plugins/profile/<run>/
+    assert any("xplane" in f or "trace" in f for f in files)
