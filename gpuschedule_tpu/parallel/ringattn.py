@@ -20,8 +20,11 @@ Causality is enforced with global positions derived from
 (their logits are masked to -inf before the accumulator update).
 
 The inner function assumes it runs inside ``shard_map``;
-:func:`ring_attention` wraps it over the ambient mesh (the trainer's
-``with mesh:`` context) or an explicit one.
+:func:`ring_attention` wraps it over an explicit ``mesh=`` (what the
+trainer passes) or, failing that, the ambient mesh set with
+``jax.sharding.set_mesh``/``use_abstract_mesh``.  Note the legacy
+``with mesh:`` context does NOT populate that ambient mesh in JAX 0.9 —
+pass ``mesh=`` explicitly there.
 """
 
 from __future__ import annotations
@@ -98,15 +101,16 @@ def ring_attention(
     causal: bool = True,
 ) -> jax.Array:
     """Causal attention over (B, S, H, D) with S sharded on mesh axis
-    ``axis``; batch stays sharded on ``dp``.  Uses the ambient mesh (the
-    trainer's ``with mesh:`` scope) when ``mesh`` is None."""
+    ``axis``; batch stays sharded on ``dp``.  With ``mesh=None`` the
+    ambient mesh from ``jax.sharding.set_mesh`` is used (the legacy
+    ``with mesh:`` context does not set it — pass ``mesh=`` there)."""
     if mesh is None:
-        abstract = jax.sharding.get_abstract_mesh()
-        if abstract is None or axis not in (abstract.shape or {}):
+        shape = jax.sharding.get_abstract_mesh().shape  # empty dict if unset
+        if axis not in shape:
             raise ValueError(
-                f"no ambient mesh with axis {axis!r}; pass mesh= explicitly"
+                f"no ambient mesh with axis {axis!r} (set_mesh not in "
+                f"effect); pass mesh= explicitly"
             )
-        shape = abstract.shape
     else:
         shape = mesh.shape
     sp_size = shape[axis]
