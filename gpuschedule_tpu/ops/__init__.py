@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the benchmark models.
+
+The hot-op layer the package docstring promises: hand-written kernels for
+ops where explicit VMEM blocking beats what XLA fusion produces.  Each op
+degrades gracefully off-TPU (pallas interpret mode), so the same code path
+runs in CPU-mesh tests and on real chips.
+"""
+
+from gpuschedule_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

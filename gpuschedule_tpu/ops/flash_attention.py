@@ -1,0 +1,199 @@
+"""Flash attention forward kernel in pallas (TPU).
+
+Blockwise causal attention that never materializes the (S, S) score
+matrix — and never holds more than one K/V *block* in VMEM: the grid is
+(batch*heads, q-blocks, k-blocks) with the K/V block index innermost, so
+pallas streams (block_k, d) tiles HBM→VMEM while the online-softmax state
+(running max, denominator, weighted numerator) is carried across k steps
+in VMEM scratch.  Peak on-chip footprint is O(block_q * d + block_k * d),
+independent of S — the property that makes long sequences fit.  This is
+the single-chip sibling of the cross-chip ring in
+:mod:`gpuschedule_tpu.parallel.ringattn`: same math, different memory
+system (VMEM blocking vs ICI ppermute).
+
+Backward runs as a dense XLA recompute (``jax.custom_vjp`` over the
+shared oracle in :mod:`gpuschedule_tpu.ops.reference`).  Head dim and
+sequence length are padded to lane/block multiples and unpadded on the
+way out, so any model shape works.
+
+Off-TPU the kernel runs in pallas interpret mode automatically, so CPU
+tests exercise the very same code path the chip compiles.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from gpuschedule_tpu.ops.reference import NEG_INF, dense_attention
+
+def _reference(q, k, v, causal):
+    """Positional-arg shim over the shared oracle (test-facing name)."""
+    return dense_attention(q, k, v, causal=causal)
+
+
+def _pick_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, block_q, block_k, causal, sm_scale, seq_len,
+):
+    """Grid (bh, qi, kb), kb innermost: scratch carries the online-softmax
+    state across k blocks of one (bh, qi); the output block is written on
+    the last k step."""
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        cols = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        valid = cols < seq_len  # mask sequence padding
+        if causal:
+            valid = jnp.logical_and(valid, rows >= cols)
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev, l_prev, acc_prev = m_ref[...], l_ref[...], acc_ref[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * corr + p.sum(axis=-1, keepdims=True)
+        acc_ref[...] = acc_prev * corr + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        # k blocks wholly above the diagonal contribute nothing
+        @pl.when(kb * block_k <= qi * block_q + (block_q - 1))
+        def _():
+            _update()
+    else:
+        _update()
+
+    @pl.when(kb == nk - 1)
+    def _finalize():
+        o_ref[0] = (
+            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def _pad_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _flash_fwd_impl(q, k, v, *, causal, block_q, block_k, interpret):
+    b, s, h, d = q.shape
+    sm_scale = 1.0 / (d ** 0.5)
+    # S padded to a common multiple of both block sizes so every K/V block
+    # in the grid is fully in-bounds and every valid column is visited
+    s_mult = math.lcm(block_q, block_k)
+
+    def prep(x):  # (B, S, H, D) -> (B*H, S_pad, D_pad)
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
+        return _pad_to(_pad_to(x, 1, s_mult), 2, 128)
+
+    qp, kp, vp = prep(q), prep(k), prep(v)
+    bh, s_pad, d_pad = qp.shape
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        causal=causal,
+        sm_scale=sm_scale,
+        seq_len=s,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, s_pad // block_q, s_pad // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda i, j, kb: (i, kb, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d_pad), lambda i, j, kb: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s_pad, d_pad), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),       # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),       # running denom
+            pltpu.VMEM((block_q, d_pad), jnp.float32),   # running numerator
+        ],
+        interpret=interpret if interpret is not None else _pick_interpret(),
+    )(qp, kp, vp)
+    out = out[:, :s, :d].reshape(b, h, s, d)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_fwd_impl(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+    # Dense XLA recompute: correctness-first backward.  The forward kernel
+    # is where the O(S^2) activation memory was; grads reuse autodiff.
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: dense_attention(q, k, v, causal=causal), q, k, v
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Blockwise attention over (B, S, H, D); differentiable.
+
+    ``interpret=None`` auto-selects pallas interpret mode off-TPU.  The
+    call signature matches the model zoo's ``attn_fn`` hook, so
+    ``ShardedTrainer(..., flash_attn=True)`` drops it into any LM."""
+    if q.shape != k.shape or q.shape != v.shape:
+        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
+    if q.ndim != 4:
+        raise ValueError(f"expected (B, S, H, D), got {q.shape}")
+    return _flash(q, k, v, causal, block_q, block_k, interpret)

@@ -1,0 +1,29 @@
+"""Dense-attention oracle shared by the kernel tests and backward passes.
+
+One implementation, imported by both the pallas flash kernel
+(:mod:`gpuschedule_tpu.ops.flash_attention` — its recompute backward) and
+the ring-attention layer/tests (:mod:`gpuschedule_tpu.parallel.ringattn`),
+so the numerical ground truth cannot drift between them.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # mask value: exp(NEG_INF - m) underflows to exactly 0 in f32
+
+
+def dense_attention(q, k, v, *, causal: bool) -> jax.Array:
+    """Plain (B, S, H, D) attention; f32 math, input dtype out."""
+    d = q.shape[-1]
+    logits = jnp.einsum(
+        "blhd,bmhd->bhlm", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) / jnp.sqrt(jnp.float32(d))
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        mask = jnp.arange(s_q)[:, None] >= jnp.arange(s_k)[None, :]
+        logits = jnp.where(mask[None, None, :, :], logits, NEG_INF)
+    w = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhlm,bmhd->blhd", w, v.astype(jnp.float32))
+    return out.astype(q.dtype)

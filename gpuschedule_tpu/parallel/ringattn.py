@@ -130,15 +130,8 @@ def ring_attention(
 
 
 def _plain_causal_attention(q, k, v, *, causal: bool) -> jax.Array:
-    """Reference implementation — also the test oracle."""
-    d = q.shape[-1]
-    logits = jnp.einsum(
-        "blhd,bmhd->bhlm", q.astype(jnp.float32), k.astype(jnp.float32)
-    ) / jnp.sqrt(jnp.float32(d))
-    if causal:
-        s_q, s_k = q.shape[1], k.shape[1]
-        mask = jnp.arange(s_q)[:, None] >= jnp.arange(s_k)[None, :]
-        logits = jnp.where(mask[None, None, :, :], logits, _NEG)
-    w = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhlm,bmhd->blhd", w, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    """Reference implementation — the shared oracle from ops/reference.py
+    (one ground truth for both the ring layer and the pallas kernel)."""
+    from gpuschedule_tpu.ops.reference import dense_attention
+
+    return dense_attention(q, k, v, causal=causal)

@@ -84,8 +84,11 @@ class ShardedTrainer:
         learning_rate: float = 1e-3,
         seq_shard: bool = False,
         ring_attn: bool = False,
+        flash_attn: bool = False,
     ):
         attn_fn = None
+        if ring_attn and flash_attn:
+            raise ValueError("ring_attn and flash_attn are mutually exclusive")
         if ring_attn:
             # Long-context core: sequence-sharded ring attention over the
             # sp axis (parallel/ringattn.py) instead of dense attention.
@@ -94,6 +97,28 @@ class ShardedTrainer:
             from gpuschedule_tpu.parallel.ringattn import ring_attention
 
             attn_fn = partial(ring_attention, mesh=mesh, causal=True)
+        elif flash_attn:
+            # Single-device blockwise core (ops/flash_attention.py): pallas
+            # runs per device, so shard_map over the batch/head axes; the
+            # sequence stays whole on each device (use ring_attn to shard it).
+            if seq_shard:
+                raise ValueError("flash_attn keeps S per-device; use ring_attn "
+                                 "for sequence sharding")
+            from gpuschedule_tpu.ops import flash_attention
+
+            fa_spec = P("dp", None, "tp" if mesh.shape["tp"] > 1 else None, None)
+
+            def attn_fn(q, k, v):
+                return jax.shard_map(
+                    lambda q, k, v: flash_attention(q, k, v, causal=True),
+                    mesh=mesh,
+                    in_specs=(fa_spec, fa_spec, fa_spec),
+                    out_specs=fa_spec,
+                    # pallas_call emits ShapeDtypeStruct without vma info;
+                    # the kernel is elementwise-independent per device, so
+                    # the varying-mesh-axes check adds nothing here
+                    check_vma=False,
+                )(q, k, v)
         self.model, self.cfg = build_model(model_name, attn_fn=attn_fn)
         self.is_image = isinstance(self.cfg, CnnConfig)
         self.mesh = mesh
