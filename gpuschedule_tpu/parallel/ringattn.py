@@ -37,7 +37,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-_NEG = -1e30  # mask value: exp(_NEG - m) underflows to exactly 0 in f32
+from gpuschedule_tpu.ops.reference import NEG_INF, dense_attention
 
 
 def _ring_attention_local(
@@ -56,7 +56,7 @@ def _ring_attention_local(
     qf = q.astype(jnp.float32) * scale
     my_idx = lax.axis_index(axis)
 
-    m = jnp.full((b, h, l_q), _NEG, jnp.float32)        # running max
+    m = jnp.full((b, h, l_q), NEG_INF, jnp.float32)        # running max
     denom = jnp.zeros((b, h, l_q), jnp.float32)          # running sum exp
     num = jnp.zeros((b, h, l_q, d), jnp.float32)         # running sum exp*V
 
@@ -74,7 +74,7 @@ def _ring_attention_local(
         if causal:
             pos_k = src * k_blk.shape[1] + jnp.arange(k_blk.shape[1])
             mask = pos_q[:, None] >= pos_k[None, :]
-            logits = jnp.where(mask[None, None, :, :], logits, _NEG)
+            logits = jnp.where(mask[None, None, :, :], logits, NEG_INF)
         m_new = jnp.maximum(m, logits.max(axis=-1))
         corr = jnp.exp(m - m_new)
         p_ij = jnp.exp(logits - m_new[..., None])
@@ -132,6 +132,4 @@ def ring_attention(
 def _plain_causal_attention(q, k, v, *, causal: bool) -> jax.Array:
     """Reference implementation — the shared oracle from ops/reference.py
     (one ground truth for both the ring layer and the pallas kernel)."""
-    from gpuschedule_tpu.ops.reference import dense_attention
-
     return dense_attention(q, k, v, causal=causal)

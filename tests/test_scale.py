@@ -127,6 +127,21 @@ class TestScale:
                 )
             started_set |= batch
 
+    def test_10k_jobs_dlas_bounded(self):
+        """Tiresias-DLAS at 10k jobs: quantum wakeups + per-event priority
+        pass stay tractable on a drained system."""
+        from gpuschedule_tpu.policies.dlas import DlasPolicy
+
+        jobs = generate_poisson_trace(
+            10_000, seed=17, arrival_rate=1.0 / 30.0, mean_duration=600.0
+        )
+        sim = Simulator(SimpleCluster(256), DlasPolicy(thresholds=(3600.0,)), jobs)
+        t0 = time.perf_counter()
+        result = sim.run()
+        elapsed = time.perf_counter() - t0
+        assert result.num_finished == 10_000
+        assert elapsed < 60.0, f"10k-job DLAS replay took {elapsed:.1f}s"
+
     def test_10k_jobs_srtf_bounded(self):
         """Preemptive SRTF at 10k jobs stays tractable (its per-event sort is
         over the *active* set, which stays bounded on a drained system)."""
