@@ -1,8 +1,27 @@
-"""Single-chip training-throughput benchmark.
+"""Single-chip training-throughput benchmark, hardened against tunnel hangs.
 
-Runs the flagship model's full jitted train step (fwd + bwd + adamw) on the
-real TPU chip, times the median step after warmup/compile, and prints ONE
-JSON line with tokens/s and model FLOPs utilization.
+Prints exactly ONE JSON line on stdout, always.
+
+Two-process architecture (why: the axon TPU tunnel has twice eaten the
+driver's whole bench budget by hanging *silently* at backend init —
+``jax.devices()`` blocked >240 s with no exception, so in-process
+retry/except logic never fires; BENCH_r01 ``parsed: null``, BENCH_r02
+``rc: 124``):
+
+* **Parent** (this file, no args): never touches the JAX backend.  Runs each
+  measurement attempt as a subprocess in its own session with a hard
+  wall-clock timeout, SIGKILLs the whole process group on expiry, and falls
+  back from the flagship ``transformer-large`` to the faster-compiling
+  ``transformer-base``.  On the first successful attempt it relays the
+  child's JSON line; if every attempt fails it prints a
+  ``{"metric": "bench-failed: ...", ...}`` diagnostic carrying each
+  attempt's last reported stage.  Total wall-clock is bounded well inside
+  the driver's budget.
+* **Child** (``--child MODEL``): the actual measurement — full jitted train
+  step (fwd + bwd + adamw) on the real chip, median step time after
+  warmup/compile, fenced by host readbacks (``block_until_ready`` does not
+  fence execution on this transport — see profiler/harness.py).  Reports
+  progress stages on stderr so a hang is attributable.
 
 ``vs_baseline``: BASELINE.json records no published reference numbers
 (``"published": {}``), so the comparison is against the roofline-derived
@@ -13,52 +32,91 @@ single-chip train step.  vs_baseline = achieved_MFU / 0.30; >= 1.0 beats it.
 from __future__ import annotations
 
 import json
+import os
+import signal
+import subprocess
+import sys
 import time
 
-
-MODEL = "transformer-large"   # highest-MFU config in the zoo (62% on v5e)
 BATCH = 8
 SEQ = 512
 WARMUP = 3
 ITERS = 10
 TARGET_MFU = 0.30
 
+# (model, hard timeout seconds).  transformer-large is the flagship (62% MFU
+# config — models/config.py); transformer-base compiles faster and is the
+# fallback if the tunnel is slow rather than dead.  Worst case ~8.5 min of
+# attempts, far inside the driver's budget (r02 ran >26 min before rc=124).
+# Overridable for tests: GSTPU_BENCH_MODELS="m1,m2" GSTPU_BENCH_TIMEOUT=30.
+def _attempt_plan():
+    models = os.environ.get("GSTPU_BENCH_MODELS")
+    if models:
+        t = int(os.environ.get("GSTPU_BENCH_TIMEOUT", "120"))
+        return [(m.strip(), t) for m in models.split(",") if m.strip()]
+    return [
+        ("transformer-large", 180),
+        ("transformer-large", 180),  # transient pool-busy deserves a flagship retry
+        ("transformer-base", 160),
+    ]
 
-def _first_device(attempts: int = 3, wait_s: float = 30.0):
-    """The axon TPU tunnel claims a chip from a pool at first backend touch;
-    transient UNAVAILABLE errors are worth a couple of retries before
-    giving up on the round's perf signal."""
+
+RETRY_PAUSE_S = 5.0
+
+
+def _stage(msg: str) -> None:
+    """Child-side progress marker; the parent reports the last one seen when
+    an attempt times out, turning a silent hang into a located hang."""
+    print(f"STAGE: {msg}", file=sys.stderr, flush=True)
+
+
+def child_main(model: str) -> None:
+    _stage("import-jax")
     import jax
 
-    for i in range(attempts):
-        try:
-            return jax.devices()[0]
-        except RuntimeError as e:
-            if "UNAVAILABLE" not in str(e) or i == attempts - 1:
-                raise
-            time.sleep(wait_s)
-    raise RuntimeError("unreachable")
+    # Test hook: sitecustomize registers the axon TPU plugin at interpreter
+    # boot, which overrides the JAX_PLATFORMS env var — only a programmatic
+    # config update before first backend access can force CPU (same trick
+    # as tests/conftest.py).  Production runs leave this unset.
+    plat = os.environ.get("GSTPU_BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
 
-
-def main() -> None:
     from gpuschedule_tpu.cluster.tpu import GENERATIONS
     from gpuschedule_tpu.parallel import ShardedTrainer, make_mesh
-
     from gpuschedule_tpu.profiler.harness import time_steps
 
-    dev = _first_device()
+    _stage("devices")  # first backend touch — where the tunnel hangs
+    dev = None
+    for i in range(3):
+        try:
+            dev = jax.devices()[0]
+            break
+        except RuntimeError as e:
+            # Transient pool exhaustion raises UNAVAILABLE (unlike the silent
+            # init hang, which only the parent's watchdog can handle); worth
+            # riding out in-child where the 180s attempt budget covers it.
+            if "UNAVAILABLE" not in str(e) or i == 2:
+                raise
+            _stage(f"devices-retry-{i + 1}")
+            time.sleep(30.0)
+
+    _stage("setup")
     mesh = make_mesh(dp=1, sp=1, tp=1, devices=[dev])
-    trainer = ShardedTrainer(MODEL, mesh, batch_size=BATCH, seq_len=SEQ)
+    trainer = ShardedTrainer(model, mesh, batch_size=BATCH, seq_len=SEQ)
     state = trainer.init(seed=0)
     tokens = trainer.make_batch(seed=0)
 
+    _stage("compile")
     loss = None
     for _ in range(WARMUP):  # first call compiles (~20-40s)
         state, loss = trainer.step(state, tokens)
-    float(loss)  # host readback: block_until_ready does not fence execution
-                 # on the axon tunnel (see profiler/harness.py docstring)
+    float(loss)  # host readback: the only fence this transport honors
 
-    step_s, state = time_steps(trainer.step, state, tokens, iters=ITERS)
+    _stage("measure")
+    # 3 fenced blocks of ITERS chained steps; the reported figure is the
+    # median of the 3 per-block means (see time_steps).
+    step_s, state = time_steps(trainer.step, state, tokens, iters=ITERS, repeats=3)
     # flops_per_token() is per-token for LMs, per-SAMPLE for CNN configs
     # (models/config.py) — scale by the matching unit count.
     units = BATCH if trainer.is_image else BATCH * SEQ
@@ -75,15 +133,103 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": f"{MODEL} train-step {unit_name}/s (b{BATCH}xs{SEQ}, 1 chip, "
-                f"median of {ITERS}; mfu={mfu:.3f} @ {achieved_tflops:.1f} TF on {gen})",
+                "metric": f"{model} train-step {unit_name}/s (b{BATCH}xs{SEQ}, 1 chip, "
+                f"median of 3x{ITERS}-step blocks; "
+                f"mfu={mfu:.3f} @ {achieved_tflops:.1f} TF on {gen})",
                 "value": round(tokens_per_s, 1),
                 "unit": f"{unit_name}/s",
                 "vs_baseline": round(mfu / TARGET_MFU, 3),
             }
-        )
+        ),
+        flush=True,
+    )
+
+
+def _run_attempt(model: str, timeout_s: int) -> tuple:
+    """Run one child attempt.  Returns (parsed_json_or_None, failure_note)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-u", os.path.abspath(__file__), "--child", model],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        start_new_session=True,  # own process group: killable even mid-hang
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired as exc:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            # Bounded: a grandchild outside the session could hold the pipe
+            # write ends open past the SIGKILL; abandon the pipes rather
+            # than let the watchdog itself hang.
+            out, err = proc.communicate(timeout=5)
+        except subprocess.TimeoutExpired:
+            err = (exc.stderr or b"").decode("utf-8", "replace") if isinstance(
+                exc.stderr, bytes
+            ) else (exc.stderr or "")
+        stage = _last_stage(err)
+        return None, f"{model}: timeout {timeout_s}s at stage '{stage}'"
+    # Scan stdout for the metric line even on nonzero rc: the experimental
+    # axon plugin can crash at interpreter teardown AFTER the result was
+    # flushed — a captured number beats a clean exit code.
+    for line in reversed((out or "").strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(parsed, dict) and "metric" in parsed:
+            return parsed, ""
+    if proc.returncode != 0:
+        tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
+        return None, f"{model}: rc={proc.returncode} ({tail[0][:160]})"
+    return None, f"{model}: rc=0 but no JSON line on stdout"
+
+
+def _last_stage(err: str) -> str:
+    stage = "start"
+    for line in (err or "").splitlines():
+        if line.startswith("STAGE: "):
+            stage = line[len("STAGE: "):].strip()
+    return stage
+
+
+def main() -> None:
+    failures = []
+    try:
+        attempts = _attempt_plan()
+        for i, (model, timeout_s) in enumerate(attempts):
+            parsed, note = _run_attempt(model, timeout_s)
+            if parsed is not None:
+                print(json.dumps(parsed), flush=True)
+                return
+            failures.append(note)
+            print(f"attempt {i + 1} failed: {note}", file=sys.stderr, flush=True)
+            if i + 1 < len(attempts):
+                time.sleep(RETRY_PAUSE_S)
+        reason = "all TPU attempts hung or errored (axon tunnel backend-init hang is the known cause)"
+    except Exception as exc:  # the one-JSON-line contract holds even for
+        failures.append(f"parent error: {type(exc).__name__}: {exc}")  # parent bugs
+        reason = "parent-side exception"
+    print(
+        json.dumps(
+            {
+                "metric": f"bench-failed: {reason}",
+                "value": 0.0,
+                "unit": "tokens/s",
+                "vs_baseline": 0.0,
+                "attempts": failures,
+            }
+        ),
+        flush=True,
     )
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        child_main(sys.argv[2])
+    else:
+        main()

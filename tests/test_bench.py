@@ -1,0 +1,109 @@
+"""bench.py watchdog tests — the round-3 acceptance for VERDICT item #1.
+
+The driver's perf signal died twice to a silent axon backend-init hang
+(BENCH_r01 ``parsed: null``, BENCH_r02 ``rc: 124``), so the contract under
+test is: *whatever the tunnel does — hang, error, or work — the parent
+process prints exactly one JSON line with a ``metric`` key, inside a
+bounded wall-clock*.  The hang is simulated with a short hard timeout
+against a child that sleeps; the success path runs the real child on the
+CPU backend with a small model.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+sys.path.insert(0, REPO)
+import bench  # noqa: E402
+
+
+def _cpu_env(**extra):
+    env = dict(os.environ)
+    # GSTPU_BENCH_PLATFORM (not JAX_PLATFORMS) because sitecustomize's axon
+    # plugin registration overrides the env var; the child applies it via
+    # jax.config.update before first backend access.
+    env["GSTPU_BENCH_PLATFORM"] = "cpu"
+    env.pop("GSTPU_BENCH_MODELS", None)
+    env.pop("GSTPU_BENCH_TIMEOUT", None)
+    env.update(extra)
+    return env
+
+
+def _one_json_line(stdout: str) -> dict:
+    lines = [ln for ln in stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line, got {lines!r}"
+    parsed = json.loads(lines[0])
+    assert isinstance(parsed, dict) and "metric" in parsed
+    return parsed
+
+
+def test_last_stage_parses_progress_markers():
+    err = "noise\nSTAGE: import-jax\nSTAGE: devices\nwarning: xyz\n"
+    assert bench._last_stage(err) == "devices"
+    assert bench._last_stage("") == "start"
+    assert bench._last_stage(None) == "start"
+
+
+def test_main_failure_path_always_prints_one_json_line(monkeypatch, capsys):
+    monkeypatch.setattr(bench, "_attempt_plan", lambda: [("m", 1), ("m", 1)])
+    monkeypatch.setattr(bench, "RETRY_PAUSE_S", 0.0)
+    monkeypatch.setattr(bench, "_run_attempt", lambda m, t: (None, f"{m}: boom"))
+    bench.main()
+    parsed = _one_json_line(capsys.readouterr().out)
+    assert parsed["metric"].startswith("bench-failed")
+    assert parsed["value"] == 0.0 and parsed["vs_baseline"] == 0.0
+    assert parsed["attempts"] == ["m: boom", "m: boom"]
+
+
+def test_main_success_path_relays_child_json(monkeypatch, capsys):
+    good = {"metric": "x", "value": 1.0, "unit": "u", "vs_baseline": 2.0}
+    calls = []
+
+    def fake(m, t):
+        calls.append(m)
+        return (None, "hang") if len(calls) == 1 else (good, "")
+
+    monkeypatch.setattr(bench, "_attempt_plan", lambda: [("a", 1), ("b", 1)])
+    monkeypatch.setattr(bench, "RETRY_PAUSE_S", 0.0)
+    monkeypatch.setattr(bench, "_run_attempt", fake)
+    bench.main()
+    assert _one_json_line(capsys.readouterr().out) == good
+    assert calls == ["a", "b"]  # fallback engaged after the first failure
+
+
+@pytest.mark.slow
+def test_end_to_end_success_on_cpu_backend():
+    """Full parent→child round trip with a model small enough for CPU."""
+    env = _cpu_env(GSTPU_BENCH_MODELS="transformer-tiny", GSTPU_BENCH_TIMEOUT="300")
+    proc = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True, env=env,
+        timeout=360,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    parsed = _one_json_line(proc.stdout)
+    assert parsed["value"] > 0 and parsed["unit"] == "tokens/s"
+    assert "transformer-tiny" in parsed["metric"]
+
+
+def test_hung_child_is_killed_and_reported():
+    """A child that can never finish inside the timeout must be SIGKILLed
+    and the parent must still emit the diagnostic line, promptly."""
+    env = _cpu_env(GSTPU_BENCH_MODELS="transformer-large", GSTPU_BENCH_TIMEOUT="2")
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True, env=env,
+        timeout=120,
+    )
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0
+    parsed = _one_json_line(proc.stdout)
+    assert parsed["metric"].startswith("bench-failed")
+    assert any("timeout 2s at stage" in a for a in parsed["attempts"])
+    assert elapsed < 60, f"watchdog too slow: {elapsed:.0f}s"
