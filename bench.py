@@ -155,9 +155,11 @@ def _run_attempt(model: str, timeout_s: int) -> tuple:
         start_new_session=True,  # own process group: killable even mid-hang
         cwd=os.path.dirname(os.path.abspath(__file__)),
     )
+    timed_out = False
     try:
         out, err = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired as exc:
+        timed_out = True
         try:
             os.killpg(proc.pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
@@ -168,14 +170,13 @@ def _run_attempt(model: str, timeout_s: int) -> tuple:
             # than let the watchdog itself hang.
             out, err = proc.communicate(timeout=5)
         except subprocess.TimeoutExpired:
+            out = ""
             err = (exc.stderr or b"").decode("utf-8", "replace") if isinstance(
                 exc.stderr, bytes
             ) else (exc.stderr or "")
-        stage = _last_stage(err)
-        return None, f"{model}: timeout {timeout_s}s at stage '{stage}'"
-    # Scan stdout for the metric line even on nonzero rc: the experimental
-    # axon plugin can crash at interpreter teardown AFTER the result was
-    # flushed — a captured number beats a clean exit code.
+    # Scan stdout for the metric line even on timeout or nonzero rc: the
+    # experimental axon plugin can hang or crash at interpreter teardown
+    # AFTER the result was flushed — a captured number beats a clean exit.
     for line in reversed((out or "").strip().splitlines()):
         try:
             parsed = json.loads(line)
@@ -183,6 +184,8 @@ def _run_attempt(model: str, timeout_s: int) -> tuple:
             continue
         if isinstance(parsed, dict) and "metric" in parsed:
             return parsed, ""
+    if timed_out:
+        return None, f"{model}: timeout {timeout_s}s at stage '{_last_stage(err)}'"
     if proc.returncode != 0:
         tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
         return None, f"{model}: rc={proc.returncode} ({tail[0][:160]})"

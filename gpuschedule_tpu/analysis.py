@@ -41,19 +41,63 @@ def summarize(results: Dict[str, SimResult]) -> Dict[str, dict]:
     return {name: res.summary() for name, res in results.items()}
 
 
+ACCEPTANCE_THRESHOLD_PCT = 5.0  # fixed by the BASELINE.json:5 contract
+
+
+def acceptance_band(baseline: SimResult, candidate: SimResult) -> dict:
+    """The BASELINE.json:5 contract, computed: is the TPU replay's avg-JCT
+    and makespan within 5% of the GPU-backed baseline?
+
+    Deltas are signed percentages relative to the baseline (negative =
+    candidate better).  "Within" is one-sided: a candidate that *beats* the
+    baseline by more than the threshold still satisfies the contract — the
+    band bounds regression, not improvement.  A delta is ``None`` (and the
+    verdict False) when the baseline metric is zero with a nonzero
+    candidate — undefined rather than infinite, so the dict stays strict
+    JSON.
+    """
+    b, c = baseline.summary(), candidate.summary()
+
+    def delta(key: str):
+        if b[key] == 0:
+            return 0.0 if c[key] == 0 else None
+        return 100.0 * (c[key] - b[key]) / b[key]
+
+    jct = delta("avg_jct")
+    mk = delta("makespan")
+    t = ACCEPTANCE_THRESHOLD_PCT
+    return {
+        "jct_delta_pct": jct,
+        "makespan_delta_pct": mk,
+        "threshold_pct": t,
+        "within_5pct": jct is not None and mk is not None and jct <= t and mk <= t,
+    }
+
+
 def write_report(
     results: Dict[str, SimResult],
     out_dir: str | Path,
     *,
     prefix: str = "",
+    extra: Optional[dict] = None,
 ) -> None:
     """Persist a comparison: summary JSON + per-config JCT CDF CSVs +
-    a markdown table (the notebook's bar-chart data in text form)."""
+    a markdown table (the notebook's bar-chart data in text form).
+
+    ``extra`` entries (e.g. the :func:`acceptance_band` verdict) are merged
+    into the summary JSON under their own keys and appended to the report.
+    """
     out = Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
     summary = summarize(results)
+    payload = dict(summary)
+    if extra:
+        overlap = set(extra) & set(payload)
+        if overlap:
+            raise ValueError(f"extra keys collide with config names: {sorted(overlap)}")
+        payload.update(extra)
     with open(out / f"{prefix}summary.json", "w") as f:
-        json.dump(summary, f, indent=2, sort_keys=True)
+        json.dump(payload, f, indent=2, sort_keys=True)
     for name, res in results.items():
         with open(out / f"{prefix}cdf_{name}.csv", "w", newline="") as f:
             w = csv.writer(f)
@@ -70,4 +114,17 @@ def write_report(
             f"{s['p95_queueing_delay']:.1f} | {s['mean_utilization']:.3f} | "
             f"{int(s['num_finished'])} | {int(s.get('num_rejected', 0))} |"
         )
+    if extra and "acceptance" in extra:
+        a = extra["acceptance"]
+
+        def fmt(d):
+            return "undefined (zero baseline)" if d is None else f"{d:+.2f}%"
+
+        lines += [
+            "",
+            f"**Acceptance (BASELINE.json:5, ±{a['threshold_pct']:g}% band):** "
+            f"avg-JCT delta {fmt(a['jct_delta_pct'])}, "
+            f"makespan delta {fmt(a['makespan_delta_pct'])} vs the GPU-backed "
+            f"baseline → {'WITHIN' if a['within_5pct'] else 'OUTSIDE'} the band.",
+        ]
     (out / f"{prefix}report.md").write_text("\n".join(lines) + "\n")

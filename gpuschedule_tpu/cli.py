@@ -84,15 +84,20 @@ def load_jobs(args) -> List:
     raise SystemExit("provide one of --philly / --trace / --synthetic N")
 
 
-def build_policy(args):
+def _parse_policy_kwargs(pairs) -> dict:
     kwargs = {}
-    for kv in args.policy_arg or []:
+    for kv in pairs or []:
         k, _, v = kv.partition("=")
         try:
             parsed = json.loads(v)
         except json.JSONDecodeError:
             parsed = v
         kwargs[k.replace("-", "_")] = parsed
+    return kwargs
+
+
+def build_policy(args):
+    kwargs = _parse_policy_kwargs(args.policy_arg)
     if args.policy == "optimus" and args.curves:
         from gpuschedule_tpu.profiler import CurveCache
 
@@ -140,8 +145,17 @@ def cmd_gen_trace(args) -> int:
 
 
 def cmd_compare_topology(args) -> int:
-    """BASELINE config #5: NVLink GPU nodes vs contiguous TPU slices."""
-    from gpuschedule_tpu.analysis import write_report
+    """BASELINE config #5: NVLink GPU nodes vs contiguous TPU slices.
+
+    Computes the BASELINE.json:5 acceptance band — the TPU-v5p replay's
+    avg-JCT/makespan delta vs the GPU-backed baseline (the consolidated
+    scheme, the reference lineage's YARN-ish default) on the same trace —
+    and averages the random-placement scheme over ``--seeds`` draws so the
+    GPU-vs-TPU contrast is not a single sample.
+    """
+    from statistics import mean
+
+    from gpuschedule_tpu.analysis import acceptance_band, write_report
 
     def jobs():
         if args.philly:
@@ -149,25 +163,41 @@ def cmd_compare_topology(args) -> int:
         return generate_poisson_trace(args.synthetic or 200, seed=args.seed)
 
     gpu_shape = _parse_dims(args.gpu_shape)
-    configs = {
-        "gpu-consolidated": GpuCluster(
+
+    def gpu(scheme: str, seed: int = 0) -> GpuCluster:
+        return GpuCluster(
             num_switches=gpu_shape[0], nodes_per_switch=gpu_shape[1],
-            gpus_per_node=gpu_shape[2], scheme="consolidated"),
-        "gpu-random": GpuCluster(
-            num_switches=gpu_shape[0], nodes_per_switch=gpu_shape[1],
-            gpus_per_node=gpu_shape[2], scheme="random"),
-        "gpu-topology": GpuCluster(
-            num_switches=gpu_shape[0], nodes_per_switch=gpu_shape[1],
-            gpus_per_node=gpu_shape[2], scheme="topology"),
+            gpus_per_node=gpu_shape[2], scheme=scheme, seed=seed)
+
+    configs = {"gpu-consolidated": gpu("consolidated")}
+    for s in range(max(1, args.seeds)):
+        configs[f"gpu-random-s{s}"] = gpu("random", seed=s)
+    configs.update({
+        "gpu-topology": gpu("topology"),
         "tpu-v5p": TpuCluster("v5p"),
         "tpu-v5e": TpuCluster("v5e"),
-    }
+    })
+    pol_kwargs = _parse_policy_kwargs(args.policy_arg)
     results = {}
     for name, cluster in configs.items():
-        results[name] = Simulator(cluster, make_policy(args.policy), jobs()).run()
-    print(json.dumps({k: v.summary() for k, v in results.items()}, sort_keys=True))
+        results[name] = Simulator(
+            cluster, make_policy(args.policy, **pol_kwargs), jobs()
+        ).run()
+
+    rand = [results[k] for k in results if k.startswith("gpu-random-s")]
+    extra = {
+        "acceptance": acceptance_band(results["gpu-consolidated"], results["tpu-v5p"]),
+        "gpu-random-mean": {
+            "avg_jct": mean(r.avg_jct for r in rand),
+            "makespan": mean(r.makespan for r in rand),
+            "seeds": len(rand),
+        },
+    }
+    out = {k: v.summary() for k, v in results.items()}
+    out.update(extra)
+    print(json.dumps(out, sort_keys=True))
     if args.out:
-        write_report(results, args.out)
+        write_report(results, args.out, extra=extra)
     return 0
 
 
@@ -251,10 +281,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     cmp_ = sub.add_parser("compare-topology",
                           help="config #5: GPU placement schemes vs TPU slices")
     cmp_.add_argument("--policy", choices=available(), default="fifo")
+    cmp_.add_argument("--policy-arg", action="append", metavar="K=V",
+                      help="policy constructor kwarg (JSON values), e.g. "
+                           "backfill=true")
     cmp_.add_argument("--philly")
     cmp_.add_argument("--synthetic", type=int)
     cmp_.add_argument("--seed", type=int, default=0)
     cmp_.add_argument("--gpu-shape", default="4x8x8")
+    cmp_.add_argument("--seeds", type=int, default=1,
+                      help="random-placement draws to average (config #5 "
+                           "seed sweep)")
     cmp_.add_argument("--out")
     cmp_.set_defaults(fn=cmd_compare_topology)
 

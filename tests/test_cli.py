@@ -91,15 +91,23 @@ def test_compare_topology_writes_report(tmp_path, capsys):
     rc, out = run_cli(
         capsys,
         "compare-topology", "--synthetic", "40", "--seed", "5",
-        "--gpu-shape", "2x4x8", "--out", str(tmp_path),
+        "--gpu-shape", "2x4x8", "--seeds", "2", "--out", str(tmp_path),
     )
     assert rc == 0
     summary = json.loads(out[-1])
     assert set(summary) == {
-        "gpu-consolidated", "gpu-random", "gpu-topology", "tpu-v5p", "tpu-v5e"
+        "gpu-consolidated", "gpu-random-s0", "gpu-random-s1", "gpu-topology",
+        "tpu-v5p", "tpu-v5e", "acceptance", "gpu-random-mean",
     }
+    acc = summary["acceptance"]
+    assert set(acc) == {
+        "jct_delta_pct", "makespan_delta_pct", "threshold_pct", "within_5pct"
+    }
+    assert summary["gpu-random-mean"]["seeds"] == 2
     assert (tmp_path / "summary.json").exists()
-    assert (tmp_path / "report.md").exists()
+    assert json.loads((tmp_path / "summary.json").read_text())["acceptance"] == acc
+    report = (tmp_path / "report.md").read_text()
+    assert "Acceptance (BASELINE.json:5" in report
     assert (tmp_path / "cdf_tpu-v5p.csv").exists()
 
 
@@ -132,3 +140,32 @@ def test_jct_cdf_shape():
     fracs = [y for _, y in cdf]
     assert jcts == sorted(jcts)
     assert fracs == sorted(fracs)
+
+
+def test_acceptance_band_semantics():
+    """Signed deltas, one-sided band (beating the baseline is within),
+    zero-baseline guard."""
+    from gpuschedule_tpu.analysis import acceptance_band
+
+    class Fake:
+        def __init__(self, jct, mk):
+            self._s = {"avg_jct": jct, "makespan": mk}
+
+        def summary(self):
+            return dict(self._s)
+
+    a = acceptance_band(Fake(100.0, 1000.0), Fake(104.0, 960.0))
+    assert a["within_5pct"] is True
+    assert a["jct_delta_pct"] == pytest.approx(4.0)
+    assert a["makespan_delta_pct"] == pytest.approx(-4.0)
+
+    # 20% better than baseline is still "within" — the band bounds regression
+    assert acceptance_band(Fake(100.0, 100.0), Fake(80.0, 80.0))["within_5pct"] is True
+    assert acceptance_band(Fake(100.0, 100.0), Fake(106.0, 90.0))["within_5pct"] is False
+
+    # zero baseline with nonzero candidate: undefined delta (None keeps the
+    # dict strict-JSON serializable, unlike float inf), verdict False
+    z = acceptance_band(Fake(0.0, 0.0), Fake(1.0, 0.0))
+    assert z["jct_delta_pct"] is None and z["makespan_delta_pct"] == 0.0
+    assert z["within_5pct"] is False
+    json.dumps(z)  # must remain strict JSON

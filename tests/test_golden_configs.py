@@ -104,16 +104,72 @@ def test_golden_config4_optimus():
     pin(res, 1297.6093866124274, 22083.55504500175)
 
 
-def test_golden_config5_gpu_random_vs_tpu_slices():
-    """Config #5: topology-aware comparison — scattered GPU gangs pay a
-    locality penalty; contiguous v5p slices never degrade."""
+def _acceptance(policy: str, **policy_kwargs):
+    from gpuschedule_tpu.analysis import acceptance_band
+
     gpu = Simulator(
-        GpuCluster(num_switches=4, nodes_per_switch=8, gpus_per_node=8, scheme="random"),
-        make_policy("fifo"),
+        GpuCluster(num_switches=4, nodes_per_switch=8, gpus_per_node=8,
+                   scheme="consolidated"),
+        make_policy(policy, **policy_kwargs),
         load_philly_csv(PHILLY),
     ).run()
-    pin(gpu, 5817.45742037037, 59421.341)
+    tpu = Simulator(
+        TpuCluster("v5p"), make_policy(policy, **policy_kwargs), load_philly_csv(PHILLY)
+    ).run()
+    return acceptance_band(gpu, tpu)
+
+
+def test_golden_acceptance_band_srtf():
+    """BASELINE.json:5 contract, stated explicitly: the headline Philly
+    replay (SRTF, the config #2 policy) on a v5p-256 lands within 5% of the
+    GPU-backed baseline (consolidated scheme, equal chip count) — in fact
+    3.1% BETTER on avg JCT."""
+    a = _acceptance("srtf")
+    assert a["within_5pct"] is True
+    assert a["jct_delta_pct"] == pytest.approx(-3.062908657752523, rel=REL)
+    assert a["makespan_delta_pct"] == pytest.approx(1.3015844007761623, rel=REL)
+
+
+def test_golden_acceptance_band_fifo_backfill():
+    """FIFO needs backfill to stay in the band on slices: pow2 slice
+    round-up inflates job footprints, and plain-FIFO head-of-line blocking
+    turns that into +13% avg JCT (pinned below); letting followers fill the
+    geometric gaps recovers it to better-than-baseline."""
+    a = _acceptance("fifo", backfill=True)
+    assert a["within_5pct"] is True
+    assert a["jct_delta_pct"] == pytest.approx(-2.4653391213886846, rel=REL)
+    assert a["makespan_delta_pct"] == pytest.approx(-9.369800793197951, rel=REL)
+
+
+def test_golden_acceptance_band_fifo_documents_hol_cost():
+    """Plain FIFO is knowingly OUTSIDE the band — the one policy where the
+    slice allocator's pow2 inflation has no mechanism to hide behind.  The
+    pin documents the cost instead of pretending it away."""
+    a = _acceptance("fifo")
+    assert a["within_5pct"] is False
+    assert a["jct_delta_pct"] == pytest.approx(13.122896278111906, rel=REL)
+    assert a["makespan_delta_pct"] == pytest.approx(2.0552027766049856, rel=REL)
+
+
+def test_golden_config5_gpu_random_vs_tpu_slices():
+    """Config #5: topology-aware comparison — scattered GPU gangs pay a
+    locality penalty; contiguous v5p slices never degrade.  The random
+    scheme is swept over seeds so the headline contrast is not a
+    single-draw artifact (seed 0 stays pinned for determinism)."""
+    gpu_makespans = []
+    for seed in range(3):
+        gpu = Simulator(
+            GpuCluster(num_switches=4, nodes_per_switch=8, gpus_per_node=8,
+                       scheme="random", seed=seed),
+            make_policy("fifo"),
+            load_philly_csv(PHILLY),
+        ).run()
+        gpu_makespans.append(gpu.makespan)
+        if seed == 0:
+            pin(gpu, 5817.45742037037, 59421.341)
     tpu = Simulator(TpuCluster("v5p"), make_policy("fifo"), load_philly_csv(PHILLY)).run()
     pin(tpu, 5896.8249166666665, 46973.684)
-    # the headline contrast: equal chip counts, better makespan on slices
-    assert tpu.makespan < gpu.makespan
+    # the headline contrast: equal chip counts, better makespan on slices —
+    # against the seed-averaged random draw, not one sample
+    mean_gpu = sum(gpu_makespans) / len(gpu_makespans)
+    assert tpu.makespan < mean_gpu
