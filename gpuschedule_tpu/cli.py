@@ -128,13 +128,19 @@ def cmd_run(args) -> int:
 
 def cmd_gen_trace(args) -> int:
     if args.philly_like:
-        jobs = generate_philly_like_trace(args.num_jobs, seed=args.seed)
+        from gpuschedule_tpu.sim.philly import PHILLY_MEAN_INTERARRIVAL_S
+
+        rate = (args.arrival_rate if args.arrival_rate is not None
+                else 1.0 / PHILLY_MEAN_INTERARRIVAL_S)
+        jobs = generate_philly_like_trace(args.num_jobs, seed=args.seed,
+                                          arrival_rate=rate)
         save_philly_csv(jobs, args.out)
     else:
+        rate = args.arrival_rate if args.arrival_rate is not None else 1.0 / 60.0
         jobs = generate_poisson_trace(
             args.num_jobs,
             seed=args.seed,
-            arrival_rate=args.arrival_rate,
+            arrival_rate=rate,
             mean_duration=args.mean_duration,
             failure_rate=args.failure_rate,
             util_range=(args.util_min, 1.0),
@@ -271,7 +277,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     gen.add_argument("--num-jobs", type=int, required=True)
     gen.add_argument("--seed", type=int, default=0)
     gen.add_argument("--philly-like", action="store_true")
-    gen.add_argument("--arrival-rate", type=float, default=1.0 / 60.0)
+    gen.add_argument("--arrival-rate", type=float, default=None,
+                     help="jobs/sec; defaults to 1/60 (poisson) or the "
+                          "published Philly rate 1/67.3 (--philly-like)")
     gen.add_argument("--mean-duration", type=float, default=3600.0)
     gen.add_argument("--failure-rate", type=float, default=0.0)
     gen.add_argument("--util-min", type=float, default=1.0)

