@@ -87,19 +87,10 @@ def child_main(model: str) -> None:
     from gpuschedule_tpu.profiler.harness import time_steps
 
     _stage("devices")  # first backend touch — where the tunnel hangs
-    dev = None
-    for i in range(3):
-        try:
-            dev = jax.devices()[0]
-            break
-        except RuntimeError as e:
-            # Transient pool exhaustion raises UNAVAILABLE (unlike the silent
-            # init hang, which only the parent's watchdog can handle); worth
-            # riding out in-child where the 180s attempt budget covers it.
-            if "UNAVAILABLE" not in str(e) or i == 2:
-                raise
-            _stage(f"devices-retry-{i + 1}")
-            time.sleep(30.0)
+    # Transient pool exhaustion raises UNAVAILABLE (unlike the silent init
+    # hang, which only the parent's watchdog can handle); worth riding out
+    # in-child where the 180s attempt budget covers it.
+    dev = _devices_with_retry(jax)[0]
 
     _stage("setup")
     mesh = make_mesh(dp=1, sp=1, tp=1, devices=[dev])
@@ -145,10 +136,121 @@ def child_main(model: str) -> None:
     )
 
 
-def _run_attempt(model: str, timeout_s: int) -> tuple:
+def child_flash(model: str) -> None:
+    """Flash-attention smoke on the real chip: one *compiled*
+    (``interpret=False`` via backend autodetect) forward AND backward of
+    the pallas kernels, checked against the dense oracle computed on the
+    same device, plus a ``flash_attn=True`` train step of ``model``.
+
+    The round-3 verdict's top item: every prior flash test ran interpret
+    mode on CPU; this proves the Mosaic-compiled path executes and agrees.
+    Prints the same one-JSON-line contract as the main bench (the driver
+    never runs this mode; ``--flash-smoke`` is operator-invoked and its
+    line is committed as ``FLASH_SMOKE_r*.json``).
+    """
+    _stage("import-jax")
+    import jax
+
+    plat = os.environ.get("GSTPU_BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gpuschedule_tpu.cluster.tpu import GENERATIONS
+    from gpuschedule_tpu.models import MODEL_CONFIGS
+    from gpuschedule_tpu.ops import flash_attention
+    from gpuschedule_tpu.ops.flash_attention import _pick_interpret, _reference
+    from gpuschedule_tpu.parallel import ShardedTrainer, make_mesh
+    from gpuschedule_tpu.profiler.harness import time_steps
+
+    _stage("devices")
+    dev = _devices_with_retry(jax)[0]
+    backend = jax.default_backend()
+    compiled = not _pick_interpret()  # False would mean interpret fallback
+
+    _stage("parity")
+    cfg = MODEL_CONFIGS[model]
+    s_par, heads = 1024, cfg.n_heads
+    d_head = cfg.d_model // cfg.n_heads
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (2, s_par, heads, d_head), jnp.float32)
+    k = jax.random.normal(kk, (2, s_par, heads, d_head), jnp.float32)
+    v = jax.random.normal(kv, (2, s_par, heads, d_head), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_reference(q, k, v, True) ** 2).sum()
+
+    out = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
+    ref = jax.jit(lambda q, k, v: _reference(q, k, v, True))(q, k, v)
+    fwd_err = float(jnp.max(jnp.abs(out - ref)))
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    # gradient magnitudes are O(S) sums; compare relative to the oracle's scale
+    bwd_err = max(
+        float(jnp.max(jnp.abs(a - b)) / jnp.maximum(jnp.max(jnp.abs(b)), 1e-6))
+        for a, b in zip(gf, gr)
+    )
+    assert fwd_err < 2e-2, f"compiled forward diverges from oracle: {fwd_err}"
+    assert bwd_err < 2e-2, f"compiled backward diverges from oracle: {bwd_err}"
+
+    _stage("train-step")
+    mesh = make_mesh(dp=1, sp=1, tp=1, devices=[dev])
+    seq = cfg.max_seq
+    trainer = ShardedTrainer(model, mesh, batch_size=2, seq_len=seq, flash_attn=True)
+    state = trainer.init(seed=0)
+    tokens = trainer.make_batch(seed=0)
+    loss = None
+    for _ in range(WARMUP):
+        state, loss = trainer.step(state, tokens)
+    assert float(loss) == float(loss), "flash train step produced NaN loss"
+
+    _stage("measure")
+    step_s, state = time_steps(trainer.step, state, tokens, iters=5, repeats=3)
+    toks = 2 * seq
+    tokens_per_s = toks / step_s
+    achieved_tflops = cfg.flops_per_token() * toks / step_s / 1e12
+    kind = getattr(dev, "device_kind", "").lower()
+    gen = "v5p" if "v5p" in kind or "v5 pod" in kind else "v5e"
+    mfu = achieved_tflops / GENERATIONS[gen]["bf16_tflops"]
+
+    print(
+        json.dumps(
+            {
+                "metric": f"flash-smoke {model} (S={seq}, b2) compiled pallas "
+                f"fwd+bwd on {gen}: fwd_maxerr={fwd_err:.2e} "
+                f"bwd_relerr={bwd_err:.2e} mfu={mfu:.3f}",
+                "value": round(tokens_per_s, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(mfu / TARGET_MFU, 3),
+                "compiled": compiled,
+                "backend": backend,
+            }
+        ),
+        flush=True,
+    )
+
+
+def _devices_with_retry(jax):
+    """First backend touch with the UNAVAILABLE-retry loop (see child_main)."""
+    for i in range(3):
+        try:
+            return jax.devices()
+        except RuntimeError as e:
+            if "UNAVAILABLE" not in str(e) or i == 2:
+                raise
+            _stage(f"devices-retry-{i + 1}")
+            time.sleep(30.0)
+
+
+def _run_attempt(model: str, timeout_s: int, child_flag: str = "--child") -> tuple:
     """Run one child attempt.  Returns (parsed_json_or_None, failure_note)."""
     proc = subprocess.Popen(
-        [sys.executable, "-u", os.path.abspath(__file__), "--child", model],
+        [sys.executable, "-u", os.path.abspath(__file__), child_flag, model],
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
         text=True,
@@ -200,6 +302,33 @@ def _last_stage(err: str) -> str:
     return stage
 
 
+def flash_smoke_main() -> None:
+    """Operator-invoked: watchdog-wrapped flash smoke, one JSON line."""
+    failures = []
+    model = os.environ.get("GSTPU_FLASH_MODEL", "transformer-long")
+    timeout_s = int(os.environ.get("GSTPU_BENCH_TIMEOUT", "420"))
+    for i in range(2):
+        parsed, note = _run_attempt(model, timeout_s, child_flag="--child-flash")
+        if parsed is not None:
+            print(json.dumps(parsed), flush=True)
+            return
+        failures.append(note)
+        print(f"flash attempt {i + 1} failed: {note}", file=sys.stderr, flush=True)
+        time.sleep(RETRY_PAUSE_S)
+    print(
+        json.dumps(
+            {
+                "metric": "flash-smoke-failed",
+                "value": 0.0,
+                "unit": "tokens/s",
+                "vs_baseline": 0.0,
+                "attempts": failures,
+            }
+        ),
+        flush=True,
+    )
+
+
 def main() -> None:
     failures = []
     try:
@@ -234,5 +363,9 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
         child_main(sys.argv[2])
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--child-flash":
+        child_flash(sys.argv[2])
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--flash-smoke":
+        flash_smoke_main()
     else:
         main()

@@ -1,22 +1,38 @@
-"""Flash attention forward kernel in pallas (TPU).
+"""Flash attention (forward + blockwise backward) in pallas (TPU).
 
 Blockwise causal attention that never materializes the (S, S) score
 matrix — and never holds more than one K/V *block* in VMEM: the grid is
 (batch*heads, q-blocks, k-blocks) with the K/V block index innermost, so
 pallas streams (block_k, d) tiles HBM→VMEM while the online-softmax state
 (running max, denominator, weighted numerator) is carried across k steps
-in VMEM scratch.  Peak on-chip footprint is O(block_q * d + block_k * d),
-independent of S — the property that makes long sequences fit.  This is
-the single-chip sibling of the cross-chip ring in
+in VMEM scratch.
+
+**Memory contract (forward AND backward).**  Peak on-chip footprint is
+O(block_q·d + block_k·d) per (batch, head) — independent of S — in both
+directions.  The forward saves only the per-row logsumexp (O(S) per
+batch·head, lane-replicated f32); the backward is the standard
+flash-attention-2 structure: two more blockwise kernels recompute the
+probabilities per (q-block, k-block) tile from q/k and the saved
+logsumexp, accumulating dq in one pass (k innermost) and dk/dv in a
+second (q innermost).  No (S, S) intermediate exists anywhere — the
+long-context property holds end-to-end through training, not just
+inference (the round-3 backward was a dense XLA recompute; see
+``tests/test_flash_attention.py::test_backward_never_materializes_s_by_s``
+for the executable form of this contract).
+
+Row statistics (running max / denominator / logsumexp / delta) are kept
+**lane-replicated at width 128** in VMEM and HBM — the layout Mosaic's
+tiling expects (f32 tiles are (8, 128); a (block_q, 1) scratch is
+narrower than one lane tile).  Reads reduce over the replicated lanes
+(``max``), writes broadcast back, so arbitrary block sizes still work.
+
+Head dim and sequence length are padded to lane/block multiples and
+unpadded on the way out, so any model shape works.  This is the
+single-chip sibling of the cross-chip ring in
 :mod:`gpuschedule_tpu.parallel.ringattn`: same math, different memory
 system (VMEM blocking vs ICI ppermute).
 
-Backward runs as a dense XLA recompute (``jax.custom_vjp`` over the
-shared oracle in :mod:`gpuschedule_tpu.ops.reference`).  Head dim and
-sequence length are padded to lane/block multiples and unpadded on the
-way out, so any model shape works.
-
-Off-TPU the kernel runs in pallas interpret mode automatically, so CPU
+Off-TPU the kernels run in pallas interpret mode automatically, so CPU
 tests exercise the very same code path the chip compiles.
 """
 
@@ -32,6 +48,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 from gpuschedule_tpu.ops.reference import NEG_INF, dense_attention
 
+# Lane width of the replicated row-statistic arrays (m, l, lse, delta):
+# the f32 VMEM tile is (8, 128), so row vectors are stored broadcast
+# across 128 lanes and reduced (max) back to (rows, 1) on read.
+LANES = 128
+
+
 def _reference(q, k, v, causal):
     """Positional-arg shim over the shared oracle (test-facing name)."""
     return dense_attention(q, k, v, causal=causal)
@@ -41,13 +63,39 @@ def _pick_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _row(block) -> jax.Array:
+    """(rows, 1) row statistic from a lane-replicated (rows, LANES) block."""
+    return jnp.max(block, axis=-1, keepdims=True)
+
+
+def _rep(rowvec, rows: int) -> jax.Array:
+    """Broadcast a (rows, 1) row statistic back to the (rows, LANES) layout."""
+    return jnp.broadcast_to(rowvec, (rows, LANES))
+
+
+def _mask(qi, kb, *, block_q, block_k, causal, seq_len):
+    """Validity mask for the (block_q, block_k) score tile at (qi, kb):
+    padding columns beyond seq_len are dead; causal kills cols > rows."""
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    cols = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    valid = cols < seq_len
+    if causal:
+        valid = jnp.logical_and(valid, rows >= cols)
+    return valid
+
+
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     *, block_q, block_k, causal, sm_scale, seq_len,
 ):
     """Grid (bh, qi, kb), kb innermost: scratch carries the online-softmax
-    state across k blocks of one (bh, qi); the output block is written on
-    the last k step."""
+    state across k blocks of one (bh, qi); the output block and the row
+    logsumexp (the only residual the backward needs) are written on the
+    last k step."""
     qi = pl.program_id(1)
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -63,22 +111,18 @@ def _fwd_kernel(
         k_blk = k_ref[0].astype(jnp.float32)
         v_blk = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
-        rows = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
+        valid = _mask(
+            qi, kb, block_q=block_q, block_k=block_k, causal=causal,
+            seq_len=seq_len,
         )
-        cols = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        valid = cols < seq_len  # mask sequence padding
-        if causal:
-            valid = jnp.logical_and(valid, rows >= cols)
         s = jnp.where(valid, s, NEG_INF)
-        m_prev, l_prev, acc_prev = m_ref[...], l_ref[...], acc_ref[...]
+        m_prev, l_prev = _row(m_ref[...]), _row(l_ref[...])
+        acc_prev = acc_ref[...]
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         corr = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
-        m_ref[...] = m_new
-        l_ref[...] = l_prev * corr + p.sum(axis=-1, keepdims=True)
+        m_ref[...] = _rep(m_new, block_q)
+        l_ref[...] = _rep(l_prev * corr + p.sum(axis=-1, keepdims=True), block_q)
         acc_ref[...] = acc_prev * corr + jnp.dot(
             p, v_blk, preferred_element_type=jnp.float32
         )
@@ -93,9 +137,103 @@ def _fwd_kernel(
 
     @pl.when(kb == nk - 1)
     def _finalize():
-        o_ref[0] = (
-            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
-        ).astype(o_ref.dtype)
+        l_fin = jnp.maximum(_row(l_ref[...]), 1e-30)
+        o_ref[0] = (acc_ref[...] / l_fin).astype(o_ref.dtype)
+        lse_ref[0] = _rep(_row(m_ref[...]) + jnp.log(l_fin), block_q)
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, acc_ref,
+    *, block_q, block_k, causal, sm_scale, seq_len,
+):
+    """dq pass: grid (bh, qi, kb), kb innermost — one q block accumulates
+    its gradient across the k blocks it attended to, recomputing p from
+    q/k and the saved logsumexp (never the full score matrix)."""
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        g_blk = g_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        valid = _mask(
+            qi, kb, block_q=block_q, block_k=block_k, causal=causal,
+            seq_len=seq_len,
+        )
+        s = jnp.where(valid, s, NEG_INF)
+        # normalized probabilities via the saved logsumexp; masked entries
+        # underflow to exactly 0 (NEG_INF - finite)
+        p = jnp.exp(s - _row(lse_ref[0]))
+        dp = jnp.dot(g_blk, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - _row(delta_ref[0])) * sm_scale
+        acc_ref[...] += jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(kb * block_k <= qi * block_q + (block_q - 1))
+        def _():
+            _update()
+    else:
+        _update()
+
+    @pl.when(kb == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkdv_kernel(
+    q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, block_q, block_k, causal, sm_scale, seq_len,
+):
+    """dk/dv pass: grid (bh, kb, qi), qi innermost — one k/v block
+    accumulates its gradient across the q blocks that attended to it."""
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _update():
+        q = q_ref[0].astype(jnp.float32)  # unscaled: ds carries sm_scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        g_blk = g_ref[0].astype(jnp.float32)
+        s = (
+            jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
+        )
+        valid = _mask(
+            qi, kb, block_q=block_q, block_k=block_k, causal=causal,
+            seq_len=seq_len,
+        )
+        s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - _row(lse_ref[0]))
+        dv_acc[...] += jnp.dot(p.T, g_blk, preferred_element_type=jnp.float32)
+        dp = jnp.dot(g_blk, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - _row(delta_ref[0])) * sm_scale
+        dk_acc[...] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+
+    if causal:
+        # q blocks wholly above this k block see none of it
+        @pl.when(qi * block_q + (block_q - 1) >= kb * block_k)
+        def _():
+            _update()
+    else:
+        _update()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _pad_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
@@ -108,18 +246,29 @@ def _pad_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
     return jnp.pad(x, widths)
 
 
+def _prep(x, b, s, h, d, s_mult):
+    """(B, S, H, D) -> (B*H, S_pad, D_pad): the kernel-facing layout."""
+    x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
+    return _pad_to(_pad_to(x, 1, s_mult), 2, 128)
+
+
+def _unprep(x, b, s, h, d):
+    """(B*H, S_pad, D_pad) -> (B, S, H, D): drop padding, restore layout."""
+    x = x[:, :s, :d].reshape(b, h, s, d)
+    return jnp.transpose(x, (0, 2, 1, 3))
+
+
 def _flash_fwd_impl(q, k, v, *, causal, block_q, block_k, interpret):
+    """Returns (out, lse) — lse in the padded lane-replicated
+    (B*H, S_pad, LANES) layout the backward kernels consume directly."""
     b, s, h, d = q.shape
     sm_scale = 1.0 / (d ** 0.5)
     # S padded to a common multiple of both block sizes so every K/V block
     # in the grid is fully in-bounds and every valid column is visited
     s_mult = math.lcm(block_q, block_k)
-
-    def prep(x):  # (B, S, H, D) -> (B*H, S_pad, D_pad)
-        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
-        return _pad_to(_pad_to(x, 1, s_mult), 2, 128)
-
-    qp, kp, vp = prep(q), prep(k), prep(v)
+    qp = _prep(q, b, s, h, d, s_mult)
+    kp = _prep(k, b, s, h, d, s_mult)
+    vp = _prep(v, b, s, h, d, s_mult)
     bh, s_pad, d_pad = qp.shape
 
     kernel = functools.partial(
@@ -130,7 +279,7 @@ def _flash_fwd_impl(q, k, v, *, causal, block_q, block_k, interpret):
         sm_scale=sm_scale,
         seq_len=s,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, s_pad // block_q, s_pad // block_k),
         in_specs=[
@@ -138,40 +287,127 @@ def _flash_fwd_impl(q, k, v, *, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d_pad), lambda i, j, kb: (i, kb, 0)),
             pl.BlockSpec((1, block_k, d_pad), lambda i, j, kb: (i, kb, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d_pad), lambda i, j, kb: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s_pad, d_pad), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda i, j, kb: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_pad, d_pad), q.dtype),
+            jax.ShapeDtypeStruct((bh, s_pad, LANES), jnp.float32),
+        ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),       # running max
-            pltpu.VMEM((block_q, 1), jnp.float32),       # running denom
+            pltpu.VMEM((block_q, LANES), jnp.float32),   # running max
+            pltpu.VMEM((block_q, LANES), jnp.float32),   # running denom
             pltpu.VMEM((block_q, d_pad), jnp.float32),   # running numerator
         ],
         interpret=interpret if interpret is not None else _pick_interpret(),
     )(qp, kp, vp)
-    out = out[:, :s, :d].reshape(b, h, s, d)
-    return jnp.transpose(out, (0, 2, 1, 3))
+    return _unprep(out, b, s, h, d), lse
+
+
+def _flash_bwd_impl(q, k, v, out, lse, g, *, causal, block_q, block_k, interpret):
+    """Blockwise dq/dk/dv from the saved lse (flash-attention-2 backward)."""
+    b, s, h, d = q.shape
+    sm_scale = 1.0 / (d ** 0.5)
+    s_mult = math.lcm(block_q, block_k)
+    qp = _prep(q, b, s, h, d, s_mult)
+    kp = _prep(k, b, s, h, d, s_mult)
+    vp = _prep(v, b, s, h, d, s_mult)
+    gp = _prep(g, b, s, h, d, s_mult)
+    bh, s_pad, d_pad = qp.shape
+    nq, nk = s_pad // block_q, s_pad // block_k
+
+    # delta_i = dO_i . O_i (rowwise): O(S) like lse, computed densely in
+    # XLA (a fused elementwise-reduce, no S x S term), then laid out
+    # lane-replicated for the kernels.  Padded rows have g = 0 => delta 0.
+    delta = jnp.einsum(
+        "bshd,bshd->bsh", g.astype(jnp.float32), out.astype(jnp.float32)
+    )
+    delta = jnp.transpose(delta, (0, 2, 1)).reshape(bh, s)
+    delta = jnp.broadcast_to(
+        _pad_to(delta, 1, s_mult)[..., None], (bh, s_pad, LANES)
+    )
+
+    interp = interpret if interpret is not None else _pick_interpret()
+    opts = dict(
+        block_q=block_q, block_k=block_k, causal=causal, sm_scale=sm_scale,
+        seq_len=s,
+    )
+    lse_spec_q = pl.BlockSpec((1, block_q, LANES), lambda i, j, kb: (i, j, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **opts),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_q, d_pad), lambda i, j, kb: (i, j, 0)),
+            lse_spec_q,
+            lse_spec_q,
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d_pad), lambda i, j, kb: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s_pad, d_pad), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d_pad), jnp.float32)],
+        interpret=interp,
+    )(qp, kp, vp, gp, lse, delta)
+
+    lse_spec_k = pl.BlockSpec((1, block_q, LANES), lambda i, j, qi: (i, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, **opts),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda i, j, qi: (i, qi, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda i, j, qi: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda i, j, qi: (i, j, 0)),
+            pl.BlockSpec((1, block_q, d_pad), lambda i, j, qi: (i, qi, 0)),
+            lse_spec_k,
+            lse_spec_k,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d_pad), lambda i, j, qi: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda i, j, qi: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_pad, d_pad), k.dtype),
+            jax.ShapeDtypeStruct((bh, s_pad, d_pad), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
+        ],
+        interpret=interp,
+    )(qp, kp, vp, gp, lse, delta)
+
+    return (
+        _unprep(dq, b, s, h, d),
+        _unprep(dk, b, s, h, d),
+        _unprep(dv, b, s, h, d),
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_fwd_impl(
+    out, _ = _flash_fwd_impl(
         q, k, v, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd_impl(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
-    # Dense XLA recompute: correctness-first backward.  The forward kernel
-    # is where the O(S^2) activation memory was; grads reuse autodiff.
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: dense_attention(q, k, v, causal=causal), q, k, v
+    q, k, v, out, lse = res
+    return _flash_bwd_impl(
+        q, k, v, out, lse, g, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=interpret,
     )
-    return vjp(g)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -187,7 +423,10 @@ def flash_attention(
     block_k: int = 128,
     interpret: bool | None = None,
 ) -> jax.Array:
-    """Blockwise attention over (B, S, H, D); differentiable.
+    """Blockwise attention over (B, S, H, D); differentiable end-to-end
+    with O(block·d) on-chip memory in BOTH directions — the backward is
+    blockwise too (saved-logsumexp recompute per tile), so training with
+    long sequences never materializes an (S, S) intermediate.
 
     ``interpret=None`` auto-selects pallas interpret mode off-TPU.  The
     call signature matches the model zoo's ``attn_fn`` hook, so

@@ -101,6 +101,9 @@ class ShardedTrainer:
             # Single-device blockwise core (ops/flash_attention.py): pallas
             # runs per device, so shard_map over the batch/head axes; the
             # sequence stays whole on each device (use ring_attn to shard it).
+            # Memory contract: O(block*d) on-chip in BOTH directions — the
+            # backward is blockwise too (saved-logsumexp recompute), so
+            # training long sequences never materializes (S, S) anywhere.
             if seq_shard:
                 raise ValueError("flash_attn keeps S per-device; use ring_attn "
                                  "for sequence sharding")

@@ -77,6 +77,68 @@ def test_flash_gradients_match_dense():
         )
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_gradients_unaligned_mismatched_blocks(causal):
+    """The blockwise backward must recompute the same padding/causal masks
+    the forward applied: S=200, D=40 with block_q != block_k exercises
+    every masked corner of the dq and dk/dv kernels."""
+    q, k, v = _qkv(s=200, d=40)
+
+    def loss_flash(q, k, v):
+        return (
+            flash_attention(q, k, v, causal=causal, block_q=128, block_k=96)
+            ** 2
+        ).sum()
+
+    def loss_ref(q, k, v):
+        return (_reference(q, k, v, causal) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4
+        )
+
+
+def test_flash_gradients_long_context():
+    """S=4096 grad parity vs the dense oracle (the verdict's bar for the
+    blockwise backward)."""
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(kq, (1, 4096, 1, 32))
+    k = jax.random.normal(kk, (1, 4096, 1, 32))
+    v = jax.random.normal(kv, (1, 4096, 1, 32))
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, block_q=512, block_k=512) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_reference(q, k, v, True) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4
+        )
+
+
+def test_backward_never_materializes_s_by_s():
+    """Executable form of the memory contract: the lowered HLO of the
+    jitted backward contains no (S, S)-shaped intermediate.  The round-3
+    dense-recompute backward fails this (its vjp materializes the full
+    2048x2048 score matrix); the blockwise backward's biggest tensors are
+    block-sized."""
+    S = 2048
+    q = jnp.ones((1, S, 1, 32))
+
+    def loss(q, k, v):
+        return (flash_attention(q, k, v, block_q=256, block_k=256) ** 2).sum()
+
+    txt = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(q, q, q).as_text()
+    assert f"{S}x{S}" not in txt and f"{S},{S}" not in txt
+
+
 def test_flash_shape_validation():
     q, k, v = _qkv(s=32, d=16)
     with pytest.raises(ValueError, match="shapes differ"):
