@@ -11,6 +11,7 @@ from gpuschedule_tpu.cluster.base import Allocation, ClusterBase, SimpleCluster
 from gpuschedule_tpu.cluster.gpu import GpuCluster, GpuPlacement
 from gpuschedule_tpu.cluster.tpu import (
     GENERATIONS,
+    MultiSliceGeometry,
     SliceGeometry,
     TpuCluster,
     next_pow2,
@@ -25,6 +26,7 @@ __all__ = [
     "GpuPlacement",
     "TpuCluster",
     "SliceGeometry",
+    "MultiSliceGeometry",
     "GENERATIONS",
     "next_pow2",
     "valid_slice_shapes",

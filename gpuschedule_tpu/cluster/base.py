@@ -65,9 +65,12 @@ class OverlayMixin:
 
     Gandiva co-locates low-utilization jobs on the same devices (SURVEY.md
     §3.3 "packing").  An *overlay* is an Allocation that shares the chips of
-    a live base allocation: it consumes no extra capacity, must match the
-    base's size, and when the base is freed the oldest overlay is promoted
-    to become the new owner so the remaining packed job keeps its chips.
+    a live base allocation: it consumes no extra capacity, must fit within
+    the base's size (a smaller guest occupies a sub-box of the base slice),
+    and when the base is freed the oldest overlay is promoted to become the
+    new owner so the remaining packed job keeps its chips — a promoted
+    smaller heir holds the full base box until it finishes (slice geometry
+    is immutable once granted).
 
     Flavors call :meth:`_try_overlay` from ``allocate`` and
     :meth:`_free_with_overlays` from ``free``; ``_promote`` is the flavor
@@ -88,7 +91,7 @@ class OverlayMixin:
             groups.setdefault(b, []).append(o)
         return {b: sorted(os) for b, os in groups.items()}
 
-    def _try_overlay(self, num_chips: int, hint: Optional[dict]):
+    def _try_overlay(self, num_chips: int, hint: Optional[dict], job=None):
         """Return an overlay Allocation if the hint asks for one, None if the
         hint is absent, or raise if the request is malformed."""
         if not hint or "overlay" not in hint:
@@ -98,11 +101,14 @@ class OverlayMixin:
         size = self._live_size(bid)
         if size is None:
             raise ValueError(f"overlay base {base.alloc_id} is not live")
-        if num_chips != size:
+        if num_chips > size:
             raise ValueError(
-                f"overlay must match base size: requested {num_chips}, base has {size}"
+                f"overlay must fit the base: requested {num_chips}, base has {size}"
             )
-        alloc = Allocation(next(self._ids), num_chips, detail=self._live_detail(bid))
+        alloc = Allocation(
+            next(self._ids), num_chips,
+            detail=self._overlay_detail(bid, num_chips, job),
+        )
         self._overlays[alloc.alloc_id] = bid
         return alloc
 
@@ -129,6 +135,13 @@ class OverlayMixin:
     def _live_detail(self, alloc_id: int):
         return None
 
+    def _overlay_detail(self, alloc_id: int, num_chips: int, job=None):
+        """Detail to hand a guest overlaying ``alloc_id``.  Defaults to the
+        base's detail; flavors override when a smaller guest spans less
+        than the base does (e.g. a single-pod guest on a multislice base
+        must not inherit the base's DCN speed_factor)."""
+        return self._live_detail(alloc_id)
+
     def _promote(self, old_base_id: int, new_base_id: int) -> None:
         raise NotImplementedError
 
@@ -150,7 +163,7 @@ class SimpleCluster(OverlayMixin, ClusterBase):
         return self._used
 
     def allocate(self, num_chips: int, *, job=None, hint: Optional[dict] = None):
-        overlay = self._try_overlay(num_chips, hint)
+        overlay = self._try_overlay(num_chips, hint, job)
         if overlay is not None:
             return overlay
         if num_chips <= 0 or num_chips > self.free_chips:

@@ -32,7 +32,11 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from gpuschedule_tpu.cluster.tpu import GENERATIONS, SliceGeometry, valid_slice_shapes
-from gpuschedule_tpu.profiler.ici import dp_gradient_bytes, slice_allreduce_seconds
+from gpuschedule_tpu.profiler.ici import (
+    cross_pod_allreduce_seconds,
+    dp_gradient_bytes,
+    slice_allreduce_seconds,
+)
 
 
 @dataclass(frozen=True)
@@ -129,20 +133,50 @@ def synthesize_step_times(
     generation: str,
     ks: Sequence[int],
     serial_fraction: float = 0.02,
+    unit: int = 1,
 ) -> List[float]:
-    """Predict step_time(k) from one measured chip + the analytic ICI term.
+    """Predict step_time(k) from one measured baseline + the analytic ICI
+    term.
 
-    Compute scales as (1 - serial_fraction)/k; the collective term is the
-    axis-decomposed ring allreduce of the f32 gradient payload over the
-    squarest valid slice shape for k (what the allocator would grant).
+    ``unit`` is how many chips the measured baseline spanned (1 for a
+    plain single-chip measurement; sp*tp when the smallest model replica
+    is itself sharded): compute scales as (1 - serial_fraction)/(k/unit)
+    — adding replicas, data-parallel.  The collective term is the
+    axis-decomposed ring allreduce of ``param_count`` f32 gradients per
+    chip (callers divide by tp for tp-sharded params) over the squarest
+    valid slice shape for k (what the allocator would grant).
     """
     spec = GENERATIONS[generation]
     dims = spec["pod_dims"]
+    pod_chips = math.prod(dims)
     comp = single_chip_step_s * (1.0 - serial_fraction)
     serial = single_chip_step_s * serial_fraction
     grad_bytes = dp_gradient_bytes(param_count)
+    full_pod = SliceGeometry(
+        pod=0,
+        origin=tuple(0 for _ in dims),
+        shape=tuple(dims),
+        wrap_axes=tuple(True for _ in dims),
+    )
     out = []
     for k in ks:
+        if k % unit:
+            raise ValueError(f"k={k} is not a multiple of the measured unit {unit}")
+        if k > pod_chips:
+            # multislice: m whole pods — per-pod ICI allreduce, then the
+            # cross-pod DCN phase on the already-reduced payload (this is
+            # where the ICI-vs-DCN cliff enters the goodput curves)
+            m, rem = divmod(k, pod_chips)
+            if rem:
+                raise ValueError(
+                    f"{k} chips exceed one {generation} pod ({pod_chips}) "
+                    "and are not a whole-pod multiple"
+                )
+            comm = slice_allreduce_seconds(
+                grad_bytes, full_pod, generation=generation
+            ) + cross_pod_allreduce_seconds(grad_bytes, m)
+            out.append(comp / (k // unit) + serial + comm)
+            continue
         shapes = valid_slice_shapes(k, dims)
         if not shapes:
             raise ValueError(f"{k} is not a valid slice size on {dims}")
@@ -154,7 +188,7 @@ def synthesize_step_times(
             wrap_axes=tuple(s == d for s, d in zip(shape, dims)),
         )
         comm = slice_allreduce_seconds(grad_bytes, geom, generation=generation)
-        out.append(comp / k + serial + comm)
+        out.append(comp / (k // unit) + serial + comm)
     return out
 
 

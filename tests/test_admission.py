@@ -16,24 +16,28 @@ from gpuschedule_tpu.sim import Job, JobState, Simulator
 def test_unsatisfiable_sizes_rejected_on_tpu_cluster():
     c = TpuCluster("v5e", dims=(4, 4), num_pods=2)
     assert not c.is_satisfiable(3)    # non-pow2
-    assert not c.is_satisfiable(32)   # pow2 but > one pod (slices never span)
+    assert c.is_satisfiable(32)       # 2 whole pods: multislice (round 4)
+    assert not c.is_satisfiable(24)   # > pod but not a whole-pod multiple
+    assert not c.is_satisfiable(64)   # more pods than the fleet
     assert c.is_satisfiable(16)
     assert SimpleCluster(64).is_satisfiable(64)
     assert not SimpleCluster(64).is_satisfiable(65)
 
 
 def test_srtf_not_wedged_by_unsatisfiable_job():
-    """Reviewer repro: 32-chip 'shortest' job on a 2x(4x4) cluster used to
-    preempt everything every round and finish nothing."""
+    """Reviewer repro: an impossible 'shortest' job used to preempt
+    everything every round and finish nothing.  (Round 4: 32 chips on a
+    2x(4x4) fleet became a legal multislice gang, so the impossible size
+    is now 64 — more pods than the fleet has.)"""
     jobs = [
         Job("running16", 0.0, num_chips=16, duration=100.0),
-        Job("impossible32", 5.0, num_chips=32, duration=10.0),
+        Job("impossible64", 5.0, num_chips=64, duration=10.0),
         Job("small4", 6.0, num_chips=4, duration=10.0),
     ]
     c = TpuCluster("v5e", dims=(4, 4), num_pods=2)
     res = Simulator(c, make_policy("srtf"), jobs).run()
     by_id = {j.job_id: j for j in res.jobs}
-    assert by_id["impossible32"].state is JobState.REJECTED
+    assert by_id["impossible64"].state is JobState.REJECTED
     # rejected jobs are excluded from headline aggregates
     assert res.num_rejected == 1
     assert res.num_finished == 2

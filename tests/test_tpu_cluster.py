@@ -137,10 +137,16 @@ def test_non_pow2_request_returns_none():
         c.round_up(257)
 
 
-def test_oversized_request_returns_none():
+def test_oversized_request_rules():
+    """Round-4 contract: above one pod, whole-pod multiples are granted as
+    multislice (DCN-joined pods); other oversizes stay unsatisfiable."""
     c = TpuCluster("v5e", dims=(4, 4), num_pods=2)
-    assert c.allocate(32) is None  # exceeds any single-pod box
-    assert c.invalid_size_failures == 1
+    whale = c.allocate(32)        # 2 whole pods: multislice grant
+    assert whale is not None and whale.num_chips == 32
+    c.free(whale)
+    assert c.allocate(24) is None  # not a whole-pod multiple
+    assert c.allocate(64) is None  # more pods than the fleet
+    assert c.invalid_size_failures == 2
 
 
 def test_bad_pod_hint_raises():
