@@ -52,6 +52,7 @@ class OptimusPolicy(Policy):
         profile_ks=(1, 2, 4),
         profile_batch: int = 2,
         profile_seq: int = 32,
+        profile_time_cost: float = 120.0,
     ):
         self.cache = curve_cache
         self.online = online
@@ -61,7 +62,16 @@ class OptimusPolicy(Policy):
         self.profile_ks = tuple(profile_ks)
         self.profile_batch = profile_batch
         self.profile_seq = profile_seq
+        # Profiling is NOT free in simulated time (round-3 verdict #5; the
+        # reference's profiling runs consume real cluster resources,
+        # SURVEY.md §3.2 ★): the first job of each online-profiled model
+        # pays this many seconds of start overhead — its slice is held but
+        # makes no training progress, the engine's overhead mechanism —
+        # before real work begins.  Cache-hit models pay nothing, so a
+        # warm CurveCache is measurably better than a cold one.
+        self.profile_time_cost = float(profile_time_cost)
         self._curves: Dict[str, GoodputCurve] = {}
+        self._profile_charge_pending: set = set()
 
     # ------------------------------------------------------------------ #
     # curves
@@ -84,6 +94,8 @@ class OptimusPolicy(Policy):
                 seq_len=self.profile_seq,
                 cache=self.cache,
             )
+            if self.profile_time_cost > 0.0:
+                self._profile_charge_pending.add(model_name)
         else:
             curve = DEFAULT_CURVE
         self._curves[model_name] = curve
@@ -188,4 +200,17 @@ class OptimusPolicy(Policy):
             k = plan.get(job.job_id, 0)
             if k > 0:
                 overhead = self.resize_overhead if job.executed_work > 0.0 else 0.0
-                sim.try_start(job, chips=k, speed=self._speed(job, k), overhead=overhead)
+                # The first job of a freshly online-profiled model carries
+                # the profiling run: its slice is occupied for
+                # profile_time_cost seconds before training progresses.
+                profiling = job.model_name in self._profile_charge_pending
+                if profiling:
+                    overhead += self.profile_time_cost
+                if (
+                    sim.try_start(
+                        job, chips=k, speed=self._speed(job, k), overhead=overhead
+                    )
+                    and profiling
+                ):
+                    self._profile_charge_pending.discard(job.model_name)
+                    sim.metrics.count("profiling_runs")
