@@ -44,6 +44,61 @@ def summarize(results: Dict[str, SimResult]) -> Dict[str, dict]:
 ACCEPTANCE_THRESHOLD_PCT = 5.0  # fixed by the BASELINE.json:5 contract
 
 
+def scale_offered_load(jobs, factor: float):
+    """Rescale a trace's offered load in place by stretching arrivals.
+
+    ``factor`` < 1 lowers the load (inter-arrival gaps divide by it); job
+    sizes and durations are untouched, so only queueing pressure changes.
+    Returns the same list for chaining.
+    """
+    if factor <= 0:
+        raise ValueError(f"load factor must be positive, got {factor}")
+    for j in jobs:
+        j.submit_time = j.submit_time / factor
+    return jobs
+
+
+def acceptance_load_sweep(
+    make_jobs,
+    baseline_factory,
+    candidate_factory,
+    policy_factory,
+    *,
+    loads: Sequence[float] = (0.70, 0.80, 0.90, 0.95),
+    base_load: float = 0.95,
+    base_results=None,
+) -> Dict[str, dict]:
+    """The acceptance band as a function of offered load.
+
+    The round-3 verdict (weak #7) asked for the curve behind the plain-
+    FIFO knowing-pin: at the published arrival rate the 10k replay runs
+    ~95% offered load, where HOL queueing explodes any capacity the pow2
+    round-up forfeits; sweeping the load shows where the policy re-enters
+    the band — and catches a future allocator regression that a single
+    already-huge delta would hide.  Each entry replays baseline and
+    candidate clusters on the same load-rescaled trace.
+    """
+    from gpuschedule_tpu.sim.engine import Simulator
+
+    out: Dict[str, dict] = {}
+    for load in loads:
+        if base_results is not None and abs(load - base_load) < 1e-12:
+            # the caller already replayed the unscaled trace: reuse
+            out[f"{load:.2f}"] = acceptance_band(*base_results)
+            continue
+        factor = load / base_load
+        base = Simulator(
+            baseline_factory(), policy_factory(),
+            scale_offered_load(make_jobs(), factor),
+        ).run()
+        cand = Simulator(
+            candidate_factory(), policy_factory(),
+            scale_offered_load(make_jobs(), factor),
+        ).run()
+        out[f"{load:.2f}"] = acceptance_band(base, cand)
+    return out
+
+
 def acceptance_band(baseline: SimResult, candidate: SimResult) -> dict:
     """The BASELINE.json:5 contract, computed: is the TPU replay's avg-JCT
     and makespan within 5% of the GPU-backed baseline?

@@ -163,9 +163,11 @@ def cmd_compare_topology(args) -> int:
 
     from gpuschedule_tpu.analysis import acceptance_band, write_report
 
-    def jobs():
+    def jobs(num_pods: int = 1):
         if args.philly:
-            return load_philly_csv(args.philly)
+            # multi-pod configs keep the trace's whales as multislice
+            # gangs instead of clamping them to one pod
+            return load_philly_csv(args.philly, num_pods=num_pods)
         return generate_poisson_trace(args.synthetic or 200, seed=args.seed)
 
     gpu_shape = _parse_dims(args.gpu_shape)
@@ -182,15 +184,29 @@ def cmd_compare_topology(args) -> int:
         "gpu-topology": gpu("topology"),
         "tpu-v5p": TpuCluster("v5p"),
         "tpu-v5e": TpuCluster("v5e"),
+        # the ICI-vs-DCN boundary made visible: same generation, two pods
+        # joined by DCN — whales run as multislice gangs at a speed_factor
+        # < 1 instead of being clamped into one pod
+        "tpu-v5p-2pod": TpuCluster("v5p", num_pods=2),
     })
+    pods_of = {"tpu-v5p-2pod": 2}
     pol_kwargs = _parse_policy_kwargs(args.policy_arg)
     results = {}
     for name, cluster in configs.items():
         results[name] = Simulator(
-            cluster, make_policy(args.policy, **pol_kwargs), jobs()
+            cluster, make_policy(args.policy, **pol_kwargs),
+            jobs(pods_of.get(name, 1)),
         ).run()
 
     rand = [results[k] for k in results if k.startswith("gpu-random-s")]
+    # how many gangs actually spanned pods in the 2-pod replay: on the
+    # synthetic path (or a whale-free Philly trace) the answer is zero and
+    # the 2-pod/1-pod JCT ratio says nothing about DCN — it only measures
+    # doubled capacity, and the two fleets replay different gang sizes
+    # anyway (whales clamped vs multislice), so the ratio is reported with
+    # its multislice count and nulled when no gang crossed a pod
+    pod_chips = configs["tpu-v5p-2pod"].pod_chips
+    n_multislice = sum(1 for j in jobs(2) if j.num_chips > pod_chips)
     extra = {
         "acceptance": acceptance_band(results["gpu-consolidated"], results["tpu-v5p"]),
         "gpu-random-mean": {
@@ -198,7 +214,27 @@ def cmd_compare_topology(args) -> int:
             "makespan": mean(r.makespan for r in rand),
             "seeds": len(rand),
         },
+        "dcn_vs_ici": {
+            "multislice_jobs": n_multislice,
+            "jct_ratio_2pod_over_1pod": (
+                results["tpu-v5p-2pod"].avg_jct / results["tpu-v5p"].avg_jct
+                if n_multislice else None
+            ),
+        },
     }
+    if args.load_sweep:
+        # the acceptance band vs offered load (plain FIFO's entry point
+        # into the 5% band lives here; see the golden sweep table).  The
+        # base-load point reuses the replays already computed above.
+        from gpuschedule_tpu.analysis import acceptance_load_sweep
+
+        extra["load_sweep"] = acceptance_load_sweep(
+            jobs,
+            lambda: gpu("consolidated"),
+            lambda: TpuCluster("v5p"),
+            lambda: make_policy(args.policy, **pol_kwargs),
+            base_results=(results["gpu-consolidated"], results["tpu-v5p"]),
+        )
     out = {k: v.summary() for k, v in results.items()}
     out.update(extra)
     print(json.dumps(out, sort_keys=True))
@@ -299,6 +335,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     cmp_.add_argument("--seeds", type=int, default=1,
                       help="random-placement draws to average (config #5 "
                            "seed sweep)")
+    cmp_.add_argument("--load-sweep", action="store_true",
+                      help="also sweep offered load (70/80/90/95%%) and "
+                           "report the acceptance band per load")
     cmp_.add_argument("--out")
     cmp_.set_defaults(fn=cmd_compare_topology)
 

@@ -97,12 +97,17 @@ def test_compare_topology_writes_report(tmp_path, capsys):
     summary = json.loads(out[-1])
     assert set(summary) == {
         "gpu-consolidated", "gpu-random-s0", "gpu-random-s1", "gpu-topology",
-        "tpu-v5p", "tpu-v5e", "acceptance", "gpu-random-mean",
+        "tpu-v5p", "tpu-v5e", "tpu-v5p-2pod", "acceptance", "gpu-random-mean",
+        "dcn_vs_ici",
     }
     acc = summary["acceptance"]
     assert set(acc) == {
         "jct_delta_pct", "makespan_delta_pct", "threshold_pct", "within_5pct"
     }
+    # synthetic traces have no multislice whales: the ratio must be nulled
+    # (it would only measure doubled capacity), with the count saying why
+    assert summary["dcn_vs_ici"]["multislice_jobs"] == 0
+    assert summary["dcn_vs_ici"]["jct_ratio_2pod_over_1pod"] is None
     assert summary["gpu-random-mean"]["seeds"] == 2
     assert (tmp_path / "summary.json").exists()
     assert json.loads((tmp_path / "summary.json").read_text())["acceptance"] == acc
