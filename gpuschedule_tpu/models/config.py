@@ -35,6 +35,13 @@ class ModelConfig:
         estimate the MFU arithmetic in bench.py uses)."""
         return 6.0 * self.param_count
 
+    def flops_per_token_attn(self, seq_len: int) -> float:
+        """6N plus the causal-attention matmul FLOPs, which 6N ignores and
+        which dominate at long context: 12·L·S·d fwd+bwd per token, halved
+        for causal masking → 6·L·S·d.  Use this for long-context MFU
+        (at S=32k it is ~5x the 6N figure for the xlong config)."""
+        return self.flops_per_token() + 6.0 * self.n_layers * seq_len * self.d_model
+
 
 @dataclass(frozen=True)
 class CnnConfig:
@@ -104,6 +111,18 @@ MODEL_CONFIGS: Dict[str, "ModelConfig | CnnConfig"] = {
             n_heads=8,
             d_ff=1024,
             max_seq=4096,
+            remat=True,
+        ),
+        # Long-context flagship: S=32k training fits one v5e chip ONLY via
+        # the blockwise flash kernels (dense attention's (B, H, S, S) f32
+        # scores are ~34 GB at S=32k — over 2x the chip's HBM) + remat.
+        ModelConfig(
+            "transformer-xlong",
+            d_model=512,
+            n_layers=6,
+            n_heads=8,
+            d_ff=2048,
+            max_seq=32768,
             remat=True,
         ),
         # "mlp-wide" is a transformer with a fat FFN and thin attention —
