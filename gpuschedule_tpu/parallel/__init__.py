@@ -8,8 +8,21 @@ let XLA insert the collectives.  Nothing here spawns processes — under
 ``jax.distributed`` the same code runs multi-host unchanged.
 """
 
+from gpuschedule_tpu.parallel.checkpoint import (
+    reshard_state,
+    restore_state,
+    save_state,
+)
 from gpuschedule_tpu.parallel.mesh import make_mesh
 from gpuschedule_tpu.parallel.ringattn import ring_attention
 from gpuschedule_tpu.parallel.train import ShardedTrainer, param_partition_spec
 
-__all__ = ["make_mesh", "ring_attention", "ShardedTrainer", "param_partition_spec"]
+__all__ = [
+    "make_mesh",
+    "ring_attention",
+    "ShardedTrainer",
+    "param_partition_spec",
+    "save_state",
+    "restore_state",
+    "reshard_state",
+]

@@ -1,0 +1,123 @@
+"""Real checkpoint/restore for sharded train state (orbax-backed).
+
+The scheduler layer MODELS checkpoint/restore cost (``sim/overhead.py``:
+suspend, migrate, and grow-shrink charge seconds derived from model and
+slice size — SURVEY.md §5 "Checkpoint / resume").  This module is the
+mechanism those seconds stand for: save a :class:`ShardedTrainer`'s
+(params, opt_state) to disk and restore it — onto the SAME mesh, or onto
+a DIFFERENT one.
+
+Cross-mesh restore is the TPU-native piece.  The reference's elastic
+moves serialize through a filesystem checkpoint because NCCL process
+groups cannot re-shape in place; here a resize/migration is just
+``jax.device_put`` onto the new mesh's ``NamedSharding``s — XLA moves the
+bytes (over ICI when live, from the checkpoint when cold), and the same
+partition-spec rules that shard a fresh init re-shard the restored state.
+So Gandiva grow-shrink and Optimus resize map onto: checkpoint (or keep
+live), rebuild the trainer on the new slice, ``restore``/``reshard``.
+
+Orbax handles the on-disk format (async-capable, per-shard files); the
+sharding metadata comes from the TARGET trainer, not the checkpoint, so a
+state saved from a dp=4 mesh restores cleanly onto dp=2·tp=2.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Tuple
+
+import jax
+
+__all__ = ["save_state", "restore_state", "reshard_state"]
+
+
+def _target_shardings(trainer, state) -> Tuple[Any, Any]:
+    """(params, opt_state) NamedSharding pytrees for ``trainer``'s mesh,
+    derived from the same partition-spec rules init uses (single source
+    of sharding truth: parallel/train.py param_partition_spec)."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from gpuschedule_tpu.parallel.train import param_partition_spec
+
+    params, opt_state = state
+    param_sh = jax.tree_util.tree_map_with_path(
+        lambda path, v: NamedSharding(
+            trainer.mesh, param_partition_spec(path, v)
+        ),
+        params,
+    )
+
+    # opt-state leaves mirror param leaves (adam moments) or are scalars
+    # (step counts): shard by shape match against the param rule, else
+    # replicate.  tree_map_with_path over the opt_state gives paths whose
+    # param-name suffix matches the param tree's, so reuse the rule.
+    def opt_spec(path, v):
+        if getattr(v, "ndim", 0) == 0:
+            return NamedSharding(trainer.mesh, P())
+        return NamedSharding(trainer.mesh, param_partition_spec(path, v))
+
+    opt_sh = jax.tree_util.tree_map_with_path(opt_spec, opt_state)
+    return param_sh, opt_sh
+
+
+def save_state(state, path: str | Path, *, overwrite: bool = True) -> str:
+    """Write (params, opt_state) to ``path`` (orbax PyTree checkpoint).
+
+    Works for any mesh/sharding: orbax records per-leaf shape/dtype and
+    gathers shards as needed.  ``overwrite=True`` (default) replaces an
+    existing checkpoint at the path — the scheduler's suspend/migrate
+    cycle saves the same job repeatedly.  Returns the checkpoint path.
+    """
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, state, force=overwrite)
+    return str(path)
+
+
+def restore_state(trainer, path: str | Path):
+    """Load a checkpoint onto ``trainer``'s mesh with its shardings.
+
+    The checkpoint may have been saved from a different mesh shape
+    (elastic resize, migration across slices): restore targets are built
+    from the TARGET trainer's partition rules, so each device reads
+    exactly its shard of the new layout.
+    """
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    # abstract target: shapes/dtypes from a cost-free eval of init —
+    # also the tree-structure template (orbax flattens tuples to lists
+    # on disk; the item template restores the original containers)
+    abstract = jax.eval_shape(lambda: trainer.init(seed=0))
+    shardings = _target_shardings(trainer, abstract)
+
+    def to_restore_arg(leaf, sharding):
+        return ocp.ArrayRestoreArgs(
+            sharding=sharding, global_shape=leaf.shape, dtype=leaf.dtype
+        )
+
+    restore_args = jax.tree.map(to_restore_arg, abstract, shardings)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        return ckptr.restore(
+            path,
+            args=ocp.args.PyTreeRestore(item=abstract, restore_args=restore_args),
+        )
+
+
+def reshard_state(trainer, state):
+    """Live re-shard: place ``state`` onto ``trainer``'s mesh/shardings.
+
+    The in-memory half of an elastic move — no filesystem round trip;
+    XLA transfers each shard to its new home (ICI when source and target
+    devices overlap a live slice).  ``state`` may come from a trainer
+    with a different mesh factorization.
+    """
+    param_sh, opt_sh = _target_shardings(trainer, state)
+    params, opt_state = state
+    return (
+        jax.device_put(params, param_sh),
+        jax.device_put(opt_state, opt_sh),
+    )

@@ -78,6 +78,39 @@ def test_main_success_path_relays_child_json(monkeypatch, capsys):
     assert calls == ["a", "b"]  # fallback engaged after the first failure
 
 
+def test_run_attempt_scan_takes_last_json_line(monkeypatch):
+    """The longctx child flushes a flash-only line BEFORE the dense probe
+    and the final line after it: the reverse scan must hand back the
+    final line when both are present (and the early one if the probe
+    killed the child before the second print)."""
+    first = {"metric": "longctx (dense_at_same_S=unprobed)", "value": 1.0}
+    final = {"metric": "longctx (dense_at_same_S=OOM)", "value": 1.0,
+             "dense_feasible": False}
+
+    class FakeProc:
+        returncode = 0
+
+        def __init__(self, out):
+            self._out = out
+
+        def communicate(self, timeout=None):
+            return self._out, ""
+
+    out_two = json.dumps(first) + "\n" + json.dumps(final) + "\n"
+    monkeypatch.setattr(
+        bench.subprocess, "Popen", lambda *a, **k: FakeProc(out_two)
+    )
+    parsed, note = bench._run_attempt("m", 5, child_flag="--child-longctx")
+    assert parsed == final and note == ""
+
+    out_one = json.dumps(first) + "\n"
+    monkeypatch.setattr(
+        bench.subprocess, "Popen", lambda *a, **k: FakeProc(out_one)
+    )
+    parsed, _ = bench._run_attempt("m", 5, child_flag="--child-longctx")
+    assert parsed == first  # rescue: the pre-probe flush survives
+
+
 @pytest.mark.slow
 def test_end_to_end_success_on_cpu_backend():
     """Full parent→child round trip with a model small enough for CPU."""
