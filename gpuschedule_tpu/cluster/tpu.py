@@ -305,6 +305,11 @@ class TpuCluster(OverlayMixin, ClusterBase):
             self.fragmentation_failures += 1
         return None
 
+    def _empty_pods(self) -> List[int]:
+        """Indices of pods with no occupied cell — the only pods a
+        multislice may claim (single source of the emptiness invariant)."""
+        return [p for p, occ in enumerate(self._occ) if not occ.any()]
+
     def _allocate_multislice(self, num_chips: int, *, job=None):
         """Grant a gang larger than one pod as whole empty pods joined
         over DCN, or None.  Only whole-pod multiples are valid multislice
@@ -316,7 +321,7 @@ class TpuCluster(OverlayMixin, ClusterBase):
             return None
         if num_chips > self.free_chips:
             return None
-        empty = [p for p, occ in enumerate(self._occ) if not occ.any()]
+        empty = self._empty_pods()
         if len(empty) < m:
             # enough chips in aggregate but not enough whole pods free:
             # cross-pod fragmentation
@@ -422,7 +427,7 @@ class TpuCluster(OverlayMixin, ClusterBase):
             m, rem = divmod(num_chips, self.pod_chips)
             if rem or m > self.num_pods:
                 return False
-            return sum(1 for occ in self._occ if not occ.any()) >= m
+            return len(self._empty_pods()) >= m
         shapes = valid_slice_shapes(num_chips, self.dims)
         return any(
             self._find_free_box(occ, shape, None) is not None
@@ -469,9 +474,16 @@ class TpuCluster(OverlayMixin, ClusterBase):
     # fragmentation / observability
 
     def largest_allocatable(self) -> int:
-        """Largest valid slice size grantable right now (0 if none)."""
+        """Largest valid allocation grantable right now (0 if none): a
+        multislice over every empty pod when more than one is empty, else
+        the largest power-of-two box in any pod.  Without the multislice
+        arm, ``fragmentation()`` would read 0.5 on a perfectly-compact
+        two-pod fleet (free = 2 pods, 'largest' capped at 1)."""
         if self.free_chips == 0:
             return 0
+        empty_pods = len(self._empty_pods())
+        if empty_pods > 1:
+            return empty_pods * self.pod_chips
         # largest pow2 <= min(free, pod capacity); min() of the raw values
         # could land on a non-pow2 and skip every real candidate below it
         k = 1 << (min(self.free_chips, self.pod_chips).bit_length() - 1)

@@ -73,6 +73,19 @@ def test_multislice_invalid_sizes_rejected():
     assert c.is_satisfiable(32)
 
 
+def test_fragmentation_metric_spans_pods():
+    """An idle multi-pod fleet is perfectly compact: largest_allocatable
+    must count the multislice over all empty pods, not cap at one pod
+    (fragmentation() would otherwise read 0.5 on a clean 2-pod fleet)."""
+    c = _fleet(pods=2)
+    assert c.largest_allocatable() == 32
+    assert c.fragmentation() == 0.0
+    a = c.allocate(2)
+    assert c.largest_allocatable() == 16  # one pod dirty: best is 1 pod
+    c.free(a)
+    assert c.largest_allocatable() == 32
+
+
 def test_dcn_speed_factor_scales_with_model_size():
     """Bigger gradients pay a bigger DCN toll: the cliff is model-aware."""
     c = _fleet(pods=2)
@@ -185,6 +198,28 @@ def test_cross_pod_allreduce_seconds_basic():
     t2 = cross_pod_allreduce_seconds(1e9, 2)
     t4 = cross_pod_allreduce_seconds(1e9, 4)
     assert 0 < t2 < t4 < 2 * t2  # (m-1)/m asymptote, not linear
+
+
+def test_multislice_at_scale_stays_fast():
+    """The empty-pod scan in the multislice allocator must not drag the
+    engine's scaling: 10k jobs + 1% whales on a 4-pod fleet replay in
+    seconds (same bar family as test_scale.py)."""
+    import time
+
+    from gpuschedule_tpu.sim.trace import generate_poisson_trace
+
+    jobs = generate_poisson_trace(10_000, seed=3)
+    whales = [
+        Job(f"w{i}", 5000.0 * i, num_chips=512, duration=600.0,
+            model_name="transformer-base")
+        for i in range(100)
+    ]
+    c = TpuCluster("v5e", num_pods=4)  # 4 x 256
+    t0 = time.perf_counter()
+    res = Simulator(c, make_policy("srtf"), jobs + whales).run()
+    elapsed = time.perf_counter() - t0
+    assert res.num_finished == 10_100
+    assert elapsed < 30.0, f"multislice replay took {elapsed:.1f}s"
 
 
 # --------------------------------------------------------------------- #
