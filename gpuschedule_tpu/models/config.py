@@ -23,17 +23,38 @@ class ModelConfig:
     d_ff: int = 512
     max_seq: int = 512
     remat: bool = False
+    # n_experts > 0 turns each block's MLP into a top-1-routed MoE
+    # (models/transformer.py MoeMlp, experts sharded over the tp axis)
+    n_experts: int = 0
+    capacity_factor: float = 1.25
 
     @property
     def param_count(self) -> int:
-        """Approximate parameter count (embeddings + blocks)."""
-        per_block = 4 * self.d_model * self.d_model + 2 * self.d_model * self.d_ff
+        """Approximate parameter count (embeddings + blocks).  MoE configs
+        hold n_experts copies of each FFN plus a router."""
+        ffn = 2 * self.d_model * self.d_ff
+        per_block = 4 * self.d_model * self.d_model + max(1, self.n_experts) * ffn
+        if self.n_experts:
+            per_block += self.d_model * self.n_experts  # router
         return self.vocab * self.d_model + self.n_layers * per_block
 
+    @property
+    def active_param_count(self) -> int:
+        """Params a single token actually exercises: for top-1 MoE that is
+        ONE expert FFN per block (plus the router), not all n_experts —
+        the count FLOPs and goodput estimates must use.  Derived from
+        ``param_count`` (single source of the arithmetic): the inactive
+        mass is exactly the n_experts-1 unused FFN copies per block."""
+        if not self.n_experts:
+            return self.param_count
+        ffn = 2 * self.d_model * self.d_ff
+        return self.param_count - self.n_layers * (self.n_experts - 1) * ffn
+
     def flops_per_token(self) -> float:
-        """~6N FLOPs/token for fwd+bwd of an N-param dense LM (the standard
-        estimate the MFU arithmetic in bench.py uses)."""
-        return 6.0 * self.param_count
+        """~6N FLOPs/token for fwd+bwd, N = ACTIVE params (equals total
+        params for dense configs; one expert per token for MoE — the
+        standard estimate the MFU arithmetic in bench.py uses)."""
+        return 6.0 * self.active_param_count
 
     def flops_per_token_attn(self, seq_len: int) -> float:
         """6N plus the causal-attention matmul FLOPs, which 6N ignores and
@@ -129,5 +150,20 @@ MODEL_CONFIGS: Dict[str, "ModelConfig | CnnConfig"] = {
         # keeps one model class while giving the profiler a compute-heavy,
         # communication-light point in the workload mix.
         ModelConfig("mlp-wide", d_model=256, n_layers=2, n_heads=2, d_ff=4096),
+        # Mixture-of-experts family: top-1 (Switch) routing, experts
+        # sharded over the tp mesh axis (expert parallelism).  8x the FFN
+        # params of transformer-small at ~its per-token FLOPs.
+        ModelConfig(
+            "transformer-moe",
+            d_model=256,
+            n_layers=4,
+            n_heads=8,
+            d_ff=1024,
+            n_experts=8,
+        ),
+        ModelConfig(
+            "moe-tiny", d_model=128, n_layers=2, n_heads=4, d_ff=256,
+            n_experts=4,
+        ),
     )
 }

@@ -61,8 +61,91 @@ class ProjectedAttention(nn.Module):
         )(o)
 
 
+class MoeMlp(nn.Module):
+    """Top-1 (Switch) routed mixture-of-experts FFN, GShard-style.
+
+    TPU-native by construction: routing is expressed as dense one-hot
+    **dispatch/combine einsums** over an (experts, capacity, d) buffer —
+    no scatter/gather, so everything lands on the MXU and the whole layer
+    shards by annotating the expert dim.  The partition rule in
+    :func:`gpuschedule_tpu.parallel.train.param_partition_spec` puts the
+    expert dim of ``w_up``/``w_down`` on the **tp axis** (expert
+    parallelism over the tensor axis — ep-over-tp); XLA turns the
+    dispatch einsum's sharding mismatch into the all-to-all the GShard
+    paper inserts by hand.
+
+    Tokens route to their argmax expert, f32 router math for stable
+    training; each expert processes at most ``capacity_factor * T / E``
+    tokens and overflow tokens are dropped (their block output is 0, so
+    the residual stream carries them through — standard Switch behavior).
+    """
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = self.cfg
+        b, s, d = x.shape
+        e = c.n_experts
+        t = b * s
+        cap = max(1, int(c.capacity_factor * t / e))
+
+        logits = nn.Dense(
+            e, dtype=jnp.float32, param_dtype=jnp.float32, name="router"
+        )(x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1).reshape(t, e)
+        gate = jnp.max(probs, axis=-1)                      # (T,)
+        choice = jnp.argmax(probs, axis=-1)                 # (T,)
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)
+        # Switch load-balancing auxiliary loss: E * sum_e f_e * P_e, where
+        # f_e = fraction of tokens routed to e, P_e = mean router prob.
+        # Minimized (= 1) at uniform routing; without it top-1 routing
+        # collapses onto a few experts and overflow tokens stop getting
+        # FFN compute.  Sown; the trainer adds it at moe_aux_weight.
+        frac = jnp.mean(onehot, axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+        self.sow("moe_losses", "aux", e * jnp.sum(frac * mean_prob))
+        # position of each token inside its expert's buffer, in token
+        # order: the chosen column holds count-1 (>= 0), all others -1,
+        # so the row max extracts it (a row SUM would add the -1s)
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0     # (T, E)
+        pos_tok = jnp.max(pos, axis=-1)                     # (T,) position, >= 0
+        keep = (pos_tok >= 0) & (pos_tok < cap)
+        pos_clip = jnp.clip(pos_tok, 0, cap - 1).astype(jnp.int32)
+        # dispatch: (T, E, C) one-hot of (expert, slot), zero for dropped
+        dispatch = (
+            onehot[:, :, None]
+            * jax.nn.one_hot(pos_clip, cap, dtype=jnp.float32)[:, None, :]
+            * keep[:, None, None]
+        )
+        xf = x.reshape(t, d)
+        expert_in = jnp.einsum(
+            "td,tec->ecd", xf.astype(jnp.bfloat16), dispatch.astype(jnp.bfloat16)
+        )
+
+        kin = nn.initializers.lecun_normal()
+        w_up = self.param("w_up", kin, (e, d, c.d_ff), jnp.float32)
+        b_up = self.param("b_up", nn.initializers.zeros, (e, c.d_ff), jnp.float32)
+        w_down = self.param("w_down", kin, (e, c.d_ff, d), jnp.float32)
+        b_down = self.param("b_down", nn.initializers.zeros, (e, d), jnp.float32)
+        h = (
+            jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(jnp.bfloat16))
+            + b_up[:, None, :].astype(jnp.bfloat16)
+        )
+        h = nn.gelu(h)
+        out = (
+            jnp.einsum("ecf,efd->ecd", h, w_down.astype(jnp.bfloat16))
+            + b_down[:, None, :].astype(jnp.bfloat16)
+        )
+        # combine: gather each token's slot back, weighted by its gate prob
+        combine = dispatch * gate[:, None, None]
+        y = jnp.einsum("ecd,tec->td", out, combine.astype(jnp.bfloat16))
+        return y.reshape(b, s, d)
+
+
 class Block(nn.Module):
-    """Pre-LN causal self-attention + MLP block, bf16 compute."""
+    """Pre-LN causal self-attention + MLP block, bf16 compute.  The MLP is
+    a dense FFN, or a top-1 MoE when the config sets ``n_experts``."""
 
     cfg: ModelConfig
     attn_fn: Any = None  # None -> dense SelfAttention; else (q,k,v)->out core
@@ -84,6 +167,8 @@ class Block(nn.Module):
             )(h, mask=nn.make_causal_mask(jnp.zeros(h.shape[:2], dtype=jnp.int32)))
         x = x + h
         h = nn.LayerNorm(dtype=jnp.bfloat16, name="ln2")(x)
+        if c.n_experts:
+            return x + MoeMlp(c, name="moe")(h)
         h = nn.Dense(c.d_ff, dtype=jnp.bfloat16, param_dtype=jnp.float32, name="up")(h)
         h = nn.gelu(h)
         h = nn.Dense(c.d_model, dtype=jnp.bfloat16, param_dtype=jnp.float32, name="down")(h)

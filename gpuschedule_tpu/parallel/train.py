@@ -48,6 +48,13 @@ def param_partition_spec(path: Tuple, value: Any) -> P:
         parts[axis_idx] = "tp"
         return P(*parts)
 
+    if "moe" in names:
+        # expert parallelism over the tp axis (ep-over-tp): the leading
+        # expert dim of every expert weight/bias shards; the router stays
+        # replicated so each device routes its own tokens
+        if any(n in names for n in ("w_up", "w_down", "b_up", "b_down")):
+            return spec_for(0)
+        return P()
     if "embed" in names and "embedding" in names:
         return spec_for(0)  # (vocab, d): shard vocab
     if "kernel" in names:
@@ -85,7 +92,11 @@ class ShardedTrainer:
         seq_shard: bool = False,
         ring_attn: bool = False,
         flash_attn: bool = False,
+        moe_aux_weight: float = 1e-2,
     ):
+        # weight of the sown Switch load-balancing loss (MoE configs only;
+        # a no-op for dense models, whose sow collection is empty)
+        self.moe_aux_weight = moe_aux_weight
         attn_fn = None
         if ring_attn and flash_attn:
             raise ValueError("ring_attn and flash_attn are mutually exclusive")
@@ -166,7 +177,11 @@ class ShardedTrainer:
             return jnp.zeros((batch_size, seq_len), dtype=jnp.int32)
 
         def init_fn(rng):
-            params = self.model.init(rng, example_input())
+            variables = self.model.init(rng, example_input())
+            # keep ONLY the trainable collection: MoE layers sow a
+            # "moe_losses" collection at trace time, which must not leak
+            # into the optimizer state
+            params = {"params": variables["params"]}
             params = constrain_params(params)
             # opt state leaves are elementwise views of params; sharding
             # propagates from the constraint above
@@ -182,10 +197,20 @@ class ShardedTrainer:
                 return optax.softmax_cross_entropy_with_integer_labels(
                     logits, labels
                 ).mean()
-            logits = self.model.apply(params, batch)
-            return optax.softmax_cross_entropy_with_integer_labels(
+            # mutable: collect the sown MoE load-balancing losses (empty
+            # dict for dense models — no cost, one code path)
+            logits, mods = self.model.apply(
+                params, batch, mutable=["moe_losses"]
+            )
+            ce = optax.softmax_cross_entropy_with_integer_labels(
                 logits[:, :-1, :], batch[:, 1:]
             ).mean()
+            aux_terms = jax.tree_util.tree_leaves(mods.get("moe_losses", {}))
+            if aux_terms:
+                ce = ce + self.moe_aux_weight * sum(
+                    jnp.asarray(a, jnp.float32).mean() for a in aux_terms
+                )
+            return ce
 
         def step_fn(params, opt_state, tokens):
             loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
