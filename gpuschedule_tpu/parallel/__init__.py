@@ -14,6 +14,7 @@ from gpuschedule_tpu.parallel.checkpoint import (
     save_state,
 )
 from gpuschedule_tpu.parallel.mesh import make_mesh
+from gpuschedule_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 from gpuschedule_tpu.parallel.ringattn import ring_attention
 from gpuschedule_tpu.parallel.train import ShardedTrainer, param_partition_spec
 
@@ -25,4 +26,6 @@ __all__ = [
     "save_state",
     "restore_state",
     "reshard_state",
+    "pipeline_apply",
+    "stack_stage_params",
 ]

@@ -1,11 +1,14 @@
 """Device mesh construction.
 
-One mesh, three named axes — ``dp`` (data), ``sp`` (sequence), ``tp``
-(tensor) — covering the parallelism dimensions the framework schedules and
-profiles.  ``make_mesh`` factors however many devices exist (real TPU
-chips, or a virtual CPU mesh under ``--xla_force_host_platform_device_count``)
-into that axis order, putting ``tp`` innermost so tensor-parallel
-collectives ride the fastest ICI hops (the scaling-book layout recipe).
+One mesh, four named axes — ``pp`` (pipeline), ``dp`` (data), ``sp``
+(sequence), ``tp`` (tensor) — covering the parallelism dimensions the
+framework schedules and profiles.  ``make_mesh`` factors however many
+devices exist (real TPU chips, or a virtual CPU mesh under
+``--xla_force_host_platform_device_count``) into that axis order: ``tp``
+innermost so tensor-parallel collectives ride the fastest ICI hops, and
+``pp`` outermost because pipeline traffic is point-to-point once per
+microbatch — the least bandwidth-hungry axis (the scaling-book layout
+recipe).
 """
 
 from __future__ import annotations
@@ -17,7 +20,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
-AXES = ("dp", "sp", "tp")
+AXES = ("pp", "dp", "sp", "tp")
 
 
 def make_mesh(
@@ -25,22 +28,23 @@ def make_mesh(
     dp: Optional[int] = None,
     sp: int = 1,
     tp: int = 1,
+    pp: int = 1,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """Build a ``(dp, sp, tp)`` mesh over ``devices`` (default: all).
+    """Build a ``(pp, dp, sp, tp)`` mesh over ``devices`` (default: all).
 
-    ``dp`` defaults to "whatever is left": n_devices // (sp * tp).
+    ``dp`` defaults to "whatever is left": n_devices // (pp * sp * tp).
     """
     devs = list(devices) if devices is not None else list(jax.devices())
     n = len(devs)
-    if sp < 1 or tp < 1:
-        raise ValueError(f"axis sizes must be >= 1: sp={sp}, tp={tp}")
-    if n % (sp * tp) != 0:
-        raise ValueError(f"{n} devices not divisible by sp*tp={sp * tp}")
-    inferred_dp = n // (sp * tp)
+    if sp < 1 or tp < 1 or pp < 1:
+        raise ValueError(f"axis sizes must be >= 1: pp={pp}, sp={sp}, tp={tp}")
+    if n % (pp * sp * tp) != 0:
+        raise ValueError(f"{n} devices not divisible by pp*sp*tp={pp * sp * tp}")
+    inferred_dp = n // (pp * sp * tp)
     if dp is None:
         dp = inferred_dp
-    if dp * sp * tp != n:
-        raise ValueError(f"dp*sp*tp={dp * sp * tp} != {n} devices")
-    grid = np.array(devs).reshape(dp, sp, tp)
+    if pp * dp * sp * tp != n:
+        raise ValueError(f"pp*dp*sp*tp={pp * dp * sp * tp} != {n} devices")
+    grid = np.array(devs).reshape(pp, dp, sp, tp)
     return Mesh(grid, AXES)

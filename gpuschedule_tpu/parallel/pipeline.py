@@ -1,0 +1,121 @@
+"""GPipe-style pipeline parallelism over the ``pp`` mesh axis.
+
+The TPU-native formulation: the pipeline IS a collective program.  Each
+pp rank holds one stage's parameters (stage-stacked pytrees sharded on
+their leading dim); microbatches flow stage-to-stage via
+``lax.ppermute`` inside one ``shard_map``, and the whole schedule —
+fill, steady state, drain: ``M + S - 1`` ticks for M microbatches over S
+stages — is a single ``lax.scan`` that ``jax.grad`` differentiates
+through directly, ppermute's transpose being the reverse permute.  No
+per-stage processes, no send/recv framework, no hand-written backward
+schedule: the 1F1B-ish interleaving falls out of autodiff's reverse
+sweep.  This is the reference's pipeline-parallel analogue done the XLA
+way (same design recipe as the ring in :mod:`.ringattn`; scaling-book
+"pipelining" chapter pattern).
+
+Off the critical path before the wave arrives (and after it drains) a
+stage computes on zeros; those outputs are never read, and the cost is
+the standard (S-1)/(M+S-1) bubble.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["pipeline_apply", "stack_stage_params"]
+
+
+def stack_stage_params(params_list):
+    """Stack per-stage parameter pytrees on a new leading (stage) dim —
+    the layout ``pipeline_apply`` shards over pp."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params,
+    microbatches: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = "pp",
+) -> jax.Array:
+    """Run ``stage_fn`` as a ``pp``-deep pipeline over microbatches.
+
+    ``stage_fn(stage_params, x) -> y`` must map activations to
+    same-shaped activations (a transformer block); ``stacked_params``
+    leaves carry a leading stage dim equal to the mesh's ``pp`` extent;
+    ``microbatches`` is ``(M, mb, ...)``.  Returns the last stage's
+    outputs, ``(M, mb, ...)``, replicated across pp (a psum over the
+    stage mask).  Differentiable end-to-end.
+    """
+    n_stages = mesh.shape[axis]
+    if microbatches.ndim < 2:
+        raise ValueError(
+            f"microbatches must be (M, microbatch, ...), got {microbatches.shape}"
+        )
+    lead = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if lead != n_stages:
+        raise ValueError(
+            f"stacked params carry {lead} stages but mesh {axis}={n_stages}"
+        )
+    dp = mesh.shape.get("dp", 1)
+    if microbatches.shape[1] % dp != 0:
+        raise ValueError(
+            f"microbatch size {microbatches.shape[1]} not divisible by dp={dp}"
+        )
+    m = microbatches.shape[0]
+    ticks = m + n_stages - 1
+
+    def per_device(params_stacked, xs):
+        # in_spec P(axis) leaves a unit stage dim; strip it
+        params = jax.tree.map(lambda a: a[0], params_stacked)
+        stage = jax.lax.axis_index(axis)
+        perm = [(i, i + 1) for i in range(n_stages - 1)]  # stage i -> i+1
+
+        def tick(carry, t):
+            send_buf, out = carry
+            # what stage-1 produced last tick arrives here; ranks with no
+            # source (stage 0) receive zeros, which they never read
+            recv = jax.lax.ppermute(send_buf, axis, perm)
+            mb = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, m - 1), keepdims=False
+            )
+            x = jnp.where(stage == 0, mb, recv)
+            y = stage_fn(params, x)
+            # the last stage finished microbatch t-(S-1) this tick
+            done = t - (n_stages - 1)
+            write = jnp.logical_and(done >= 0, stage == n_stages - 1)
+            upd = jax.lax.dynamic_update_index_in_dim(
+                out, jnp.where(write, y, jax.lax.dynamic_index_in_dim(
+                    out, jnp.clip(done, 0, m - 1), keepdims=False
+                )), jnp.clip(done, 0, m - 1), axis=0,
+            )
+            return (y, upd), None
+
+        init = (jnp.zeros_like(xs[0]), jnp.zeros_like(xs))
+        (_, out), _ = jax.lax.scan(tick, init, jnp.arange(ticks))
+        # replicate the last stage's result across pp so the caller sees
+        # one coherent array
+        mask = (stage == n_stages - 1).astype(out.dtype)
+        return jax.lax.psum(out * mask, axis)
+
+    spec_params = jax.tree.map(
+        lambda a: P(axis, *([None] * (a.ndim - 1))), stacked_params
+    )
+    # the microbatch dim shards over dp (each dp column pipelines its own
+    # batch shard — pp and dp compose instead of dp replicating the work);
+    # params replicate over dp automatically (spec names only `axis`)
+    data_spec = P(None, "dp")
+    return jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(spec_params, data_spec),
+        out_specs=data_spec,
+        check_vma=False,  # psum over the stage mask makes the output invariant
+    )(stacked_params, microbatches)

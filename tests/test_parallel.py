@@ -34,7 +34,7 @@ def test_forward_shapes_and_dtype():
 
 def test_make_mesh_factorizations():
     mesh = make_mesh(dp=2, sp=2, tp=2)
-    assert dict(mesh.shape) == {"dp": 2, "sp": 2, "tp": 2}
+    assert dict(mesh.shape) == {"pp": 1, "dp": 2, "sp": 2, "tp": 2}
     mesh = make_mesh()  # all defaults -> everything on dp
     assert mesh.shape["dp"] == 8
     with pytest.raises(ValueError):
