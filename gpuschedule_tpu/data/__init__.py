@@ -1,0 +1,17 @@
+"""Input pipelines: token datasets and device-prefetched batch iterators.
+
+The host→device feed for :class:`~gpuschedule_tpu.parallel.ShardedTrainer`
+(its ``make_batch`` covers benchmarks; real training reads data).  Design
+follows the TPU input recipe: batches materialize on host (numpy,
+memory-mapped), are placed with the trainer's batch sharding via
+``jax.device_put``, and a small prefetch queue keeps N batches in flight
+so host IO overlaps device steps.
+"""
+
+from gpuschedule_tpu.data.loader import (
+    TokenFileDataset,
+    prefetch_to_device,
+    synthetic_lm_batches,
+)
+
+__all__ = ["TokenFileDataset", "synthetic_lm_batches", "prefetch_to_device"]

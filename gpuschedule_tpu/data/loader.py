@@ -1,0 +1,145 @@
+"""Token datasets + device prefetch.
+
+Three pieces, composable and small:
+
+- :class:`TokenFileDataset` — a flat binary token file (any integer
+  dtype), memory-mapped, cut into fixed (batch, seq) blocks.  Mmap keeps
+  the host working set at one batch regardless of corpus size; epochs
+  reshuffle block order deterministically per seed.
+- :func:`synthetic_lm_batches` — the zero-IO stand-in with the same
+  iterator contract (benchmarks, tests, profiling).
+- :func:`prefetch_to_device` — wraps any host-batch iterator, placing
+  each batch with ``jax.device_put`` (optionally with a ``Sharding``) and
+  keeping ``size`` batches in flight: transfers overlap the device's
+  current step, the standard TPU input-pipeline pattern.
+
+The loader is sharding-agnostic on purpose: pass the trainer's
+``batch_sharding`` and the same iterator feeds a 1-chip run or a dp/sp
+mesh — placement, not the reader, changes.
+"""
+
+from __future__ import annotations
+
+import collections
+from pathlib import Path
+from typing import Iterator, Optional
+
+import numpy as np
+
+__all__ = ["TokenFileDataset", "synthetic_lm_batches", "prefetch_to_device"]
+
+
+class TokenFileDataset:
+    """Fixed-shape LM batches from a flat binary token file.
+
+    ``path`` holds tokens as a 1-D array of ``dtype``; blocks of
+    ``batch * seq_len`` consecutive tokens become one (batch, seq_len)
+    int32 batch.  Block order shuffles per (epoch, seed); the tail that
+    doesn't fill a block is dropped (static shapes — XLA compiles one
+    program).
+    """
+
+    def __init__(
+        self,
+        path: str | Path,
+        *,
+        batch_size: int,
+        seq_len: int,
+        dtype: str = "uint16",
+        seed: int = 0,
+    ):
+        self.path = Path(path)
+        self.batch_size = int(batch_size)
+        self.seq_len = int(seq_len)
+        if self.batch_size < 1 or self.seq_len < 1:
+            raise ValueError("batch_size and seq_len must be >= 1")
+        self.seed = seed
+        self._tokens = np.memmap(self.path, dtype=np.dtype(dtype), mode="r")
+        self.block = self.batch_size * self.seq_len
+        self.num_batches = len(self._tokens) // self.block
+        if self.num_batches == 0:
+            raise ValueError(
+                f"{self.path} holds {len(self._tokens)} tokens; "
+                f"one batch needs {self.block}"
+            )
+
+    def __len__(self) -> int:
+        return self.num_batches
+
+    def batches(self, *, epoch: int = 0) -> Iterator[np.ndarray]:
+        """Yield every batch once, order shuffled per (seed, epoch)."""
+        order = np.random.default_rng((self.seed, epoch)).permutation(
+            self.num_batches
+        )
+        for i in order:
+            start = int(i) * self.block
+            chunk = np.asarray(self._tokens[start:start + self.block])
+            yield chunk.astype(np.int32).reshape(self.batch_size, self.seq_len)
+
+    @staticmethod
+    def write(tokens, path: str | Path, *, dtype: str = "uint16") -> Path:
+        """Write a token array as a dataset file (test/tooling helper).
+
+        Refuses token ids outside the target dtype's range — np.astype
+        would silently wrap them (vocab > 65536 under the uint16 default)
+        and training would run on corrupted data."""
+        arr = np.asarray(tokens)
+        info = np.iinfo(np.dtype(dtype))
+        if arr.size and (arr.min() < info.min or arr.max() > info.max):
+            raise ValueError(
+                f"token ids span [{arr.min()}, {arr.max()}], outside "
+                f"{dtype}'s [{info.min}, {info.max}]; pick a wider dtype"
+            )
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        arr.astype(np.dtype(dtype)).tofile(path)
+        return path
+
+
+def synthetic_lm_batches(
+    *,
+    batch_size: int,
+    seq_len: int,
+    vocab: int,
+    num_batches: int,
+    seed: int = 0,
+) -> Iterator[np.ndarray]:
+    """Deterministic random token batches with the dataset iterator
+    contract — the zero-IO feed for benchmarks and profiling."""
+    rng = np.random.default_rng(seed)
+    for _ in range(num_batches):
+        yield rng.integers(
+            0, vocab, size=(batch_size, seq_len), dtype=np.int32
+        )
+
+
+def prefetch_to_device(
+    iterator,
+    *,
+    size: int = 2,
+    sharding: Optional[object] = None,
+):
+    """Keep ``size`` device-placed batches in flight ahead of the consumer.
+
+    ``jax.device_put`` is async: enqueueing the transfer returns
+    immediately, so while the device runs step N the host is already
+    copying batches N+1..N+size.  Pass the trainer's ``batch_sharding``
+    to land shards directly on their mesh positions.
+    """
+    import jax
+
+    if size < 1:
+        raise ValueError(f"prefetch size must be >= 1, got {size}")
+    queue: collections.deque = collections.deque()
+
+    def put(batch):
+        return jax.device_put(batch, sharding) if sharding is not None else (
+            jax.device_put(batch)
+        )
+
+    for batch in iterator:
+        queue.append(put(batch))
+        if len(queue) == size:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()
