@@ -243,6 +243,113 @@ def cmd_compare_topology(args) -> int:
     return 0
 
 
+def cmd_train(args) -> int:
+    """Actually train a model — the framework's user-facing training entry
+    (mesh + trainer + input pipeline + checkpoint in one command).
+
+    Drives the same ShardedTrainer the profiler measures: build a
+    (dp, sp, tp) mesh over the visible devices, feed it from a token file
+    (``--data``) or the synthetic generator, optionally restore from /
+    save to an orbax checkpoint, and print one JSON summary line."""
+    import jax
+
+    from gpuschedule_tpu.data import (
+        TokenFileDataset,
+        prefetch_to_device,
+        synthetic_lm_batches,
+    )
+    from gpuschedule_tpu.parallel import (
+        ShardedTrainer,
+        make_mesh,
+        restore_state,
+        save_state,
+    )
+
+    if args.steps < 1:
+        raise SystemExit("--steps must be >= 1")
+    devs = jax.devices()[: args.devices] if args.devices else jax.devices()
+    mesh = make_mesh(sp=args.sp, tp=args.tp, devices=devs)
+    trainer = ShardedTrainer(
+        args.model,
+        mesh,
+        batch_size=args.batch_size,
+        seq_len=args.seq_len,
+        learning_rate=args.lr,
+        flash_attn=args.flash_attn,
+    )
+    if trainer.is_image:
+        raise SystemExit(
+            f"{args.model!r} is a CNN config; `train` feeds LM token "
+            "batches (image models are profile-only for now)"
+        )
+    state = (
+        restore_state(trainer, args.restore) if args.restore
+        else trainer.init(seed=args.seed)
+    )
+
+    if args.data:
+        ds = TokenFileDataset(
+            args.data, batch_size=trainer.batch_size, seq_len=args.seq_len,
+            dtype=args.data_dtype, seed=args.seed,
+        )
+
+        def batches():
+            epoch = 0
+            while True:
+                yield from ds.batches(epoch=epoch)
+                epoch += 1
+    else:
+        def batches():
+            yield from synthetic_lm_batches(
+                batch_size=trainer.batch_size, seq_len=args.seq_len,
+                vocab=trainer.cfg.vocab, num_batches=args.steps,
+                seed=args.seed,
+            )
+
+    import itertools
+    import time as _time
+
+    first_loss = None
+    t0 = None
+    feed = prefetch_to_device(
+        itertools.islice(batches(), args.steps), size=2,
+        sharding=trainer.batch_sharding,
+    )
+    n = 0
+    for batch in feed:
+        state, loss = trainer.step(state, batch)
+        n += 1
+        if first_loss is None:
+            # the float() readback fences compile+step 1; the timed
+            # window starts here so tokens_per_s reports warm throughput
+            first_loss = float(loss)
+            t0 = _time.perf_counter()
+    last_loss = float(loss)
+    elapsed = _time.perf_counter() - t0
+    tokens_per_s = (
+        round((n - 1) * trainer.batch_size * args.seq_len / elapsed, 1)
+        if n > 1 and elapsed > 0
+        else None  # one step is all compile; no honest rate to report
+    )
+    if args.ckpt:
+        save_state(state, args.ckpt)
+    print(
+        json.dumps(
+            {
+                "model": args.model,
+                "steps": n,
+                "mesh": dict(mesh.shape),
+                "first_loss": first_loss,
+                "last_loss": last_loss,
+                "tokens_per_s": tokens_per_s,
+                "checkpoint": args.ckpt or None,
+            },
+            sort_keys=True,
+        )
+    )
+    return 0
+
+
 def cmd_profile(args) -> int:
     from gpuschedule_tpu.profiler import CurveCache
     from gpuschedule_tpu.profiler.harness import capture_trace, profile_model
@@ -340,6 +447,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                            "report the acceptance band per load")
     cmp_.add_argument("--out")
     cmp_.set_defaults(fn=cmd_compare_topology)
+
+    tr = sub.add_parser("train", help="train a model on a device mesh")
+    tr.add_argument("--model", required=True)
+    tr.add_argument("--steps", type=int, default=10)
+    tr.add_argument("--batch-size", type=int, default=8)
+    tr.add_argument("--seq-len", type=int, default=128)
+    tr.add_argument("--lr", type=float, default=1e-3)
+    tr.add_argument("--sp", type=int, default=1)
+    tr.add_argument("--tp", type=int, default=1)
+    tr.add_argument("--devices", type=int,
+                    help="use only the first N devices (default: all)")
+    tr.add_argument("--seed", type=int, default=0)
+    tr.add_argument("--flash-attn", action="store_true",
+                    help="blockwise pallas attention core")
+    tr.add_argument("--data", help="flat binary token file (see data/)")
+    tr.add_argument("--data-dtype", default="uint16")
+    tr.add_argument("--ckpt", help="save final state here (orbax)")
+    tr.add_argument("--restore", help="resume from this checkpoint")
+    tr.set_defaults(fn=cmd_train)
 
     prof = sub.add_parser("profile", help="fit goodput curves on live devices")
     prof.add_argument("--model", action="append", required=True)

@@ -174,3 +174,56 @@ def test_acceptance_band_semantics():
     assert z["jct_delta_pct"] is None and z["makespan_delta_pct"] == 0.0
     assert z["within_5pct"] is False
     json.dumps(z)  # must remain strict JSON
+
+
+def test_train_subcommand_end_to_end(tmp_path, capsys):
+    """`cli train`: synthetic feed -> sharded steps -> checkpoint; then a
+    second invocation resumes from it on a different mesh shape."""
+    pytest.importorskip("jax", reason="train needs the [profiler] extra")
+    rc, out = run_cli(
+        capsys,
+        "train", "--model", "transformer-tiny", "--steps", "3",
+        "--batch-size", "4", "--seq-len", "32", "--devices", "4",
+        "--ckpt", str(tmp_path / "ckpt"),
+    )
+    assert rc == 0
+    summary = json.loads(out[-1])
+    assert summary["steps"] == 3
+    assert summary["mesh"]["dp"] == 4
+    assert summary["last_loss"] == summary["last_loss"]  # finite
+    assert summary["tokens_per_s"] > 0
+    assert (tmp_path / "ckpt").exists()
+
+    # resume on dp=1 x tp=2: the cross-mesh elastic restore through the CLI
+    rc2, out2 = run_cli(
+        capsys,
+        "train", "--model", "transformer-tiny", "--steps", "2",
+        "--batch-size", "4", "--seq-len", "32", "--devices", "2",
+        "--tp", "2", "--restore", str(tmp_path / "ckpt"),
+    )
+    assert rc2 == 0
+    s2 = json.loads(out2[-1])
+    assert s2["steps"] == 2 and s2["mesh"]["tp"] == 2
+    # warm start: resumes below the cold run's first loss
+    assert s2["first_loss"] < summary["first_loss"]
+
+
+def test_train_subcommand_token_file(tmp_path, capsys):
+    pytest.importorskip("jax")
+    import numpy as np
+
+    from gpuschedule_tpu.data import TokenFileDataset
+
+    rng = np.random.default_rng(0)
+    corpus = TokenFileDataset.write(
+        rng.integers(0, 8000, size=4 * 32 * 4), tmp_path / "c.bin"
+    )
+    rc, out = run_cli(
+        capsys,
+        "train", "--model", "transformer-tiny", "--steps", "2",
+        "--batch-size", "4", "--seq-len", "32", "--devices", "2",
+        "--data", str(corpus),
+    )
+    assert rc == 0
+    s = json.loads(out[-1])
+    assert s["steps"] == 2 and s["last_loss"] == s["last_loss"]
