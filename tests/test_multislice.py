@@ -200,6 +200,79 @@ def test_cross_pod_allreduce_seconds_basic():
     assert 0 < t2 < t4 < 2 * t2  # (m-1)/m asymptote, not linear
 
 
+def test_multislice_random_alloc_free_invariants():
+    """Hypothesis-style invariant suite for the multi-pod allocator:
+    random interleavings of in-pod slices and whole-pod multislices keep
+    conservation, non-overlap, and the can_allocate<->allocate agreement
+    intact (the multislice arm must stay an exact feasibility oracle)."""
+    import math
+
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    from gpuschedule_tpu.cluster import valid_slice_shapes
+
+    def expand(geom):
+        return list(geom.slices) if isinstance(geom, MultiSliceGeometry) else [geom]
+
+    def check(c):
+        # the multislice-aware sibling of test_tpu_cluster._check_invariants
+        # (that one iterates live_slices() assuming single-pod geometry)
+        slices = [s for g in c.live_slices() for s in expand(g)]
+        assert c.used_chips == sum(s.num_chips for s in slices)
+        assert 0 <= c.used_chips <= c.total_chips
+        # occupancy grids agree with the accounting exactly
+        assert c.used_chips == sum(int(occ.sum()) for occ in c._occ)
+        seen = set()
+        for s in slices:
+            assert math.prod(s.shape) == s.num_chips
+            assert all(
+                o >= 0 and o + e <= d
+                for o, e, d in zip(s.origin, s.shape, c.dims)
+            )
+            assert s.shape in valid_slice_shapes(s.num_chips, c.dims)
+            for coord in s.chips():
+                key = (s.pod, coord)
+                assert key not in seen, f"overlap at {key}"
+                seen.add(key)
+
+    @settings(max_examples=40, deadline=None)
+    @given(
+        ops=st.lists(
+            st.tuples(
+                st.sampled_from(["alloc", "free"]),
+                st.sampled_from([1, 2, 4, 8, 16, 32, 48, 3, 24, 64]),
+                st.integers(0, 10**6),
+            ),
+            max_size=50,
+        )
+    )
+    def run(ops):
+        c = TpuCluster("v5e", dims=(4, 4), num_pods=3)  # 3 x 16 chips
+        handles = []
+        for kind, size, r in ops:
+            if kind == "alloc":
+                feasible = c.can_allocate(size)
+                a = c.allocate(size)
+                assert (a is not None) == feasible, (
+                    f"can_allocate({size})={feasible} but allocate "
+                    f"{'succeeded' if a else 'failed'}"
+                )
+                if a is not None:
+                    assert a.num_chips == size
+                    handles.append(a)
+            elif handles:
+                c.free(handles.pop(r % len(handles)))
+            check(c)
+        for a in handles:
+            c.free(a)
+        check(c)
+        assert c.free_chips == c.total_chips
+        assert c.allocate(48) is not None  # full fleet allocatable again
+
+    run()
+
+
 def test_multislice_at_scale_stays_fast():
     """The empty-pod scan in the multislice allocator must not drag the
     engine's scaling: 10k jobs + 1% whales on a 4-pod fleet replay in
