@@ -376,7 +376,28 @@ def cmd_profile(args) -> int:
     return 0
 
 
+def _apply_platform_override() -> None:
+    """Make the JAX_PLATFORMS env var mean what it says.
+
+    This image's sitecustomize registers the axon TPU plugin at
+    interpreter boot, which overrides the env var; only a programmatic
+    config update before first backend access restores it (same hook as
+    bench.py / tests/conftest.py).  No-op when the var is unset or jax is
+    not installed — the sim core stays jax-free."""
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    try:
+        import jax
+    except ImportError:
+        return
+    jax.config.update("jax_platforms", plat)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
+    _apply_platform_override()
     p = argparse.ArgumentParser(prog="gpuschedule_tpu", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
 
