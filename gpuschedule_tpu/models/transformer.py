@@ -175,6 +175,43 @@ class Block(nn.Module):
         return x + h
 
 
+class Embedder(nn.Module):
+    """Token + position embedding — the pre-pipeline boundary of a staged
+    LM (parallel/pipeline.py PipelinedLM); param names match
+    :class:`TransformerLM` so the partition rules apply unchanged."""
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        c = self.cfg
+        x = nn.Embed(
+            c.vocab, c.d_model, dtype=jnp.bfloat16, param_dtype=jnp.float32, name="embed"
+        )(tokens)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (c.max_seq, c.d_model),
+            jnp.float32,
+        )
+        return x + pos[None, : tokens.shape[1], :].astype(jnp.bfloat16)
+
+
+class LMHead(nn.Module):
+    """Final LN + logits — the post-pipeline boundary of a staged LM."""
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = self.cfg
+        x = nn.LayerNorm(dtype=jnp.bfloat16, name="ln_f")(x)
+        logits = nn.Dense(
+            c.vocab, dtype=jnp.bfloat16, param_dtype=jnp.float32, name="lm_head"
+        )(x)
+        return logits.astype(jnp.float32)  # f32 softmax for stable loss
+
+
 class TransformerLM(nn.Module):
     """Causal LM: embed → blocks → final LN → logits (tied to f32 head)."""
 

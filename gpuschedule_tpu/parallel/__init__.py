@@ -14,7 +14,11 @@ from gpuschedule_tpu.parallel.checkpoint import (
     save_state,
 )
 from gpuschedule_tpu.parallel.mesh import make_mesh
-from gpuschedule_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from gpuschedule_tpu.parallel.pipeline import (
+    PipelinedLM,
+    pipeline_apply,
+    stack_stage_params,
+)
 from gpuschedule_tpu.parallel.ringattn import ring_attention
 from gpuschedule_tpu.parallel.train import ShardedTrainer, param_partition_spec
 
@@ -28,4 +32,5 @@ __all__ = [
     "reshard_state",
     "pipeline_apply",
     "stack_stage_params",
+    "PipelinedLM",
 ]

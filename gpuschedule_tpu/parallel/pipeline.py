@@ -28,7 +28,12 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["pipeline_apply", "stack_stage_params"]
+try:  # optax ships with the [profiler] extra, like the rest of parallel/
+    import optax
+except ImportError:  # pragma: no cover - pipeline needs the extra anyway
+    optax = None
+
+__all__ = ["pipeline_apply", "stack_stage_params", "PipelinedLM"]
 
 
 def stack_stage_params(params_list):
@@ -119,3 +124,152 @@ def pipeline_apply(
         out_specs=data_spec,
         check_vma=False,  # psum over the stage mask makes the output invariant
     )(stacked_params, microbatches)
+
+
+class PipelinedLM:
+    """A trainable LM with its block stack pipelined over the pp axis.
+
+    The staged form of :class:`~gpuschedule_tpu.parallel.ShardedTrainer`'s
+    model: embedding and head run at the boundaries (replicated — they
+    are a small fraction of the FLOPs), and the ``n_layers`` transformer
+    blocks split into ``pp`` equal stages driven by
+    :func:`pipeline_apply`.  One ``jax.jit`` holds the whole train step —
+    fwd pipeline, loss, the autodiff backward pipeline, and the adamw
+    update — so the reverse-sweep schedule is compiled, not orchestrated.
+
+    Correctness-first reference implementation: microbatch count M sets
+    the bubble fraction (S-1)/(M+S-1); the per-tick activations the
+    backward needs are stored by the scan (memory ~ ticks x microbatch),
+    which is the GPipe tradeoff.
+    """
+
+    def __init__(
+        self,
+        model_name: str,
+        mesh: Mesh,
+        *,
+        batch_size: int,
+        seq_len: int,
+        num_microbatches: int = 4,
+        learning_rate: float = 1e-3,
+    ):
+        import flax.linen as nn
+
+        from gpuschedule_tpu.models import MODEL_CONFIGS
+        from gpuschedule_tpu.models.transformer import Block, Embedder, LMHead
+
+        cfg = MODEL_CONFIGS[model_name]
+        pp = mesh.shape["pp"]
+        if pp < 2:
+            raise ValueError(f"PipelinedLM needs a pp>=2 mesh, got pp={pp}")
+        if cfg.n_experts:
+            # MoE blocks sow their load-balancing aux loss; the pipelined
+            # stage_fn has no mutable-collection plumbing yet, so training
+            # one here would silently drop the aux term (and leak sown
+            # scalars into the optimizer state) — refuse instead
+            raise ValueError(
+                f"{model_name} is an MoE config; PipelinedLM does not "
+                "pipeline MoE blocks yet (use ShardedTrainer)"
+            )
+        if cfg.n_layers % pp:
+            raise ValueError(
+                f"{model_name} has {cfg.n_layers} layers, not divisible by pp={pp}"
+            )
+        if batch_size % num_microbatches:
+            raise ValueError(
+                f"batch {batch_size} not divisible by {num_microbatches} microbatches"
+            )
+        self.cfg = cfg
+        self.mesh = mesh
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.num_microbatches = num_microbatches
+        self.layers_per_stage = cfg.n_layers // pp
+        # honor the config's remat flag exactly like TransformerLM does:
+        # long-sequence configs trade FLOPs for HBM inside each stage
+        self._block = (nn.remat(Block) if cfg.remat else Block)(cfg)
+        self._embed = Embedder(cfg)
+        self._head = LMHead(cfg)
+        self.tx = optax.adamw(learning_rate)
+
+        def stage_fn(stage_params, x):
+            for i in range(self.layers_per_stage):  # static unroll
+                x = self._block.apply(stage_params[f"layer{i}"], x)
+            return x
+
+        def loss_fn(params, tokens):
+            b, s = tokens.shape
+            m = self.num_microbatches
+            x = self._embed.apply(params["embed"], tokens)
+            xs = x.reshape(m, b // m, s, cfg.d_model)
+            ys = pipeline_apply(
+                stage_fn, params["stages"], xs, mesh=mesh
+            )
+            logits = self._head.apply(params["head"], ys.reshape(b, s, -1))
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1, :], tokens[:, 1:]
+            ).mean()
+
+        def step_fn(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._loss_fn = loss_fn
+        self._step = jax.jit(step_fn, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------ #
+
+    def init(self, seed: int = 0):
+        """(params, opt_state): embed/head boundaries + pp-stacked stages."""
+        cfg = self.cfg
+        pp = self.mesh.shape["pp"]
+        keys = jax.random.split(jax.random.PRNGKey(seed), cfg.n_layers + 2)
+        tokens = jnp.zeros((2, min(8, self.seq_len)), dtype=jnp.int32)
+        e_params = self._embed.init(keys[0], tokens)
+        x = self._embed.apply(e_params, tokens)
+        h_params = self._head.init(keys[1], x)
+        per_stage = []
+        k = 0
+        for _ in range(pp):
+            stage = {}
+            for i in range(self.layers_per_stage):
+                stage[f"layer{i}"] = self._block.init(keys[2 + k], x)
+                k += 1
+            per_stage.append(stage)
+        params = {
+            "embed": e_params,
+            "head": h_params,
+            "stages": stack_stage_params(per_stage),
+        }
+        return params, self.tx.init(params)
+
+    def make_batch(self, seed: int = 0):
+        key = jax.random.PRNGKey(seed)
+        return jax.random.randint(
+            key, (self.batch_size, self.seq_len), 0, self.cfg.vocab,
+            dtype=jnp.int32,
+        )
+
+    def step(self, state, tokens):
+        """One pipelined optimizer step; returns (new_state, loss)."""
+        params, opt_state = state
+        with self.mesh:
+            params, opt_state, loss = self._step(params, opt_state, tokens)
+        return (params, opt_state), loss
+
+    def reference_loss(self, params, tokens):
+        """The same math with the blocks applied sequentially (no
+        pipeline) — the parity oracle for tests."""
+        cfg = self.cfg
+        pp = self.mesh.shape["pp"]
+        x = self._embed.apply(params["embed"], tokens)
+        for s in range(pp):
+            stage = jax.tree.map(lambda a: a[s], params["stages"])
+            for i in range(self.layers_per_stage):
+                x = self._block.apply(stage[f"layer{i}"], x)
+        logits = self._head.apply(params["head"], x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1, :], tokens[:, 1:]
+        ).mean()
