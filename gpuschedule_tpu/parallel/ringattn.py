@@ -25,6 +25,23 @@ trainer passes) or, failing that, the ambient mesh set with
 ``jax.sharding.set_mesh``/``use_abstract_mesh``.  Note the legacy
 ``with mesh:`` context does NOT populate that ambient mesh in JAX 0.9 —
 pass ``mesh=`` explicitly there.
+
+**Choosing a long-context core** (the decision surface the trainer's
+``ring_attn``/``flash_attn`` flags expose):
+
+- ``flash_attn`` — one device holds the whole sequence; the pallas
+  kernels (:mod:`gpuschedule_tpu.ops.flash_attention`) keep on-chip
+  memory at O(block·d) in BOTH directions.  Right whenever S fits one
+  chip's HBM as activations (S=32k trains on one v5e this way —
+  ``bench.py --longctx``).
+- ``ring_attn`` (this module) — S itself is sharded over sp chips; each
+  round computes a dense (S/P, S/P) chunk-pair product.  Right when the
+  sequence (or its activations) exceeds one chip.  Per-chunk memory is
+  O((S/P)^2) scores: at very large S/P the chunk product itself becomes
+  the limit, and the composition of the two — the flash recurrence as
+  this ring's per-chunk op ("ring flash attention") — is the natural
+  next step; the merge the accumulator already implements is exactly the
+  (out, lse) merge that composition needs.
 """
 
 from __future__ import annotations
