@@ -20,6 +20,13 @@ inference (the round-3 backward was a dense XLA recompute; see
 ``tests/test_flash_attention.py::test_backward_never_materializes_s_by_s``
 for the executable form of this contract).
 
+**Dtype policy.**  Matmuls run in the *input* dtype with f32 accumulation
+(``preferred_element_type``): bf16 q/k/v — the model zoo's compute dtype —
+hits the MXU at full bf16 rate, while the online-softmax state, logsumexp,
+and every probability/score stays f32.  The probability operand of the
+p·V / pᵀ·dO / dsᵀ·q dots is cast down to the value dtype (standard
+flash-attention-2 practice); f32 inputs keep the all-f32 numerics.
+
 Row statistics (running max / denominator / logsumexp / delta) are kept
 **lane-replicated at width 128** in VMEM and HBM — the layout Mosaic's
 tiling expects (f32 tiles are (8, 128); a (block_q, 1) scratch is
@@ -107,10 +114,13 @@ def _fwd_kernel(
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     def _update():
-        q = q_ref[0].astype(jnp.float32) * sm_scale
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        # Dots run in the INPUT dtype (bf16 on the train path -> full MXU
+        # rate) with f32 accumulation; sm_scale is applied to the f32
+        # product, not the operand, so bf16 q loses nothing to the scale.
+        q = q_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
         valid = _mask(
             qi, kb, block_q=block_q, block_k=block_k, causal=causal,
             seq_len=seq_len,
@@ -124,7 +134,7 @@ def _fwd_kernel(
         m_ref[...] = _rep(m_new, block_q)
         l_ref[...] = _rep(l_prev * corr + p.sum(axis=-1, keepdims=True), block_q)
         acc_ref[...] = acc_prev * corr + jnp.dot(
-            p, v_blk, preferred_element_type=jnp.float32
+            p.astype(v_blk.dtype), v_blk, preferred_element_type=jnp.float32
         )
 
     if causal:
@@ -158,11 +168,11 @@ def _bwd_dq_kernel(
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     def _update():
-        q = q_ref[0].astype(jnp.float32) * sm_scale
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        g_blk = g_ref[0].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        q = q_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        g_blk = g_ref[0]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
         valid = _mask(
             qi, kb, block_q=block_q, block_k=block_k, causal=causal,
             seq_len=seq_len,
@@ -173,7 +183,9 @@ def _bwd_dq_kernel(
         p = jnp.exp(s - _row(lse_ref[0]))
         dp = jnp.dot(g_blk, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - _row(delta_ref[0])) * sm_scale
-        acc_ref[...] += jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+        acc_ref[...] += jnp.dot(
+            ds.astype(k_blk.dtype), k_blk, preferred_element_type=jnp.float32
+        )
 
     if causal:
         @pl.when(kb * block_k <= qi * block_q + (block_q - 1))
@@ -204,10 +216,10 @@ def _bwd_dkdv_kernel(
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     def _update():
-        q = q_ref[0].astype(jnp.float32)  # unscaled: ds carries sm_scale
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        g_blk = g_ref[0].astype(jnp.float32)
+        q = q_ref[0]  # unscaled: ds carries sm_scale
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        g_blk = g_ref[0]
         s = (
             jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
         )
@@ -217,10 +229,14 @@ def _bwd_dkdv_kernel(
         )
         s = jnp.where(valid, s, NEG_INF)
         p = jnp.exp(s - _row(lse_ref[0]))
-        dv_acc[...] += jnp.dot(p.T, g_blk, preferred_element_type=jnp.float32)
+        dv_acc[...] += jnp.dot(
+            p.T.astype(g_blk.dtype), g_blk, preferred_element_type=jnp.float32
+        )
         dp = jnp.dot(g_blk, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - _row(delta_ref[0])) * sm_scale
-        dk_acc[...] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        dk_acc[...] += jnp.dot(
+            ds.T.astype(q.dtype), q, preferred_element_type=jnp.float32
+        )
 
     if causal:
         # q blocks wholly above this k block see none of it
@@ -234,6 +250,15 @@ def _bwd_dkdv_kernel(
     def _finalize():
         dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _effective_blocks(s: int, block_q: int, block_k: int) -> tuple[int, int]:
+    """Clamp block sizes to the sequence rounded up to one lane tile, so
+    large defaults never force a short sequence to pad to lcm(blocks).
+    Deterministic in (s, blocks): the backward recomputes the identical
+    clamp, keeping its padded layout aligned with the forward's saved lse."""
+    cap = -(-s // LANES) * LANES
+    return min(block_q, cap), min(block_k, cap)
 
 
 def _pad_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
@@ -263,6 +288,7 @@ def _flash_fwd_impl(q, k, v, *, causal, block_q, block_k, interpret):
     (B*H, S_pad, LANES) layout the backward kernels consume directly."""
     b, s, h, d = q.shape
     sm_scale = 1.0 / (d ** 0.5)
+    block_q, block_k = _effective_blocks(s, block_q, block_k)
     # S padded to a common multiple of both block sizes so every K/V block
     # in the grid is fully in-bounds and every valid column is visited
     s_mult = math.lcm(block_q, block_k)
@@ -309,6 +335,7 @@ def _flash_bwd_impl(q, k, v, out, lse, g, *, causal, block_q, block_k, interpret
     """Blockwise dq/dk/dv from the saved lse (flash-attention-2 backward)."""
     b, s, h, d = q.shape
     sm_scale = 1.0 / (d ** 0.5)
+    block_q, block_k = _effective_blocks(s, block_q, block_k)
     s_mult = math.lcm(block_q, block_k)
     qp = _prep(q, b, s, h, d, s_mult)
     kp = _prep(k, b, s, h, d, s_mult)
@@ -420,13 +447,19 @@ def flash_attention(
     *,
     causal: bool = True,
     block_q: int = 128,
-    block_k: int = 128,
+    block_k: int = 512,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Blockwise attention over (B, S, H, D); differentiable end-to-end
     with O(block·d) on-chip memory in BOTH directions — the backward is
     blockwise too (saved-logsumexp recompute per tile), so training with
     long sequences never materializes an (S, S) intermediate.
+
+    Default blocks are the measured v5e sweet spot (tools/kernel_bench.py
+    on the real chip, b2 S4096 h8 bf16): (128, 512) runs fwd+bwd 1.8x
+    faster than both (128, 128) and the dense-XLA path; blocks are
+    clamped to the sequence's lane-tile round-up so short sequences never
+    pad to the large default.
 
     ``interpret=None`` auto-selects pallas interpret mode off-TPU.  The
     call signature matches the model zoo's ``attn_fn`` hook, so

@@ -123,6 +123,39 @@ def test_flash_gradients_long_context():
         )
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_bf16_inputs_match_oracle(causal):
+    """bf16 q/k/v take the input-dtype MXU path (bf16 dots, f32
+    accumulate); outputs must stay within bf16 resolution of the f32
+    oracle on the same inputs."""
+    q, k, v = _qkv(s=192, d=64, dtype=jnp.bfloat16)
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    ref = _reference(qf, kf, vf, causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32), np.asarray(ref), atol=3e-2, rtol=3e-2
+    )
+
+    def loss_flash(q, k, v):
+        return (
+            flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+            .astype(jnp.float32) ** 2
+        ).sum()
+
+    def loss_ref(q, k, v):
+        return (_reference(q, k, v, causal) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(qf, kf, vf)
+    for a, b in zip(gf, gr):
+        scale = max(float(np.max(np.abs(np.asarray(b)))), 1e-6)
+        relerr = float(
+            np.max(np.abs(np.asarray(a, dtype=np.float32) - np.asarray(b)))
+        ) / scale
+        assert relerr < 5e-2, f"bf16 grad diverges from oracle: {relerr}"
+
+
 def test_backward_never_materializes_s_by_s():
     """Executable form of the memory contract: the lowered HLO of the
     jitted backward contains no (S, S)-shaped intermediate.  The round-3

@@ -1,0 +1,91 @@
+"""Operator tool: isolate flash-attention kernel speed on the real chip.
+
+Times the pallas flash kernels (fwd and fwd+bwd) against the dense-XLA
+oracle at the same shape/dtype, across block-size variants, printing one
+JSON line per measurement.  This attributes train-step time: the flash
+smoke (bench.py --flash-smoke) times a whole model, where lm_head/embed
+matmuls can dominate and mask kernel regressions or wins.
+
+Usage (each run compiles ~6 variants; expect a few minutes):
+    timeout 600 python tools/kernel_bench.py
+Shapes default to the transformer-long attention shape (b2 S4096 h8 d32)
+plus a wider-head shape (d128) where no padding waste exists.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from gpuschedule_tpu.ops import flash_attention
+from gpuschedule_tpu.ops.reference import dense_attention
+
+
+def _time(fn, *args, iters=10, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.tree_util.tree_map(lambda x: x.block_until_ready(), out)
+    # host readback fences execution on the axon transport
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+    return (time.perf_counter() - t0) / iters
+
+
+def attn_flops(b, s, h, d, causal=True):
+    """Useful FLOPs of one attention forward: qk^T + pv matmuls."""
+    full = 2 * 2 * b * h * s * s * d
+    return full / 2 if causal else full
+
+
+def run(b, s, h, d, dtype):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, s, h, d), dtype)
+    k = jax.random.normal(kk, (b, s, h, d), dtype)
+    v = jax.random.normal(kv, (b, s, h, d), dtype)
+    shape = f"b{b}s{s}h{h}d{d} {jnp.dtype(dtype).name}"
+    fl = attn_flops(b, s, h, d)
+
+    def report(name, sec, mult):
+        print(json.dumps({
+            "case": f"{shape} {name}", "ms": round(sec * 1e3, 3),
+            "tflops": round(mult * fl / sec / 1e12, 2),
+        }), flush=True)
+
+    dense = jax.jit(lambda q, k, v: dense_attention(q, k, v, causal=True))
+    report("dense fwd", _time(dense, q, k, v), 1)
+
+    def dense_loss(q, k, v):
+        return (dense_attention(q, k, v, causal=True).astype(jnp.float32) ** 2).sum()
+
+    dg = jax.jit(jax.grad(dense_loss, argnums=(0, 1, 2)))
+    report("dense fwd+bwd", _time(dg, q, k, v), 3.5)
+
+    for bq, bk in ((128, 128), (256, 256), (128, 512), (512, 128)):
+        f = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash_attention(
+            q, k, v, causal=True, block_q=bq, block_k=bk))
+        report(f"flash fwd bq{bq} bk{bk}", _time(f, q, k, v), 1)
+
+        def loss(q, k, v, bq=bq, bk=bk):
+            return (flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk
+            ).astype(jnp.float32) ** 2).sum()
+
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        report(f"flash fwd+bwd bq{bq} bk{bk}", _time(g, q, k, v), 3.5)
+
+
+if __name__ == "__main__":
+    print(json.dumps({"backend": jax.default_backend(),
+                      "device": str(jax.devices()[0])}), flush=True)
+    run(2, 4096, 8, 32, jnp.bfloat16)   # transformer-long shape (d padded 4x)
+    run(2, 4096, 8, 128, jnp.bfloat16)  # no-padding shape
