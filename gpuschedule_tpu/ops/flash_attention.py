@@ -283,9 +283,13 @@ def _unprep(x, b, s, h, d):
     return jnp.transpose(x, (0, 2, 1, 3))
 
 
-def _flash_fwd_impl(q, k, v, *, causal, block_q, block_k, interpret):
+def _flash_fwd_impl(q, k, v, *, causal, block_q, block_k, interpret,
+                    out_dtype=None):
     """Returns (out, lse) — lse in the padded lane-replicated
-    (B*H, S_pad, LANES) layout the backward kernels consume directly."""
+    (B*H, S_pad, LANES) layout the backward kernels consume directly.
+    ``out_dtype`` overrides the output dtype (the ring chunk path asks
+    for f32 so per-hop contributions are not rounded before its f32
+    accumulation); default follows q."""
     b, s, h, d = q.shape
     sm_scale = 1.0 / (d ** 0.5)
     block_q, block_k = _effective_blocks(s, block_q, block_k)
@@ -318,7 +322,7 @@ def _flash_fwd_impl(q, k, v, *, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, LANES), lambda i, j, kb: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s_pad, d_pad), q.dtype),
+            jax.ShapeDtypeStruct((bh, s_pad, d_pad), out_dtype or q.dtype),
             jax.ShapeDtypeStruct((bh, s_pad, LANES), jnp.float32),
         ],
         scratch_shapes=[
@@ -331,8 +335,10 @@ def _flash_fwd_impl(q, k, v, *, causal, block_q, block_k, interpret):
     return _unprep(out, b, s, h, d), lse
 
 
-def _flash_bwd_impl(q, k, v, out, lse, g, *, causal, block_q, block_k, interpret):
-    """Blockwise dq/dk/dv from the saved lse (flash-attention-2 backward)."""
+def _flash_bwd_impl(q, k, v, out, lse, g, *, causal, block_q, block_k, interpret,
+                    out_dtype=None):
+    """Blockwise dq/dk/dv from the saved lse (flash-attention-2 backward).
+    ``out_dtype`` as in :func:`_flash_fwd_impl` (grad dtype override)."""
     b, s, h, d = q.shape
     sm_scale = 1.0 / (d ** 0.5)
     block_q, block_k = _effective_blocks(s, block_q, block_k)
@@ -373,7 +379,7 @@ def _flash_bwd_impl(q, k, v, out, lse, g, *, causal, block_q, block_k, interpret
             lse_spec_q,
         ],
         out_specs=pl.BlockSpec((1, block_q, d_pad), lambda i, j, kb: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s_pad, d_pad), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, s_pad, d_pad), out_dtype or q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d_pad), jnp.float32)],
         interpret=interp,
     )(qp, kp, vp, gp, lse, delta)
@@ -395,8 +401,8 @@ def _flash_bwd_impl(q, k, v, out, lse, g, *, causal, block_q, block_k, interpret
             pl.BlockSpec((1, block_k, d_pad), lambda i, j, qi: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s_pad, d_pad), k.dtype),
-            jax.ShapeDtypeStruct((bh, s_pad, d_pad), v.dtype),
+            jax.ShapeDtypeStruct((bh, s_pad, d_pad), out_dtype or k.dtype),
+            jax.ShapeDtypeStruct((bh, s_pad, d_pad), out_dtype or v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d_pad), jnp.float32),
@@ -438,6 +444,51 @@ def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_chunk_fwd(
+    q, k, v, *, causal, block_q=128, block_k=512, interpret=None
+):
+    """(out, lse_rows) for one (q-chunk, k-chunk) pair — the per-chunk op
+    of the cross-chip ring composition (parallel/ringflash.py).
+
+    ``lse_rows`` comes back in plain (B, H, S) row layout so the ring can
+    merge partial results with the associative (out, lse) flash merge.
+    Not differentiable on its own: the ring defines its OWN custom vjp
+    (a second ring pass over :func:`flash_chunk_bwd`), which is why this
+    returns the raw forward pieces instead of routing through ``_flash``.
+    """
+    b, s, h, d = q.shape
+    out, lse = _flash_fwd_impl(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret, out_dtype=jnp.float32,
+    )
+    return out, lse[:, :s, 0].reshape(b, h, s)
+
+
+def flash_chunk_bwd(
+    q, k, v, out, lse_rows, g, *, causal, block_q=128, block_k=512,
+    interpret=None,
+):
+    """(dq, dk, dv) contribution of one (q-chunk, k-chunk) pair given the
+    GLOBAL logsumexp: the flash-attention-2 identity p = exp(s − lse)
+    yields exactly-normalized probabilities per pair, so per-pair
+    contributions sum to the true gradient — the property that lets a
+    ring accumulate dk/dv as each block passes by.  ``out``/``g`` are the
+    final merged output and its cotangent (delta is recomputed from them
+    per call; O(S·d), no (S, S) term)."""
+    b, s, h, d = q.shape
+    bq, bk = _effective_blocks(s, block_q, block_k)
+    s_mult = math.lcm(bq, bk)
+    s_pad = s + ((-s) % s_mult)
+    lse_flat = _pad_to(lse_rows.reshape(b * h, s), 1, s_mult)
+    lse_full = jnp.broadcast_to(
+        lse_flat[..., None], (b * h, s_pad, LANES)
+    )
+    return _flash_bwd_impl(
+        q, k, v, out, lse_full, g, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=interpret, out_dtype=jnp.float32,
+    )
 
 
 def flash_attention(

@@ -20,11 +20,13 @@ from gpuschedule_tpu.parallel.pipeline import (
     stack_stage_params,
 )
 from gpuschedule_tpu.parallel.ringattn import ring_attention
+from gpuschedule_tpu.parallel.ringflash import ring_flash_attention
 from gpuschedule_tpu.parallel.train import ShardedTrainer, param_partition_spec
 
 __all__ = [
     "make_mesh",
     "ring_attention",
+    "ring_flash_attention",
     "ShardedTrainer",
     "param_partition_spec",
     "save_state",

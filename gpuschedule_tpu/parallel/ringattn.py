@@ -36,12 +36,13 @@ pass ``mesh=`` explicitly there.
   ``bench.py --longctx``).
 - ``ring_attn`` (this module) — S itself is sharded over sp chips; each
   round computes a dense (S/P, S/P) chunk-pair product.  Right when the
-  sequence (or its activations) exceeds one chip.  Per-chunk memory is
-  O((S/P)^2) scores: at very large S/P the chunk product itself becomes
-  the limit, and the composition of the two — the flash recurrence as
-  this ring's per-chunk op ("ring flash attention") — is the natural
-  next step; the merge the accumulator already implements is exactly the
-  (out, lse) merge that composition needs.
+  sequence (or its activations) exceeds one chip and S/P is moderate;
+  per-chunk memory is O((S/P)^2) scores.
+- ``ring_attn + flash_attn`` — the composition
+  (:mod:`gpuschedule_tpu.parallel.ringflash`): this ring's ppermute
+  rotation with the pallas kernel as the per-chunk op and a second-ring
+  pass backward.  O(block·d) on-chip at both levels; the config for
+  sequences too big for one chip at large S/P.
 """
 
 from __future__ import annotations

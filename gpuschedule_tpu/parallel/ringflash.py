@@ -1,0 +1,219 @@
+"""Ring flash attention: blockwise pallas kernels inside the ring.
+
+The composition the two long-context cores point at (see the decision
+surface in :mod:`gpuschedule_tpu.parallel.ringattn`): the sequence is
+sharded over the mesh's ``sp`` axis and K/V blocks rotate by
+``lax.ppermute`` exactly as in :func:`ring_attention` — but the per
+chunk-pair product is the VMEM-blocked flash kernel
+(:func:`gpuschedule_tpu.ops.flash_attention.flash_chunk_fwd`) instead of
+a dense (S/P, S/P) einsum, so on-chip memory is O(block·d) at BOTH
+levels: across chips (ring, O(S/P) activations) and within a chip
+(pallas, block-sized tiles).  No (S/P, S/P) score matrix exists anywhere.
+
+**Forward.**  Each chunk pair returns (out, lse); partial results merge
+with the associative flash merge — softmax over the union of key sets:
+
+    lse_new = logaddexp(lse_a, lse_b)
+    out_new = out_a·e^(lse_a − lse_new) + out_b·e^(lse_b − lse_new)
+
+Causality is decided per pair by ring position (``lax.cond``): the
+diagonal pair runs the causal kernel, past pairs run unmasked, and
+future pairs skip the kernel entirely — the branch is real on TPU, so
+the causal half of the work is not just masked but *not executed*.
+
+**Backward** is its own second ring pass (a custom vjp, NOT autodiff
+through the forward loop — that would save every visiting K/V block and
+re-materialize O(S) residuals per device).  Residuals are only the local
+(q, k, v, out, lse): the flash-attention-2 identity p = exp(s − lse)
+makes per-pair gradient contributions exact given the *global* lse, so
+each device accumulates dq locally while dk/dv accumulators ride the
+ring WITH their K/V block — after P rotations every block arrives home
+carrying its full gradient.  Comm volume is 2× the forward's (k, v, dk,
+dv per hop), the standard ring-attention backward cost.
+
+Off-TPU the inner kernels run in pallas interpret mode (same code path),
+so the 8-device CPU-mesh tests exercise the full composition.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gpuschedule_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_chunk_bwd,
+    flash_chunk_fwd,
+)
+from gpuschedule_tpu.ops.reference import NEG_INF
+
+
+def _merge(out_run, lse_run, out_i, lse_i):
+    """Fold one chunk pair's (out, lse) into the running (f32) pair.
+
+    NEG_INF is a finite sentinel (-1e30, ops/reference.py), so logaddexp
+    and the weights stay finite with no nan guard: a skipped pair merges
+    zero output at vanishing weight, and a row no pair has touched yet
+    merges two zeros (at ~half weight each — still exactly zero)."""
+    lse_new = jnp.logaddexp(lse_run, lse_i)
+    # (B, H, L) row weights onto (B, L, H, D) outputs
+    wr = jnp.transpose(jnp.exp(lse_run - lse_new), (0, 2, 1))[..., None]
+    wi = jnp.transpose(jnp.exp(lse_i - lse_new), (0, 2, 1))[..., None]
+    return out_run * wr + out_i * wi, lse_new
+
+
+def _make_local(sp_size, axis, causal, block_q, block_k, interpret):
+    """The per-device body (inside shard_map) with its ring-pass vjp."""
+    kw = dict(block_q=block_q, block_k=block_k, interpret=interpret)
+    perm = [(i, (i + 1) % sp_size) for i in range(sp_size)]
+
+    def _forward(q, k, v):
+        b, l, h, d = q.shape
+        my = lax.axis_index(axis)
+        out_run = jnp.zeros((b, l, h, d), jnp.float32)
+        lse_run = jnp.full((b, h, l), NEG_INF, jnp.float32)
+        k_blk, v_blk = k, v
+        for step in range(sp_size):
+            src = (my - step) % sp_size
+
+            def diag(k_blk=k_blk, v_blk=v_blk):
+                return flash_chunk_fwd(q, k_blk, v_blk, causal=True, **kw)
+
+            def full(k_blk=k_blk, v_blk=v_blk):
+                return flash_chunk_fwd(q, k_blk, v_blk, causal=False, **kw)
+
+            def skip():
+                # dtypes must match the kernel branches: chunk outputs
+                # are f32 regardless of input dtype (out_dtype override)
+                return (
+                    jnp.zeros((b, l, h, d), jnp.float32),
+                    jnp.full((b, h, l), NEG_INF, jnp.float32),
+                )
+
+            if causal:
+                out_i, lse_i = lax.cond(
+                    src == my,
+                    diag,
+                    lambda: lax.cond(src < my, full, skip),
+                )
+            else:
+                out_i, lse_i = full()
+            out_run, lse_run = _merge(out_run, lse_run, out_i, lse_i)
+            if step + 1 < sp_size:
+                k_blk = lax.ppermute(k_blk, axis, perm)
+                v_blk = lax.ppermute(v_blk, axis, perm)
+        return out_run.astype(q.dtype), lse_run
+
+    @jax.custom_vjp
+    def local(q, k, v):
+        return _forward(q, k, v)[0]
+
+    def fwd(q, k, v):
+        out, lse = _forward(q, k, v)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, g):
+        q, k, v, out, lse = res
+        my = lax.axis_index(axis)
+        dq = jnp.zeros(q.shape, jnp.float32)
+        dk_acc = jnp.zeros(k.shape, jnp.float32)
+        dv_acc = jnp.zeros(v.shape, jnp.float32)
+        k_blk, v_blk = k, v
+        for step in range(sp_size):
+            src = (my - step) % sp_size
+
+            def diag(k_blk=k_blk, v_blk=v_blk):
+                return flash_chunk_bwd(
+                    q, k_blk, v_blk, out, lse, g, causal=True, **kw
+                )
+
+            def full(k_blk=k_blk, v_blk=v_blk):
+                return flash_chunk_bwd(
+                    q, k_blk, v_blk, out, lse, g, causal=False, **kw
+                )
+
+            def skip():
+                return (
+                    jnp.zeros(q.shape, jnp.float32),
+                    jnp.zeros(k.shape, jnp.float32),
+                    jnp.zeros(v.shape, jnp.float32),
+                )
+
+            if causal:
+                dq_c, dk_c, dv_c = lax.cond(
+                    src == my,
+                    diag,
+                    lambda: lax.cond(src < my, full, skip),
+                )
+            else:
+                dq_c, dk_c, dv_c = full()
+            dq = dq + dq_c
+            dk_acc = dk_acc + dk_c
+            dv_acc = dv_acc + dv_c
+            # the gradient accumulator rides the ring WITH its block and
+            # needs all P hops to arrive home; K/V themselves are done
+            # after the last compute (P-1 hops), like the forward
+            if step + 1 < sp_size:
+                k_blk = lax.ppermute(k_blk, axis, perm)
+                v_blk = lax.ppermute(v_blk, axis, perm)
+            dk_acc = lax.ppermute(dk_acc, axis, perm)
+            dv_acc = lax.ppermute(dv_acc, axis, perm)
+        return (
+            dq.astype(q.dtype),
+            dk_acc.astype(k.dtype),
+            dv_acc.astype(v.dtype),
+        )
+
+    local.defvjp(fwd, bwd)
+    return local
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Optional[Mesh] = None,
+    axis: str = "sp",
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Causal attention over (B, S, H, D) with S sharded on mesh axis
+    ``axis`` and the flash kernel as the per-chunk op.  Same calling
+    contract as :func:`gpuschedule_tpu.parallel.ringattn.ring_attention`
+    (ambient-mesh fallback included); heads stay sharded over ``tp`` when
+    that axis exists.  ``sp == 1`` degenerates to plain single-device
+    :func:`flash_attention` — still blockwise, no ring."""
+    if mesh is None:
+        shape = jax.sharding.get_abstract_mesh().shape
+        if axis not in shape:
+            raise ValueError(
+                f"no ambient mesh with axis {axis!r} (set_mesh not in "
+                f"effect); pass mesh= explicitly"
+            )
+    else:
+        shape = mesh.shape
+    sp_size = shape[axis]
+    if sp_size == 1:
+        return flash_attention(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret,
+        )
+    head_axis = "tp" if "tp" in shape else None
+    spec = P("dp", axis, head_axis, None)
+    fn = _make_local(sp_size, axis, causal, block_q, block_k, interpret)
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        # pallas_call emits ShapeDtypeStructs without vma info (same
+        # reason as the trainer's flash shard_map)
+        check_vma=False,
+    )(q, k, v)

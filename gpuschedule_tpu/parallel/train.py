@@ -99,8 +99,16 @@ class ShardedTrainer:
         self.moe_aux_weight = moe_aux_weight
         attn_fn = None
         if ring_attn and flash_attn:
-            raise ValueError("ring_attn and flash_attn are mutually exclusive")
-        if ring_attn:
+            # Composition: sequence-parallel ring ACROSS chips with the
+            # blockwise pallas kernel WITHIN each chip — O(block*d) on-chip
+            # at both levels (parallel/ringflash.py).  The long-context
+            # config for sequences too big for one chip.
+            if not seq_shard:
+                raise ValueError("ring_attn requires seq_shard=True")
+            from gpuschedule_tpu.parallel.ringflash import ring_flash_attention
+
+            attn_fn = partial(ring_flash_attention, mesh=mesh, causal=True)
+        elif ring_attn:
             # Long-context core: sequence-sharded ring attention over the
             # sp axis (parallel/ringattn.py) instead of dense attention.
             if not seq_shard:

@@ -205,10 +205,12 @@ def test_flash_trainer_matches_dense_at_init():
     assert float(l_f) == pytest.approx(float(l_d), rel=2e-3)
 
 
-def test_flash_and_ring_mutually_exclusive():
+def test_flash_plus_ring_is_the_composition():
+    """ring_attn + flash_attn is no longer an error: the pair selects the
+    ring-flash composition (tests/test_ringflash.py covers its math);
+    without seq_shard it still refuses, like plain ring_attn."""
     mesh = make_mesh(devices=jax.devices()[:8])
-    with pytest.raises(ValueError, match="mutually exclusive"):
+    with pytest.raises(ValueError, match="seq_shard"):
         ShardedTrainer(
             "transformer-tiny", mesh, ring_attn=True, flash_attn=True,
-            seq_shard=True,
         )
