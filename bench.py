@@ -38,7 +38,10 @@ import subprocess
 import sys
 import time
 
-BATCH = 8
+# b16 is the measured single-chip sweet spot for transformer-large at
+# S=512 on v5e (b8: 0.611 MFU, b16: 0.638, b32: 0.634 — /tmp batch sweep,
+# round 4); larger batches start paying HBM pressure for no MXU gain
+BATCH = 16
 SEQ = 512
 WARMUP = 3
 ITERS = 10

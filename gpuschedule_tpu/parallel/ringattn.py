@@ -109,6 +109,27 @@ def _ring_attention_local(
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # (B, L, H, D)
 
 
+def resolve_ring_mesh(mesh: Optional[Mesh], axis: str):
+    """(mesh shape mapping, (B, S, H, D) ring spec, head_axis) — the
+    mesh-resolution contract shared by the dense ring and the flash
+    composition (:mod:`gpuschedule_tpu.parallel.ringflash`).  With
+    ``mesh=None`` the ambient mesh from ``jax.sharding.set_mesh`` is used
+    (the legacy ``with mesh:`` context does not set it — pass ``mesh=``
+    there).  Heads stay sharded over tp when that axis exists (all math
+    is per-head, so head-sharding composes with the ring for free)."""
+    if mesh is None:
+        shape = jax.sharding.get_abstract_mesh().shape  # empty dict if unset
+        if axis not in shape:
+            raise ValueError(
+                f"no ambient mesh with axis {axis!r} (set_mesh not in "
+                f"effect); pass mesh= explicitly"
+            )
+    else:
+        shape = mesh.shape
+    head_axis = "tp" if "tp" in shape else None
+    return shape, P("dp", axis, head_axis, None), head_axis
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -119,26 +140,13 @@ def ring_attention(
     causal: bool = True,
 ) -> jax.Array:
     """Causal attention over (B, S, H, D) with S sharded on mesh axis
-    ``axis``; batch stays sharded on ``dp``.  With ``mesh=None`` the
-    ambient mesh from ``jax.sharding.set_mesh`` is used (the legacy
-    ``with mesh:`` context does not set it — pass ``mesh=`` there)."""
-    if mesh is None:
-        shape = jax.sharding.get_abstract_mesh().shape  # empty dict if unset
-        if axis not in shape:
-            raise ValueError(
-                f"no ambient mesh with axis {axis!r} (set_mesh not in "
-                f"effect); pass mesh= explicitly"
-            )
-    else:
-        shape = mesh.shape
+    ``axis``; batch stays sharded on ``dp``.  Mesh handling per
+    :func:`resolve_ring_mesh`."""
+    shape, spec, _ = resolve_ring_mesh(mesh, axis)
     sp_size = shape[axis]
     if sp_size == 1:
         # degenerate ring: plain (still memory-efficient enough) attention
         return _plain_causal_attention(q, k, v, causal=causal)
-    # heads stay sharded over tp when that axis exists (all math is
-    # per-head, so head-sharding composes with the ring for free)
-    head_axis = "tp" if "tp" in shape else None
-    spec = P("dp", axis, head_axis, None)
     fn = partial(
         _ring_attention_local, sp_size=sp_size, axis=axis, causal=causal
     )

@@ -50,6 +50,7 @@ from gpuschedule_tpu.ops.flash_attention import (
     flash_chunk_fwd,
 )
 from gpuschedule_tpu.ops.reference import NEG_INF
+from gpuschedule_tpu.parallel.ringattn import resolve_ring_mesh
 
 
 def _merge(out_run, lse_run, out_i, lse_i):
@@ -187,26 +188,25 @@ def ring_flash_attention(
     """Causal attention over (B, S, H, D) with S sharded on mesh axis
     ``axis`` and the flash kernel as the per-chunk op.  Same calling
     contract as :func:`gpuschedule_tpu.parallel.ringattn.ring_attention`
-    (ambient-mesh fallback included); heads stay sharded over ``tp`` when
-    that axis exists.  ``sp == 1`` degenerates to plain single-device
-    :func:`flash_attention` — still blockwise, no ring."""
-    if mesh is None:
-        shape = jax.sharding.get_abstract_mesh().shape
-        if axis not in shape:
-            raise ValueError(
-                f"no ambient mesh with axis {axis!r} (set_mesh not in "
-                f"effect); pass mesh= explicitly"
-            )
-    else:
-        shape = mesh.shape
+    (mesh handling shared via ``resolve_ring_mesh``).  ``sp == 1``
+    degenerates to per-device :func:`flash_attention` — still blockwise,
+    no ring, but still shard_mapped over dp/tp: a bare pallas call has no
+    GSPMD partitioning rule, so dp>1 activations must be split *before*
+    the kernel (same guard as the trainer's flash branch)."""
+    shape, spec, head_axis = resolve_ring_mesh(mesh, axis)
     sp_size = shape[axis]
     if sp_size == 1:
-        return flash_attention(
-            q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-            interpret=interpret,
-        )
-    head_axis = "tp" if "tp" in shape else None
-    spec = P("dp", axis, head_axis, None)
+        fa_spec = P("dp", None, head_axis, None)
+        return jax.shard_map(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+                interpret=interpret,
+            ),
+            mesh=mesh,
+            in_specs=(fa_spec, fa_spec, fa_spec),
+            out_specs=fa_spec,
+            check_vma=False,
+        )(q, k, v)
     fn = _make_local(sp_size, axis, causal, block_q, block_k, interpret)
     return jax.shard_map(
         fn,

@@ -176,14 +176,15 @@ def test_holdout_mape_on_measured_points():
     assert len(jax_devs) >= 8, "conftest should expose 8 virtual CPU devices"
 
     def point(k):
-        # min-of-2: wall-clock noise on the shared core is one-sided
-        # (GC pauses, page cache), so the minimum estimates the true cost
+        # min-of-3: wall-clock noise on the shared core is one-sided
+        # (GC pauses, page cache), so the minimum estimates the true cost;
+        # two samples proved flaky in full-suite runs (~1-in-4 failures)
         return min(
             measure_step_time(
                 "transformer-tiny", devices=jax_devs[:k], batch_size=8,
                 seq_len=32, iters=10, repeats=2,
             )
-            for _ in range(2)
+            for _ in range(3)
         )
 
     fit_ks = [1, 2, 4, 8]
@@ -196,15 +197,17 @@ def test_holdout_mape_on_measured_points():
         err = mape(curve, holdout_ks, holdout_times)
         return err, fit_times, holdout_times
 
-    # one retry: a single transient stall (another test's memory pressure,
-    # a background compile) can poison a point on this box; a *systematic*
-    # model error fails both attempts
+    # two retries: a single transient stall (another test's memory
+    # pressure, a background compile) can poison a point on this box; a
+    # *systematic* model error fails all three attempts
     err, fit_times, holdout_times = attempt()
-    if err >= 0.10:
+    for _ in range(2):
+        if err < 0.10:
+            break
         err, fit_times, holdout_times = attempt()
     assert err < 0.10, (
-        f"hold-out MAPE {err:.1%} breaks the 10% contract on both attempts; "
-        f"fit={list(zip(fit_ks, fit_times))} "
+        f"hold-out MAPE {err:.1%} breaks the 10% contract on three "
+        f"attempts; fit={list(zip(fit_ks, fit_times))} "
         f"holdout={list(zip(holdout_ks, holdout_times))}"
     )
 

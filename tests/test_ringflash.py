@@ -56,10 +56,15 @@ def test_ringflash_with_tp_sharded_heads():
 
 
 def test_ringflash_degenerate_sp1_is_flash():
-    mesh = make_mesh(sp=1, tp=1, devices=jax.devices()[:8])
+    """sp=1: no ring, but still shard_mapped over dp — a bare pallas call
+    has no GSPMD partitioning rule, so dp-sharded batches must be split
+    before the kernel (batch 2 over dp=2 here)."""
+    mesh = make_mesh(dp=2, sp=1, tp=1, devices=jax.devices()[:2])
     q, k, v = _qkv()
     ref = dense_attention(q, k, v, causal=True)
-    out = ring_flash_attention(q, k, v, mesh=mesh)
+    out = jax.jit(lambda q, k, v: ring_flash_attention(q, k, v, mesh=mesh))(
+        q, k, v
+    )
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
     )
