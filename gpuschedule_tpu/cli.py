@@ -275,6 +275,8 @@ def cmd_train(args) -> int:
         batch_size=args.batch_size,
         seq_len=args.seq_len,
         learning_rate=args.lr,
+        seq_shard=args.ring_attn,
+        ring_attn=args.ring_attn,
         flash_attn=args.flash_attn,
     )
     if trainer.is_image:
@@ -482,6 +484,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     tr.add_argument("--seed", type=int, default=0)
     tr.add_argument("--flash-attn", action="store_true",
                     help="blockwise pallas attention core")
+    tr.add_argument("--ring-attn", action="store_true",
+                    help="sequence-shard over sp with ring attention "
+                         "(implies seq sharding; with --flash-attn, the "
+                         "ring-flash composition)")
     tr.add_argument("--data", help="flat binary token file (see data/)")
     tr.add_argument("--data-dtype", default="uint16")
     tr.add_argument("--ckpt", help="save final state here (orbax)")

@@ -208,6 +208,24 @@ def test_train_subcommand_end_to_end(tmp_path, capsys):
     assert s2["first_loss"] < summary["first_loss"]
 
 
+def test_train_subcommand_ring_flash_composition(capsys):
+    """`cli train --ring-attn --flash-attn`: the long-context composition
+    (sequence-sharded ring over sp with the pallas kernel per chunk)
+    reachable straight from the command line."""
+    pytest.importorskip("jax", reason="train needs the [profiler] extra")
+    rc, out = run_cli(
+        capsys,
+        "train", "--model", "transformer-tiny", "--steps", "2",
+        "--batch-size", "4", "--seq-len", "64", "--devices", "8",
+        "--sp", "2", "--tp", "2", "--ring-attn", "--flash-attn",
+    )
+    assert rc == 0
+    summary = json.loads(out[-1])
+    assert summary["mesh"] == {"dp": 2, "pp": 1, "sp": 2, "tp": 2}
+    assert summary["last_loss"] == summary["last_loss"]  # finite
+    assert summary["last_loss"] < summary["first_loss"]
+
+
 def test_train_subcommand_token_file(tmp_path, capsys):
     pytest.importorskip("jax")
     import numpy as np
