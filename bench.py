@@ -201,6 +201,36 @@ def child_flash(model: str) -> None:
     assert fwd_err < 2e-2, f"compiled forward diverges from oracle: {fwd_err}"
     assert bwd_err < 2e-2, f"compiled backward diverges from oracle: {bwd_err}"
 
+    _stage("kernel-vs-dense")
+    # kernel-only attribution at the model's FULL sequence in the train
+    # dtype (bf16): the train-step MFU below is dominated by the tiny
+    # model's lm_head, so the artifact carries the kernel's own speedup
+    # to prevent misreading.  S matters: at S~1k dense XLA is on par; the
+    # flash win grows with S (KERNEL_BENCH_r04.jsonl: 1.8x at S=4096).
+    def time_fn(f, *xs, iters=8):
+        # one readback fences the whole jitted program (all outputs are
+        # one TPU computation); perf_counter like every other timer here
+        for _ in range(2):
+            out = f(*xs)
+        jnp.sum(jax.tree_util.tree_leaves(out)[0]).item()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = f(*xs)
+        jnp.sum(jax.tree_util.tree_leaves(out)[0]).item()
+        return (time.perf_counter() - t0) / iters
+
+    # cap at 4096: the dense reference at S=32k is the OOM *counterexample*
+    # (child_longctx) — timing it here would crash the xlong smoke
+    s_time = min(cfg.max_seq, 4096)
+    kt = jax.random.split(jax.random.PRNGKey(1), 3)
+    qb, kb2, vb = (
+        jax.random.normal(kt[i], (2, s_time, heads, d_head), jnp.bfloat16)
+        for i in range(3)
+    )
+    t_flash = time_fn(jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2))), qb, kb2, vb)
+    t_dense = time_fn(jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2))), qb, kb2, vb)
+    kernel_speedup = t_dense / t_flash
+
     _stage("train-step")
     mesh = make_mesh(dp=1, sp=1, tp=1, devices=[dev])
     seq = cfg.max_seq
@@ -227,10 +257,12 @@ def child_flash(model: str) -> None:
             {
                 "metric": f"flash-smoke {model} (S={seq}, b2) compiled pallas "
                 f"fwd+bwd on {gen}: fwd_maxerr={fwd_err:.2e} "
-                f"bwd_relerr={bwd_err:.2e} mfu={mfu:.3f}",
+                f"bwd_relerr={bwd_err:.2e} mfu={mfu:.3f} "
+                f"kernel_vs_dense={kernel_speedup:.2f}x@S{s_time}",
                 "value": round(tokens_per_s, 1),
                 "unit": "tokens/s",
                 "vs_baseline": round(mfu / TARGET_MFU, 3),
+                "kernel_speedup_vs_dense": round(kernel_speedup, 2),
                 "compiled": compiled,
                 "backend": backend,
             }

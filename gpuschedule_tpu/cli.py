@@ -364,6 +364,8 @@ def cmd_profile(args) -> int:
             generation=args.generation,
             batch_size=args.batch_size,
             seq_len=args.seq_len,
+            sp=args.sp,
+            tp=args.tp,
             cache=cache,
         )
         print(json.dumps({"model": model, "theta": list(curve.theta)}))
@@ -373,6 +375,8 @@ def cmd_profile(args) -> int:
                 f"{args.trace_dir}/{model}",
                 batch_size=args.batch_size,
                 seq_len=args.seq_len,
+                sp=args.sp,
+                tp=args.tp,
             )
             print(json.dumps({"model": model, "xprof_trace": path}))
     return 0
@@ -500,6 +504,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     prof.add_argument("--generation", default="v5e")
     prof.add_argument("--batch-size", type=int, default=8)
     prof.add_argument("--seq-len", type=int, default=128)
+    prof.add_argument("--sp", type=int, default=1,
+                      help="sequence-parallel degree of each measured mesh")
+    prof.add_argument("--tp", type=int, default=1,
+                      help="tensor-parallel degree of each measured mesh")
     prof.add_argument("--curves", required=True)
     prof.add_argument("--trace-dir",
                       help="also capture an xprof trace of the step here")

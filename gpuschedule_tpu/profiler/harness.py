@@ -121,18 +121,25 @@ def capture_trace(
     batch_size: int = 8,
     seq_len: int = 128,
     steps: int = 3,
+    sp: int = 1,
+    tp: int = 1,
 ) -> str:
     """Capture an xprof (TensorBoard-viewable) trace of the train step.
 
     The deep-inspection path of the tracing subsystem (SURVEY.md §5
     "Tracing/profiling": ``jax.profiler.trace`` around jitted steps):
     wall-clock medians come from :func:`time_steps`; this produces the
-    per-op timeline for when a number needs explaining.  Returns the
+    per-op timeline for when a number needs explaining.  ``sp``/``tp``
+    must match the measurement they explain — the traced step is built by
+    the same ``_mesh_trainer`` as the measured one.  Returns the
     directory path; view with ``tensorboard --logdir`` or xprof.
     """
     import jax
 
-    trainer, state, batch = _mesh_trainer(model_name, devices, batch_size, seq_len)
+    trainer, state, batch = _mesh_trainer(
+        model_name, devices, batch_size, seq_len,
+        sp=sp, tp=tp, seq_shard=sp > 1,
+    )
     with jax.profiler.trace(str(out_dir)):
         for _ in range(steps):
             state, loss = trainer.step(state, batch)
@@ -217,8 +224,12 @@ def profile_model(
 
     curve = fit_step_time_curve(sorted(points), [points[k] for k in sorted(points)])
     if cache is not None:
+        # sp/tp variants get their own cache key: the scheduler's replay
+        # looks curves up by bare model name, and a dp curve silently
+        # replaced by an sp/tp one would feed it the wrong step times
+        key = model_name if sp == 1 and tp == 1 else f"{model_name}@sp{sp}tp{tp}"
         cache.put(
-            model_name,
+            key,
             curve,
             source=(
                 f"measured<= {len(devs)} chips (sp={sp}, tp={tp}), "

@@ -229,7 +229,10 @@ def test_profile_model_tp_mesh(tmp_path):
         cache=cache,
     )
     assert curve.step_time(2) > 0
-    meta = cache._meta["transformer-tiny"]
+    # sp/tp variants get their own cache key so they can't shadow the dp
+    # curve the scheduler replays from
+    meta = cache._meta["transformer-tiny@sp1tp2"]
+    assert "transformer-tiny" not in cache._meta
     assert "tp=2" in meta["source"]
     assert {"2", "4"} <= set(meta["points"])
     # ks not divisible by the sp*tp unit are rejected, not mismeasured
@@ -254,7 +257,7 @@ def test_profile_model_sp_mesh(tmp_path):
         cache=cache,
     )
     assert curve.step_time(2) > 0
-    assert "sp=2" in cache._meta["transformer-tiny"]["source"]
+    assert "sp=2" in cache._meta["transformer-tiny@sp2tp1"]["source"]
 
 
 def test_capture_trace_writes_xprof_files(tmp_path):
