@@ -207,17 +207,7 @@ def child_flash(model: str) -> None:
     # model's lm_head, so the artifact carries the kernel's own speedup
     # to prevent misreading.  S matters: at S~1k dense XLA is on par; the
     # flash win grows with S (KERNEL_BENCH_r04.jsonl: 1.8x at S=4096).
-    def time_fn(f, *xs, iters=8):
-        # one readback fences the whole jitted program (all outputs are
-        # one TPU computation); perf_counter like every other timer here
-        for _ in range(2):
-            out = f(*xs)
-        jnp.sum(jax.tree_util.tree_leaves(out)[0]).item()
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = f(*xs)
-        jnp.sum(jax.tree_util.tree_leaves(out)[0]).item()
-        return (time.perf_counter() - t0) / iters
+    from gpuschedule_tpu.profiler.harness import time_callable
 
     # cap at 4096: the dense reference at S=32k is the OOM *counterexample*
     # (child_longctx) — timing it here would crash the xlong smoke
@@ -227,8 +217,12 @@ def child_flash(model: str) -> None:
         jax.random.normal(kt[i], (2, s_time, heads, d_head), jnp.bfloat16)
         for i in range(3)
     )
-    t_flash = time_fn(jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2))), qb, kb2, vb)
-    t_dense = time_fn(jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2))), qb, kb2, vb)
+    t_flash = time_callable(
+        jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2))), qb, kb2, vb
+    )
+    t_dense = time_callable(
+        jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2))), qb, kb2, vb
+    )
     kernel_speedup = t_dense / t_flash
 
     _stage("train-step")

@@ -252,6 +252,35 @@ def _bwd_dkdv_kernel(
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
+def _kv_fetch_idx(block_q: int, block_k: int, causal: bool):
+    """BlockSpec index_map for K/V fetches on a (bh, q-block, k-block)
+    grid.  Causal: blocks wholly above the diagonal are skipped by the
+    kernels' ``pl.when`` guards, but the BlockSpec would still DMA them —
+    clamp the fetch index to the diagonal block instead (already
+    resident; the revisit is free), so masked steps move no new HBM
+    bytes.  The clamp formula mirrors the kernels' skip condition
+    ``kb*block_k <= qi*block_q + block_q - 1`` exactly; one definition
+    serves forward and backward so the two can never drift."""
+    if not causal:
+        return lambda i, j, kb: (i, kb, 0)
+    return lambda i, j, kb: (
+        i, jnp.minimum(kb, (j * block_q + block_q - 1) // block_k), 0
+    )
+
+
+def _q_fetch_idx(block_q: int, block_k: int, causal: bool):
+    """Mirror of :func:`_kv_fetch_idx` for q/g/lse/delta fetches on the
+    dk/dv pass's (bh, k-block, q-block) grid: q blocks wholly above the
+    current k block see none of it (skip condition
+    ``qi*block_q + block_q - 1 >= kb*block_k``), so clamp their fetch to
+    the first contributing q block."""
+    if not causal:
+        return lambda i, j, qi: (i, qi, 0)
+    return lambda i, j, qi: (
+        i, jnp.maximum(qi, (j * block_k) // block_q), 0
+    )
+
+
 def _effective_blocks(s: int, block_q: int, block_k: int) -> tuple[int, int]:
     """Clamp block sizes to the sequence rounded up to one lane tile, so
     large defaults never force a short sequence to pad to lcm(blocks).
@@ -309,13 +338,14 @@ def _flash_fwd_impl(q, k, v, *, causal, block_q, block_k, interpret,
         sm_scale=sm_scale,
         seq_len=s,
     )
+    kv_idx = _kv_fetch_idx(block_q, block_k, causal)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, s_pad // block_q, s_pad // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d_pad), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d_pad), lambda i, j, kb: (i, kb, 0)),
-            pl.BlockSpec((1, block_k, d_pad), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d_pad), kv_idx),
+            pl.BlockSpec((1, block_k, d_pad), kv_idx),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d_pad), lambda i, j, kb: (i, j, 0)),
@@ -366,14 +396,16 @@ def _flash_bwd_impl(q, k, v, out, lse, g, *, causal, block_q, block_k, interpret
         block_q=block_q, block_k=block_k, causal=causal, sm_scale=sm_scale,
         seq_len=s,
     )
+    kv_idx_b = _kv_fetch_idx(block_q, block_k, causal)
+    q_idx_b = _q_fetch_idx(block_q, block_k, causal)
     lse_spec_q = pl.BlockSpec((1, block_q, LANES), lambda i, j, kb: (i, j, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **opts),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d_pad), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d_pad), lambda i, j, kb: (i, kb, 0)),
-            pl.BlockSpec((1, block_k, d_pad), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d_pad), kv_idx_b),
+            pl.BlockSpec((1, block_k, d_pad), kv_idx_b),
             pl.BlockSpec((1, block_q, d_pad), lambda i, j, kb: (i, j, 0)),
             lse_spec_q,
             lse_spec_q,
@@ -384,15 +416,15 @@ def _flash_bwd_impl(q, k, v, out, lse, g, *, causal, block_q, block_k, interpret
         interpret=interp,
     )(qp, kp, vp, gp, lse, delta)
 
-    lse_spec_k = pl.BlockSpec((1, block_q, LANES), lambda i, j, qi: (i, qi, 0))
+    lse_spec_k = pl.BlockSpec((1, block_q, LANES), q_idx_b)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, **opts),
         grid=(bh, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, block_q, d_pad), lambda i, j, qi: (i, qi, 0)),
+            pl.BlockSpec((1, block_q, d_pad), q_idx_b),
             pl.BlockSpec((1, block_k, d_pad), lambda i, j, qi: (i, j, 0)),
             pl.BlockSpec((1, block_k, d_pad), lambda i, j, qi: (i, j, 0)),
-            pl.BlockSpec((1, block_q, d_pad), lambda i, j, qi: (i, qi, 0)),
+            pl.BlockSpec((1, block_q, d_pad), q_idx_b),
             lse_spec_k,
             lse_spec_k,
         ],

@@ -53,6 +53,26 @@ def time_steps(step_fn, state, tokens, *, iters: int, repeats: int = 3):
     return statistics.median(block_times), state
 
 
+def time_callable(fn, *args, iters: int = 8, warmup: int = 2) -> float:
+    """Mean seconds per call of ``fn(*args)`` with the host-readback fence
+    this transport requires (see module docstring) — one readback fences
+    the whole jitted program, since all outputs are one TPU computation.
+    The single timing recipe shared by bench.py's kernel attribution and
+    tools/kernel_bench.py, so fencing fixes land in one place."""
+    import jax
+    import jax.numpy as jnp
+
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+    return (time.perf_counter() - t0) / iters
+
+
 def _mesh_trainer(
     model_name, devices, batch_size, seq_len, *,
     sp: int = 1, tp: int = 1, seq_shard: bool = False, warmup: int = 1,

@@ -22,7 +22,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -31,19 +30,11 @@ import jax.numpy as jnp
 
 from gpuschedule_tpu.ops import flash_attention
 from gpuschedule_tpu.ops.reference import dense_attention
+from gpuschedule_tpu.profiler.harness import time_callable
 
 
 def _time(fn, *args, iters=10, warmup=2):
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.tree_util.tree_map(lambda x: x.block_until_ready(), out)
-    # host readback fences execution on the axon transport
-    float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
-    return (time.perf_counter() - t0) / iters
+    return time_callable(fn, *args, iters=iters, warmup=warmup)
 
 
 def attn_flops(b, s, h, d, causal=True):
