@@ -206,7 +206,7 @@ def child_flash(model: str) -> None:
     # dtype (bf16): the train-step MFU below is dominated by the tiny
     # model's lm_head, so the artifact carries the kernel's own speedup
     # to prevent misreading.  S matters: at S~1k dense XLA is on par; the
-    # flash win grows with S (KERNEL_BENCH_r04.jsonl: 1.8x at S=4096).
+    # flash win grows with S (KERNEL_BENCH_r04.jsonl: 2.1x at S=4096).
     from gpuschedule_tpu.profiler.harness import time_callable
 
     # cap at 4096: the dense reference at S=32k is the OOM *counterexample*

@@ -479,7 +479,7 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_chunk_fwd(
-    q, k, v, *, causal, block_q=128, block_k=512, interpret=None
+    q, k, v, *, causal, block_q=256, block_k=512, interpret=None
 ):
     """(out, lse_rows) for one (q-chunk, k-chunk) pair — the per-chunk op
     of the cross-chip ring composition (parallel/ringflash.py).
@@ -499,7 +499,7 @@ def flash_chunk_fwd(
 
 
 def flash_chunk_bwd(
-    q, k, v, out, lse_rows, g, *, causal, block_q=128, block_k=512,
+    q, k, v, out, lse_rows, g, *, causal, block_q=256, block_k=512,
     interpret=None,
 ):
     """(dq, dk, dv) contribution of one (q-chunk, k-chunk) pair given the
@@ -529,7 +529,7 @@ def flash_attention(
     v: jax.Array,
     *,
     causal: bool = True,
-    block_q: int = 128,
+    block_q: int = 256,
     block_k: int = 512,
     interpret: bool | None = None,
 ) -> jax.Array:
@@ -539,10 +539,11 @@ def flash_attention(
     long sequences never materializes an (S, S) intermediate.
 
     Default blocks are the measured v5e sweet spot (tools/kernel_bench.py
-    on the real chip, b2 S4096 h8 bf16): (128, 512) runs fwd+bwd 1.8x
-    faster than both (128, 128) and the dense-XLA path; blocks are
-    clamped to the sequence's lane-tile round-up so short sequences never
-    pad to the large default.
+    on the real chip, b2 S4096 h8 bf16, KERNEL_BENCH_r04.jsonl): with the
+    masked-block DMA clamp, (256, 512) runs fwd+bwd 2.1x faster than the
+    dense-XLA path and ~2x faster than naive (128, 128) blocks; blocks
+    are clamped to the sequence's lane-tile round-up so short sequences
+    never pad to the large default.
 
     ``interpret=None`` auto-selects pallas interpret mode off-TPU.  The
     call signature matches the model zoo's ``attn_fn`` hook, so

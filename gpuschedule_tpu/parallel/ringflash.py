@@ -181,7 +181,7 @@ def ring_flash_attention(
     mesh: Optional[Mesh] = None,
     axis: str = "sp",
     causal: bool = True,
-    block_q: int = 128,
+    block_q: int = 256,
     block_k: int = 512,
     interpret: bool | None = None,
 ) -> jax.Array:
