@@ -284,10 +284,17 @@ def _q_fetch_idx(block_q: int, block_k: int, causal: bool):
 def _effective_blocks(s: int, block_q: int, block_k: int) -> tuple[int, int]:
     """Clamp block sizes to the sequence rounded up to one lane tile, so
     large defaults never force a short sequence to pad to lcm(blocks).
-    Deterministic in (s, blocks): the backward recomputes the identical
-    clamp, keeping its padded layout aligned with the forward's saved lse."""
+    When the clamped pair's common multiple still overshoots that cap
+    (mismatched sizes, e.g. (256, 384) for S=300 -> lcm 768), collapse to
+    one full-sequence tile pair — strictly less padded work than padding
+    past the lane round-up.  Deterministic in (s, blocks): the backward
+    recomputes the identical clamp, keeping its padded layout aligned
+    with the forward's saved lse."""
     cap = -(-s // LANES) * LANES
-    return min(block_q, cap), min(block_k, cap)
+    bq, bk = min(block_q, cap), min(block_k, cap)
+    if math.lcm(bq, bk) > cap:
+        bq = bk = cap
+    return bq, bk
 
 
 def _pad_to(x: jax.Array, axis: int, mult: int) -> jax.Array:

@@ -123,6 +123,30 @@ def test_flash_gradients_long_context():
         )
 
 
+def test_effective_blocks_never_pad_past_lane_roundup():
+    """The padding contract behind the block clamp: whatever blocks the
+    caller asks for, the effective pair's common multiple (= the padded
+    sequence length) never exceeds S rounded up to one lane tile —
+    mismatched clamped pairs like (256, 384) at S=300 must collapse
+    rather than pad to lcm 768."""
+    import math
+
+    from gpuschedule_tpu.ops.flash_attention import LANES, _effective_blocks
+
+    for s in (48, 200, 300, 384, 400, 1000):
+        cap = -(-s // LANES) * LANES
+        for bq, bk in ((256, 512), (128, 96), (512, 128), (64, 96)):
+            ebq, ebk = _effective_blocks(s, bq, bk)
+            assert math.lcm(ebq, ebk) <= cap, (s, bq, bk, ebq, ebk)
+    # numeric parity at the collapse shape, default blocks
+    q, k, v = _qkv(s=300, d=40)
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v)),
+        np.asarray(_reference(q, k, v, True)),
+        atol=3e-5, rtol=3e-5,
+    )
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_flash_bf16_inputs_match_oracle(causal):
     """bf16 q/k/v take the input-dtype MXU path (bf16 dots, f32

@@ -176,15 +176,14 @@ def test_holdout_mape_on_measured_points():
     assert len(jax_devs) >= 8, "conftest should expose 8 virtual CPU devices"
 
     def point(k):
-        # min-of-3: wall-clock noise on the shared core is one-sided
-        # (GC pauses, page cache), so the minimum estimates the true cost;
-        # two samples proved flaky in full-suite runs (~1-in-4 failures)
-        return min(
-            measure_step_time(
-                "transformer-tiny", devices=jax_devs[:k], batch_size=8,
-                seq_len=32, iters=10, repeats=2,
-            )
-            for _ in range(3)
+        # one compile per point, robustness from the median over 4 timed
+        # blocks inside it (time_steps discards a one-sided stall that
+        # poisons a single block).  A min-of-3-separate-calls variant was
+        # tried first: equally robust but 3x the cost, because each call
+        # rebuilds the trainer and recompiles (~8 min of a ~25-min suite)
+        return measure_step_time(
+            "transformer-tiny", devices=jax_devs[:k], batch_size=8,
+            seq_len=32, iters=10, repeats=4,
         )
 
     fit_ks = [1, 2, 4, 8]
