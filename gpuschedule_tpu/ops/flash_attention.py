@@ -287,12 +287,17 @@ def _effective_blocks(s: int, block_q: int, block_k: int) -> tuple[int, int]:
     When the clamped pair's common multiple still overshoots that cap
     (mismatched sizes, e.g. (256, 384) for S=300 -> lcm 768), collapse to
     one full-sequence tile pair — strictly less padded work than padding
-    past the lane round-up.  Deterministic in (s, blocks): the backward
-    recomputes the identical clamp, keeping its padded layout aligned
-    with the forward's saved lse."""
+    past the lane round-up — but only while cap stays at the default
+    block scale (<= 512): a (cap, cap) f32 score tile lives in VMEM, and
+    collapsing at large S would materialize the very O(S, S) tile the
+    kernel exists to avoid (cap=2048 alone is a 16.8 MB tile — over a
+    v5e's VMEM).  Past that bound, mismatched custom blocks keep their
+    lcm padding: more padded FLOPs, bounded VMEM.  Deterministic in
+    (s, blocks): the backward recomputes the identical clamp, keeping
+    its padded layout aligned with the forward's saved lse."""
     cap = -(-s // LANES) * LANES
     bq, bk = min(block_q, cap), min(block_k, cap)
-    if math.lcm(bq, bk) > cap:
+    if math.lcm(bq, bk) > cap and cap <= 512:
         bq = bk = cap
     return bq, bk
 

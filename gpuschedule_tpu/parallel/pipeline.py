@@ -152,6 +152,7 @@ class PipelinedLM:
         seq_len: int,
         num_microbatches: int = 4,
         learning_rate: float = 1e-3,
+        flash_attn: bool = False,
     ):
         import flax.linen as nn
 
@@ -187,7 +188,17 @@ class PipelinedLM:
         self.layers_per_stage = cfg.n_layers // pp
         # honor the config's remat flag exactly like TransformerLM does:
         # long-sequence configs trade FLOPs for HBM inside each stage
-        self._block = (nn.remat(Block) if cfg.remat else Block)(cfg)
+        attn_fn = None
+        if flash_attn:
+            # the stage runs inside pipeline_apply's shard_map, so the
+            # per-device pallas kernel needs no extra wrapping (the same
+            # reason the trainer's flash branch shard_maps it itself)
+            from gpuschedule_tpu.ops import flash_attention
+
+            def attn_fn(q, k, v):
+                return flash_attention(q, k, v, causal=True)
+
+        self._block = (nn.remat(Block) if cfg.remat else Block)(cfg, attn_fn)
         self._embed = Embedder(cfg)
         self._head = LMHead(cfg)
         self.tx = optax.adamw(learning_rate)

@@ -138,6 +138,10 @@ def test_effective_blocks_never_pad_past_lane_roundup():
         for bq, bk in ((256, 512), (128, 96), (512, 128), (64, 96)):
             ebq, ebk = _effective_blocks(s, bq, bk)
             assert math.lcm(ebq, ebk) <= cap, (s, bq, bk, ebq, ebk)
+    # ...but the collapse is bounded: at large S a (cap, cap) f32 score
+    # tile would be the very O(S, S) VMEM blow-up the kernel avoids, so
+    # mismatched custom blocks keep their (VMEM-bounded) lcm padding
+    assert _effective_blocks(2000, 768, 1280) == (768, 1280)
     # numeric parity at the collapse shape, default blocks
     q, k, v = _qkv(s=300, d=40)
     np.testing.assert_allclose(
