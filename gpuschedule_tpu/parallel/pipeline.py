@@ -49,15 +49,25 @@ def pipeline_apply(
     *,
     mesh: Mesh,
     axis: str = "pp",
-) -> jax.Array:
+    with_aux: bool = False,
+) -> "jax.Array | tuple[jax.Array, jax.Array]":
     """Run ``stage_fn`` as a ``pp``-deep pipeline over microbatches.
 
-    ``stage_fn(stage_params, x) -> y`` must map activations to
+    ``stage_fn(stage_params, x) -> y`` (or ``-> (y, aux_scalar)`` when
+    ``with_aux=True`` — the annotation's tuple case) must map activations to
     same-shaped activations (a transformer block); ``stacked_params``
     leaves carry a leading stage dim equal to the mesh's ``pp`` extent;
     ``microbatches`` is ``(M, mb, ...)``.  Returns the last stage's
     outputs, ``(M, mb, ...)``, replicated across pp (a psum over the
     stage mask).  Differentiable end-to-end.
+
+    ``with_aux=True`` changes the stage contract to
+    ``stage_fn(params, x) -> (y, aux_scalar)`` (e.g. MoE load-balancing
+    losses sown inside the stage) and returns ``(outputs, aux)`` where
+    ``aux`` is the per-microbatch mean of the valid contributions,
+    summed across stages and averaged over dp columns.  Bubble ticks —
+    where a stage chews zeros that belong to no microbatch — are masked
+    out of the accumulation, not just discarded with their activations.
     """
     n_stages = mesh.shape[axis]
     if microbatches.ndim < 2:
@@ -84,7 +94,7 @@ def pipeline_apply(
         perm = [(i, i + 1) for i in range(n_stages - 1)]  # stage i -> i+1
 
         def tick(carry, t):
-            send_buf, out = carry
+            send_buf, out, aux_total = carry
             # what stage-1 produced last tick arrives here; ranks with no
             # source (stage 0) receive zeros, which they never read
             recv = jax.lax.ppermute(send_buf, axis, perm)
@@ -92,7 +102,17 @@ def pipeline_apply(
                 xs, jnp.clip(t, 0, m - 1), keepdims=False
             )
             x = jnp.where(stage == 0, mb, recv)
-            y = stage_fn(params, x)
+            if with_aux:
+                y, aux = stage_fn(params, x)
+                # this stage holds microbatch t-stage this tick; bubble
+                # ticks sow garbage that must not reach the aux sum
+                live = t - stage
+                valid = jnp.logical_and(live >= 0, live < m)
+                aux_total = aux_total + jnp.where(
+                    valid, jnp.asarray(aux, jnp.float32), 0.0
+                )
+            else:
+                y = stage_fn(params, x)
             # the last stage finished microbatch t-(S-1) this tick
             done = t - (n_stages - 1)
             write = jnp.logical_and(done >= 0, stage == n_stages - 1)
@@ -101,14 +121,22 @@ def pipeline_apply(
                     out, jnp.clip(done, 0, m - 1), keepdims=False
                 )), jnp.clip(done, 0, m - 1), axis=0,
             )
-            return (y, upd), None
+            return (y, upd, aux_total), None
 
-        init = (jnp.zeros_like(xs[0]), jnp.zeros_like(xs))
-        (_, out), _ = jax.lax.scan(tick, init, jnp.arange(ticks))
+        init = (jnp.zeros_like(xs[0]), jnp.zeros_like(xs), jnp.float32(0))
+        (_, out, aux_total), _ = jax.lax.scan(tick, init, jnp.arange(ticks))
         # replicate the last stage's result across pp so the caller sees
         # one coherent array
         mask = (stage == n_stages - 1).astype(out.dtype)
-        return jax.lax.psum(out * mask, axis)
+        result = jax.lax.psum(out * mask, axis)
+        if not with_aux:
+            return result
+        # sum the per-stage totals across pp, average over dp columns and
+        # microbatches -> comparable to one full-batch sequential apply
+        aux = jax.lax.psum(aux_total, axis)
+        if dp > 1:
+            aux = jax.lax.psum(aux, "dp") / dp
+        return result, aux / m
 
     spec_params = jax.tree.map(
         lambda a: P(axis, *([None] * (a.ndim - 1))), stacked_params
@@ -121,8 +149,10 @@ def pipeline_apply(
         per_device,
         mesh=mesh,
         in_specs=(spec_params, data_spec),
-        out_specs=data_spec,
-        check_vma=False,  # psum over the stage mask makes the output invariant
+        # the psum over the stage mask (and, for aux, over pp/dp) makes
+        # each output invariant where its spec is replicated
+        out_specs=(data_spec, P()) if with_aux else data_spec,
+        check_vma=False,
     )(stacked_params, microbatches)
 
 
@@ -153,6 +183,7 @@ class PipelinedLM:
         num_microbatches: int = 4,
         learning_rate: float = 1e-3,
         flash_attn: bool = False,
+        moe_aux_weight: float = 1e-2,
     ):
         import flax.linen as nn
 
@@ -163,15 +194,6 @@ class PipelinedLM:
         pp = mesh.shape["pp"]
         if pp < 2:
             raise ValueError(f"PipelinedLM needs a pp>=2 mesh, got pp={pp}")
-        if cfg.n_experts:
-            # MoE blocks sow their load-balancing aux loss; the pipelined
-            # stage_fn has no mutable-collection plumbing yet, so training
-            # one here would silently drop the aux term (and leak sown
-            # scalars into the optimizer state) — refuse instead
-            raise ValueError(
-                f"{model_name} is an MoE config; PipelinedLM does not "
-                "pipeline MoE blocks yet (use ShardedTrainer)"
-            )
         if cfg.n_layers % pp:
             raise ValueError(
                 f"{model_name} has {cfg.n_layers} layers, not divisible by pp={pp}"
@@ -202,24 +224,35 @@ class PipelinedLM:
         self._embed = Embedder(cfg)
         self._head = LMHead(cfg)
         self.tx = optax.adamw(learning_rate)
+        self.moe_aux_weight = moe_aux_weight
 
         def stage_fn(stage_params, x):
+            # mutable: collect the sown MoE load-balancing losses (the
+            # collection is empty for dense blocks -> aux stays 0); the
+            # pipeline masks bubble-tick contributions (pipeline_apply
+            # with_aux docstring)
+            aux = jnp.float32(0)
             for i in range(self.layers_per_stage):  # static unroll
-                x = self._block.apply(stage_params[f"layer{i}"], x)
-            return x
+                x, mods = self._block.apply(
+                    stage_params[f"layer{i}"], x, mutable=["moe_losses"]
+                )
+                for t in jax.tree_util.tree_leaves(mods.get("moe_losses", {})):
+                    aux = aux + jnp.asarray(t, jnp.float32).mean()
+            return x, aux
 
         def loss_fn(params, tokens):
             b, s = tokens.shape
             m = self.num_microbatches
             x = self._embed.apply(params["embed"], tokens)
             xs = x.reshape(m, b // m, s, cfg.d_model)
-            ys = pipeline_apply(
-                stage_fn, params["stages"], xs, mesh=mesh
+            ys, aux = pipeline_apply(
+                stage_fn, params["stages"], xs, mesh=mesh, with_aux=True
             )
             logits = self._head.apply(params["head"], ys.reshape(b, s, -1))
-            return optax.softmax_cross_entropy_with_integer_labels(
+            ce = optax.softmax_cross_entropy_with_integer_labels(
                 logits[:, :-1, :], tokens[:, 1:]
             ).mean()
+            return ce + self.moe_aux_weight * aux
 
         def step_fn(params, opt_state, tokens):
             loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
@@ -246,7 +279,11 @@ class PipelinedLM:
         for _ in range(pp):
             stage = {}
             for i in range(self.layers_per_stage):
-                stage[f"layer{i}"] = self._block.init(keys[2 + k], x)
+                # keep ONLY the trainable collection: MoE blocks sow
+                # their aux loss during init too, and a sown scalar in
+                # the stage pytree would leak into the optimizer state
+                variables = self._block.init(keys[2 + k], x)
+                stage[f"layer{i}"] = {"params": variables["params"]}
                 k += 1
             per_stage.append(stage)
         params = {
@@ -272,15 +309,25 @@ class PipelinedLM:
 
     def reference_loss(self, params, tokens):
         """The same math with the blocks applied sequentially (no
-        pipeline) — the parity oracle for tests."""
+        pipeline) — the parity oracle for tests.  Exact for dense blocks
+        at any microbatch count; for MoE the pipelined aux is the mean of
+        per-microbatch, per-dp-column values of a statistic nonlinear in
+        the routing probabilities, so parity is exact only at
+        num_microbatches=1 AND dp=1, statistical beyond either."""
         cfg = self.cfg
         pp = self.mesh.shape["pp"]
         x = self._embed.apply(params["embed"], tokens)
+        aux = jnp.float32(0)
         for s in range(pp):
             stage = jax.tree.map(lambda a: a[s], params["stages"])
             for i in range(self.layers_per_stage):
-                x = self._block.apply(stage[f"layer{i}"], x)
+                x, mods = self._block.apply(
+                    stage[f"layer{i}"], x, mutable=["moe_losses"]
+                )
+                for t in jax.tree_util.tree_leaves(mods.get("moe_losses", {})):
+                    aux = aux + jnp.asarray(t, jnp.float32).mean()
         logits = self._head.apply(params["head"], x)
-        return optax.softmax_cross_entropy_with_integer_labels(
+        ce = optax.softmax_cross_entropy_with_integer_labels(
             logits[:, :-1, :], tokens[:, 1:]
         ).mean()
+        return ce + self.moe_aux_weight * aux
