@@ -278,6 +278,9 @@ def cmd_train(args) -> int:
         seq_shard=args.ring_attn,
         ring_attn=args.ring_attn,
         flash_attn=args.flash_attn,
+        warmup_steps=args.warmup_steps,
+        decay_steps=args.decay_steps,
+        grad_clip=args.grad_clip,
     )
     if trainer.is_image:
         raise SystemExit(
@@ -481,6 +484,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     tr.add_argument("--batch-size", type=int, default=8)
     tr.add_argument("--seq-len", type=int, default=128)
     tr.add_argument("--lr", type=float, default=1e-3)
+    tr.add_argument("--warmup-steps", type=int, default=0,
+                    help="linear LR warmup steps (0 = none)")
+    tr.add_argument("--decay-steps", type=int, default=None,
+                    help="cosine-decay LR to zero over this many "
+                         "post-warmup steps")
+    tr.add_argument("--grad-clip", type=float, default=None,
+                    help="global-norm gradient clipping threshold")
     tr.add_argument("--sp", type=int, default=1)
     tr.add_argument("--tp", type=int, default=1)
     tr.add_argument("--devices", type=int,

@@ -21,13 +21,18 @@ from gpuschedule_tpu.parallel.pipeline import (
 )
 from gpuschedule_tpu.parallel.ringattn import ring_attention
 from gpuschedule_tpu.parallel.ringflash import ring_flash_attention
-from gpuschedule_tpu.parallel.train import ShardedTrainer, param_partition_spec
+from gpuschedule_tpu.parallel.train import (
+    ShardedTrainer,
+    make_optimizer,
+    param_partition_spec,
+)
 
 __all__ = [
     "make_mesh",
     "ring_attention",
     "ring_flash_attention",
     "ShardedTrainer",
+    "make_optimizer",
     "param_partition_spec",
     "save_state",
     "restore_state",

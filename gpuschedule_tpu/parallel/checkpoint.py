@@ -101,10 +101,24 @@ def restore_state(trainer, path: str | Path):
 
     restore_args = jax.tree.map(to_restore_arg, abstract, shardings)
     with ocp.PyTreeCheckpointer() as ckptr:
-        return ckptr.restore(
-            path,
-            args=ocp.args.PyTreeRestore(item=abstract, restore_args=restore_args),
-        )
+        try:
+            return ckptr.restore(
+                path,
+                args=ocp.args.PyTreeRestore(
+                    item=abstract, restore_args=restore_args
+                ),
+            )
+        except ValueError as e:
+            # cross-MESH restore is supported; cross-OPTIMIZER is not —
+            # grad_clip/warmup/decay change the opt_state tree structure,
+            # and orbax's structure-mismatch error doesn't say why
+            raise ValueError(
+                f"checkpoint at {path} does not match the target trainer's "
+                "state structure. Mesh shape may differ (that resharding "
+                "is supported), but optimizer hyperparameters must match "
+                "the saving run: warmup_steps/decay_steps/grad_clip change "
+                f"the opt_state pytree. Original error: {e}"
+            ) from e
 
 
 def reshard_state(trainer, state):

@@ -184,6 +184,9 @@ class PipelinedLM:
         learning_rate: float = 1e-3,
         flash_attn: bool = False,
         moe_aux_weight: float = 1e-2,
+        warmup_steps: int = 0,
+        decay_steps: "int | None" = None,
+        grad_clip: "float | None" = None,
     ):
         import flax.linen as nn
 
@@ -223,7 +226,12 @@ class PipelinedLM:
         self._block = (nn.remat(Block) if cfg.remat else Block)(cfg, attn_fn)
         self._embed = Embedder(cfg)
         self._head = LMHead(cfg)
-        self.tx = optax.adamw(learning_rate)
+        from gpuschedule_tpu.parallel.train import make_optimizer
+
+        self.tx = make_optimizer(
+            learning_rate, warmup_steps=warmup_steps,
+            decay_steps=decay_steps, grad_clip=grad_clip,
+        )
         self.moe_aux_weight = moe_aux_weight
 
         def stage_fn(stage_params, x):

@@ -30,6 +30,39 @@ from gpuschedule_tpu.models import build_model
 from gpuschedule_tpu.models.config import CnnConfig
 
 
+def make_optimizer(
+    learning_rate: float,
+    *,
+    warmup_steps: int = 0,
+    decay_steps: Optional[int] = None,
+    grad_clip: Optional[float] = None,
+) -> optax.GradientTransformation:
+    """adamw with the standard training-stack trimmings, all opt-in:
+    linear warmup over ``warmup_steps``, cosine decay to zero over
+    ``decay_steps`` (counted after warmup), and global-norm gradient
+    clipping at ``grad_clip``.  Defaults reproduce plain
+    ``optax.adamw(learning_rate)`` exactly — the goldens and every
+    existing trainer call are byte-for-byte unchanged."""
+    if decay_steps:
+        sched = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=learning_rate,
+            warmup_steps=warmup_steps,
+            decay_steps=warmup_steps + decay_steps,
+        )
+    elif warmup_steps:
+        sched = optax.warmup_constant_schedule(
+            init_value=0.0, peak_value=learning_rate,
+            warmup_steps=warmup_steps,
+        )
+    else:
+        sched = learning_rate
+    tx = optax.adamw(sched)
+    if grad_clip is not None:
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+    return tx
+
+
 def param_partition_spec(path: Tuple, value: Any) -> P:
     """Megatron-style tp sharding rule for a transformer param.
 
@@ -93,6 +126,9 @@ class ShardedTrainer:
         ring_attn: bool = False,
         flash_attn: bool = False,
         moe_aux_weight: float = 1e-2,
+        warmup_steps: int = 0,
+        decay_steps: Optional[int] = None,
+        grad_clip: Optional[float] = None,
     ):
         # weight of the sown Switch load-balancing loss (MoE configs only;
         # a no-op for dense models, whose sow collection is empty)
@@ -156,7 +192,10 @@ class ShardedTrainer:
             raise ValueError(f"seq {seq_len} not divisible by sp={sp}")
         self.batch_size = batch_size
         self.seq_len = seq_len
-        self.tx = optax.adamw(learning_rate)
+        self.tx = make_optimizer(
+            learning_rate, warmup_steps=warmup_steps,
+            decay_steps=decay_steps, grad_clip=grad_clip,
+        )
         if self.is_image:
             # (images bhwc, labels b): batch dim sharded over dp
             self.batch_sharding = (

@@ -91,3 +91,17 @@ def test_reshard_state_live_move():
     # tp sharding applied: a column-parallel kernel is split over tp
     _, loss = dst.step(moved, dst.make_batch(seed=0))
     assert float(loss) == float(loss)
+
+
+def test_restore_mismatched_optimizer_raises_clear_error(tmp_path):
+    """Cross-MESH restore is supported; cross-OPTIMIZER is not —
+    grad_clip/warmup/decay change the opt_state pytree, and the raw orbax
+    structure error never says why.  restore_state must name the cause."""
+    mesh = make_mesh(dp=2, devices=jax.devices()[:2])
+    tr = ShardedTrainer("transformer-tiny", mesh, batch_size=2, seq_len=16)
+    save_state(tr.init(seed=0), tmp_path / "ck")
+    tr2 = ShardedTrainer(
+        "transformer-tiny", mesh, batch_size=2, seq_len=16, grad_clip=1.0
+    )
+    with pytest.raises(ValueError, match="optimizer hyperparameters"):
+        restore_state(tr2, tmp_path / "ck")
