@@ -291,6 +291,20 @@ def cmd_train(args) -> int:
         restore_state(trainer, args.restore) if args.restore
         else trainer.init(seed=args.seed)
     )
+    # resume the data stream where the saved run left it: the optimizer's
+    # adamw step count IS the number of batches consumed (deterministic
+    # seeded stream + count -> the restored run never re-trains on data
+    # the checkpointed run already saw)
+    resumed_at = 0
+    if args.restore:
+        import optax
+
+        # every transform's count advances once per update, but an LR
+        # schedule adds a SECOND "count" leaf (scale_by_schedule) and
+        # tree_get raises on multiple matches — collect them all; they
+        # agree, and max() is safe if a transform ever lacked one
+        counts = optax.tree_utils.tree_get_all_with_path(state[1], "count")
+        resumed_at = max((int(v) for _, v in counts), default=0)
 
     if args.data:
         ds = TokenFileDataset(
@@ -299,16 +313,21 @@ def cmd_train(args) -> int:
         )
 
         def batches():
-            epoch = 0
+            # O(1) jump to the resume position: whole epochs are encoded
+            # in the count, the remainder slices the epoch's permutation
+            epoch, start = divmod(resumed_at, ds.num_batches)
             while True:
-                yield from ds.batches(epoch=epoch)
+                yield from ds.batches(epoch=epoch, start=start)
                 epoch += 1
+                start = 0
     else:
         def batches():
             yield from synthetic_lm_batches(
                 batch_size=trainer.batch_size, seq_len=args.seq_len,
-                vocab=trainer.cfg.vocab, num_batches=args.steps,
+                vocab=trainer.cfg.vocab,
+                num_batches=resumed_at + args.steps,
                 seed=args.seed,
+                start=resumed_at,  # per-index keying makes this O(1)
             )
 
     import itertools
@@ -348,6 +367,7 @@ def cmd_train(args) -> int:
                 "last_loss": last_loss,
                 "tokens_per_s": tokens_per_s,
                 "checkpoint": args.ckpt or None,
+                "resumed_at_step": resumed_at if args.restore else None,
             },
             sort_keys=True,
         )

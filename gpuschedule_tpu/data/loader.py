@@ -66,12 +66,16 @@ class TokenFileDataset:
     def __len__(self) -> int:
         return self.num_batches
 
-    def batches(self, *, epoch: int = 0) -> Iterator[np.ndarray]:
-        """Yield every batch once, order shuffled per (seed, epoch)."""
+    def batches(self, *, epoch: int = 0, start: int = 0) -> Iterator[np.ndarray]:
+        """Yield every batch once, order shuffled per (seed, epoch).
+
+        ``start`` skips that many batches of the epoch in O(1) — resume
+        jumps straight to its position instead of reading and discarding
+        every already-consumed batch."""
         order = np.random.default_rng((self.seed, epoch)).permutation(
             self.num_batches
         )
-        for i in order:
+        for i in order[start:]:
             start = int(i) * self.block
             chunk = np.asarray(self._tokens[start:start + self.block])
             yield chunk.astype(np.int32).reshape(self.batch_size, self.seq_len)
@@ -103,12 +107,16 @@ def synthetic_lm_batches(
     vocab: int,
     num_batches: int,
     seed: int = 0,
+    start: int = 0,
 ) -> Iterator[np.ndarray]:
     """Deterministic random token batches with the dataset iterator
-    contract — the zero-IO feed for benchmarks and profiling."""
-    rng = np.random.default_rng(seed)
-    for _ in range(num_batches):
-        yield rng.integers(
+    contract — the zero-IO feed for benchmarks and profiling.
+
+    Each batch is keyed by (seed, index), so ``start`` resumes the stream
+    at any position in O(1): batch i is identical whether the stream was
+    consumed from 0 or entered at i."""
+    for i in range(start, num_batches):
+        yield np.random.default_rng((seed, i)).integers(
             0, vocab, size=(batch_size, seq_len), dtype=np.int32
         )
 

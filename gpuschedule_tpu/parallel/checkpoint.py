@@ -109,15 +109,15 @@ def restore_state(trainer, path: str | Path):
                 ),
             )
         except ValueError as e:
-            # cross-MESH restore is supported; cross-OPTIMIZER is not —
-            # grad_clip/warmup/decay change the opt_state tree structure,
-            # and orbax's structure-mismatch error doesn't say why
+            # orbax's structure-mismatch error never says WHY the trees
+            # differ; name the likely causes instead of re-raising bare
             raise ValueError(
                 f"checkpoint at {path} does not match the target trainer's "
-                "state structure. Mesh shape may differ (that resharding "
-                "is supported), but optimizer hyperparameters must match "
-                "the saving run: warmup_steps/decay_steps/grad_clip change "
-                f"the opt_state pytree. Original error: {e}"
+                "state structure. Likely causes: a different model config, "
+                "or different optimizer hyperparameters "
+                "(warmup_steps/decay_steps/grad_clip change the opt_state "
+                "pytree). A different MESH shape alone is fine — that "
+                f"resharding is supported. Original error: {e}"
             ) from e
 
 
