@@ -13,8 +13,10 @@ retry/except logic never fires; BENCH_r01 ``parsed: null``, BENCH_r02
   wall-clock timeout, SIGKILLs the whole process group on expiry, and falls
   back from the flagship ``transformer-large`` to the faster-compiling
   ``transformer-base``.  On the first successful attempt it relays the
-  child's JSON line; if every attempt fails it prints a
-  ``{"metric": "bench-failed: ...", ...}`` diagnostic carrying each
+  child's JSON line.  If every TPU attempt fails, a last-resort CPU
+  measurement runs (metric prefixed ``cpu-fallback``, ``vs_baseline`` 0 —
+  no MFU credit against the TPU roofline, the TPU failure notes attached);
+  only if that fails too does the line read ``bench-failed`` with each
   attempt's last reported stage.  Total wall-clock is bounded well inside
   the driver's budget.
 * **Child** (``--child MODEL``): the actual measurement — full jitted train
@@ -50,7 +52,8 @@ TARGET_MFU = 0.30
 # (model, hard timeout seconds).  transformer-large is the flagship (62% MFU
 # config — models/config.py); transformer-base compiles faster and is the
 # fallback if the tunnel is slow rather than dead.  Worst case ~8.5 min of
-# attempts, far inside the driver's budget (r02 ran >26 min before rc=124).
+# TPU attempts plus up to 5 min of CPU fallback (~13.5 min total), inside
+# the driver's budget (r02 ran >26 min before rc=124).
 # Overridable for tests: GSTPU_BENCH_MODELS="m1,m2" GSTPU_BENCH_TIMEOUT=30.
 def _attempt_plan():
     models = os.environ.get("GSTPU_BENCH_MODELS")
@@ -119,20 +122,27 @@ def child_main(model: str) -> None:
     flops_per_step = trainer.cfg.flops_per_token() * units
     achieved_tflops = flops_per_step / step_s / 1e12
 
-    kind = getattr(dev, "device_kind", "").lower()
-    gen = "v5p" if "v5p" in kind or "v5 pod" in kind else "v5e"
-    peak_tflops = GENERATIONS[gen]["bf16_tflops"]
-    mfu = achieved_tflops / peak_tflops
+    if jax.default_backend() == "tpu":
+        kind = getattr(dev, "device_kind", "").lower()
+        gen = "v5p" if "v5p" in kind or "v5 pod" in kind else "v5e"
+        peak_tflops = GENERATIONS[gen]["bf16_tflops"]
+        mfu = achieved_tflops / peak_tflops
+        tail = f"mfu={mfu:.3f} @ {achieved_tflops:.1f} TF on {gen}"
+        vsb = round(mfu / TARGET_MFU, 3)
+    else:
+        # test hook / fallback runs: never claim a TPU MFU figure for a
+        # run that touched no TPU
+        tail = f"backend={jax.default_backend()}; MFU n/a off-TPU"
+        vsb = 0.0
 
     print(
         json.dumps(
             {
                 "metric": f"{model} train-step {unit_name}/s (b{BATCH}xs{SEQ}, 1 chip, "
-                f"median of 3x{ITERS}-step blocks; "
-                f"mfu={mfu:.3f} @ {achieved_tflops:.1f} TF on {gen})",
+                f"median of 3x{ITERS}-step blocks; {tail})",
                 "value": round(tokens_per_s, 1),
                 "unit": f"{unit_name}/s",
-                "vs_baseline": round(mfu / TARGET_MFU, 3),
+                "vs_baseline": vsb,
             }
         ),
         flush=True,
@@ -361,7 +371,9 @@ def _devices_with_retry(jax):
             time.sleep(30.0)
 
 
-def _run_attempt(model: str, timeout_s: int, child_flag: str = "--child") -> tuple:
+def _run_attempt(
+    model: str, timeout_s: int, child_flag: str = "--child", env: dict = None
+) -> tuple:
     """Run one child attempt.  Returns (parsed_json_or_None, failure_note)."""
     proc = subprocess.Popen(
         [sys.executable, "-u", os.path.abspath(__file__), child_flag, model],
@@ -370,6 +382,7 @@ def _run_attempt(model: str, timeout_s: int, child_flag: str = "--child") -> tup
         text=True,
         start_new_session=True,  # own process group: killable even mid-hang
         cwd=os.path.dirname(os.path.abspath(__file__)),
+        env=env,  # None inherits
     )
     timed_out = False
     try:
@@ -474,7 +487,27 @@ def main() -> None:
             print(f"attempt {i + 1} failed: {note}", file=sys.stderr, flush=True)
             if i + 1 < len(attempts):
                 time.sleep(RETRY_PAUSE_S)
-        reason = "all TPU attempts hung or errored (axon tunnel backend-init hang is the known cause)"
+        # last resort: a clearly-labeled CPU measurement beats a bare
+        # failure line — it proves the software path still works while
+        # the tunnel is dead.  vs_baseline stays 0: no MFU credit is
+        # claimed for a CPU number against a TPU roofline target.
+        parsed, note = _run_attempt(
+            "transformer-tiny",
+            int(os.environ.get("GSTPU_BENCH_TIMEOUT", "300")),
+            env=dict(os.environ, GSTPU_BENCH_PLATFORM="cpu"),
+        )
+        if parsed is not None:
+            parsed["metric"] = (
+                "cpu-fallback (TPU tunnel unreachable; NOT comparable to "
+                f"TPU rounds): {parsed.get('metric', '')}"
+            )
+            parsed["vs_baseline"] = 0.0
+            parsed["cpu_fallback"] = True
+            parsed["attempts"] = failures
+            print(json.dumps(parsed), flush=True)
+            return
+        failures.append(f"cpu-fallback {note}")
+        reason = "all TPU attempts hung or errored (axon tunnel backend-init hang is the known cause), and the CPU fallback failed too"
     except Exception as exc:  # the one-JSON-line contract holds even for
         failures.append(f"parent error: {type(exc).__name__}: {exc}")  # parent bugs
         reason = "parent-side exception"

@@ -54,12 +54,41 @@ def test_last_stage_parses_progress_markers():
 def test_main_failure_path_always_prints_one_json_line(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_attempt_plan", lambda: [("m", 1), ("m", 1)])
     monkeypatch.setattr(bench, "RETRY_PAUSE_S", 0.0)
-    monkeypatch.setattr(bench, "_run_attempt", lambda m, t: (None, f"{m}: boom"))
+    monkeypatch.setattr(
+        bench, "_run_attempt", lambda m, t, **kw: (None, f"{m}: boom")
+    )
     bench.main()
     parsed = _one_json_line(capsys.readouterr().out)
     assert parsed["metric"].startswith("bench-failed")
     assert parsed["value"] == 0.0 and parsed["vs_baseline"] == 0.0
-    assert parsed["attempts"] == ["m: boom", "m: boom"]
+    # the two TPU attempts plus the last-resort CPU fallback
+    assert parsed["attempts"] == [
+        "m: boom", "m: boom", "cpu-fallback transformer-tiny: boom"
+    ]
+
+
+def test_main_cpu_fallback_labels_the_line(monkeypatch, capsys):
+    """When every TPU attempt dies but the CPU fallback measures, the one
+    JSON line is the labeled fallback: metric prefixed, vs_baseline
+    zeroed (no MFU credit against the TPU roofline), failures attached."""
+    good = {"metric": "tiny x/s", "value": 5.0, "unit": "u", "vs_baseline": 9.9}
+
+    def fake(m, t, **kw):
+        if kw.get("env", {}) and kw["env"].get("GSTPU_BENCH_PLATFORM") == "cpu":
+            return dict(good), ""
+        return None, f"{m}: hang"
+
+    monkeypatch.setattr(bench, "_attempt_plan", lambda: [("a", 1)])
+    monkeypatch.setattr(bench, "RETRY_PAUSE_S", 0.0)
+    monkeypatch.setattr(bench, "_run_attempt", fake)
+    bench.main()
+    parsed = _one_json_line(capsys.readouterr().out)
+    assert parsed["metric"].startswith("cpu-fallback")
+    assert "tiny x/s" in parsed["metric"]
+    assert parsed["cpu_fallback"] is True
+    assert parsed["vs_baseline"] == 0.0
+    assert parsed["value"] == 5.0
+    assert parsed["attempts"] == ["a: hang"]
 
 
 def test_main_success_path_relays_child_json(monkeypatch, capsys):
