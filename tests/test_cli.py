@@ -116,6 +116,24 @@ def test_compare_topology_writes_report(tmp_path, capsys):
     assert (tmp_path / "cdf_tpu-v5p.csv").exists()
 
 
+def test_compare_topology_load_sweep_flag(capsys):
+    """--load-sweep adds the acceptance-band-vs-offered-load table, with
+    the base-load point reusing the replays already computed (its entry
+    must match the top-level acceptance block exactly)."""
+    rc, out = run_cli(
+        capsys,
+        "compare-topology", "--synthetic", "40", "--seed", "5",
+        "--gpu-shape", "2x4x8", "--load-sweep",
+    )
+    assert rc == 0
+    summary = json.loads(out[-1])
+    sweep = summary["load_sweep"]
+    assert set(sweep) == {"0.70", "0.80", "0.90", "0.95"}
+    for entry in sweep.values():
+        assert set(entry) >= {"jct_delta_pct", "within_5pct"}
+    assert sweep["0.95"] == summary["acceptance"]
+
+
 def test_max_time_cutoff(capsys):
     rc, out = run_cli(
         capsys,
@@ -290,3 +308,38 @@ def test_train_resume_with_schedule_flags(tmp_path, capsys):
     assert rc2 == 0
     s2 = json.loads(out2[-1])
     assert s2["resumed_at_step"] == 2
+
+
+def test_run_events_flag_writes_jsonl(tmp_path, capsys):
+    """--events: the CLI wires the opt-in structured event log through to
+    the engine (library behavior pinned in test_events.py)."""
+    rc, _ = run_cli(
+        capsys,
+        "run", "--policy", "srtf", "--cluster", "tpu-v5e", "--dims", "8x8",
+        "--synthetic", "20", "--seed", "4", "--events",
+        "--out", str(tmp_path),
+    )
+    assert rc == 0
+    events = (tmp_path / "events.jsonl").read_text().strip().splitlines()
+    assert events
+    kinds = {json.loads(ln)["event"] for ln in events}
+    assert "start" in kinds and "finish" in kinds
+
+
+def test_profile_subcommand_fits_and_traces(tmp_path, capsys):
+    """`cli profile`: fit a goodput curve on the live (CPU-mesh) devices,
+    persist it, and capture an xprof trace on the same mesh."""
+    pytest.importorskip("jax")
+    curves = tmp_path / "curves.json"
+    rc, out = run_cli(
+        capsys,
+        "profile", "--model", "transformer-tiny", "--ks", "1,64",
+        "--batch-size", "2", "--seq-len", "32",
+        "--curves", str(curves), "--trace-dir", str(tmp_path / "tr"),
+    )
+    assert rc == 0
+    fit = json.loads(out[0])
+    assert fit["model"] == "transformer-tiny" and len(fit["theta"]) == 3
+    trace = json.loads(out[1])
+    assert Path(trace["xprof_trace"]).exists()
+    assert "transformer-tiny" in json.loads(curves.read_text())
