@@ -358,8 +358,13 @@ class TpuCluster(OverlayMixin, ClusterBase):
 
         cfg = MODEL_CONFIGS.get(getattr(job, "model_name", None))
         param_count = cfg.param_count if cfg is not None else 30_000_000
+        # tp-sharded params shrink the per-chip dp-sync payload by tp —
+        # the same division profile_model applies to the curve's
+        # dcn_grad_bytes, so the planner's cliff and this enacted toll
+        # agree for parallelism-spec jobs
+        tp = max(1, int(getattr(job, "tp", 1) or 1))
         t_dcn = cross_pod_allreduce_seconds(
-            dp_gradient_bytes(param_count), num_pods_spanned
+            dp_gradient_bytes(param_count // tp), num_pods_spanned
         )
         return self.dcn_step_seconds / (self.dcn_step_seconds + t_dcn)
 

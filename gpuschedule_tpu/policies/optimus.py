@@ -52,7 +52,10 @@ class OptimusPolicy(Policy):
         profile_ks=(1, 2, 4),
         profile_batch: int = 2,
         profile_seq: int = 32,
-        profile_time_cost: float = 120.0,
+        profile_iters: int = 10,
+        profile_warmup: int = 2,
+        profile_compile_s: float = 30.0,
+        profile_time_cost: Optional[float] = None,
     ):
         self.cache = curve_cache
         self.online = online
@@ -62,48 +65,101 @@ class OptimusPolicy(Policy):
         self.profile_ks = tuple(profile_ks)
         self.profile_batch = profile_batch
         self.profile_seq = profile_seq
+        self.profile_iters = int(profile_iters)
+        self.profile_warmup = int(profile_warmup)
+        self.profile_compile_s = float(profile_compile_s)
         # Profiling is NOT free in simulated time (round-3 verdict #5; the
         # reference's profiling runs consume real cluster resources,
         # SURVEY.md §3.2 ★): the first job of each online-profiled model
-        # pays this many seconds of start overhead — its slice is held but
-        # makes no training progress, the engine's overhead mechanism —
-        # before real work begins.  Cache-hit models pay nothing, so a
-        # warm CurveCache is measurably better than a cold one.
-        self.profile_time_cost = float(profile_time_cost)
+        # pays a start overhead — its slice is held but makes no training
+        # progress, the engine's overhead mechanism — before real work
+        # begins.  Cache-hit models pay nothing, so a warm CurveCache is
+        # measurably better than a cold one.  By default the charge is
+        # DERIVED from the profiling workload itself (round-4 verdict #7:
+        # a flat constant ignores that the harness cost scales with
+        # profile_ks and iters): per profiled k, one compile plus
+        # (warmup + iters) steps at that k's fitted step time.  A float
+        # here overrides with the old flat charge.
+        self.profile_time_cost = (
+            None if profile_time_cost is None else float(profile_time_cost)
+        )
         self._curves: Dict[str, GoodputCurve] = {}
-        self._profile_charge_pending: set = set()
+        self._profile_charge_pending: Dict[str, float] = {}
+        # the scheduled cluster's pod boundary, captured each schedule()
+        # call: DCN-cliff planning must use the fleet's real pod size, not
+        # the nominal generation pod the curve was profiled against
+        self._cluster_pod: Optional[int] = None
 
     # ------------------------------------------------------------------ #
     # curves
 
-    def _curve(self, model_name: str) -> GoodputCurve:
-        curve = self._curves.get(model_name)
+    @staticmethod
+    def _curve_key(job: Job) -> str:
+        """Cache key for a job's curve: the @sp{s}tp{t} variant when the
+        job declares a parallelism spec, else the bare model name — the
+        consumer side of profile_model's variant keys (harness.py)."""
+        sp, tp = getattr(job, "sp", 1), getattr(job, "tp", 1)
+        if sp == 1 and tp == 1:
+            return job.model_name
+        return f"{job.model_name}@sp{sp}tp{tp}"
+
+    def _profile_charge(self, curve: GoodputCurve, ks=None) -> float:
+        """Simulated seconds one online-profiling run occupies its slice:
+        per profiled k, a compile plus (warmup + iters) steps at the
+        fitted step time — so more ks, more iters, or a slower model all
+        raise the charge the way they raise the real harness cost."""
+        if self.profile_time_cost is not None:
+            return self.profile_time_cost
+        steps = self.profile_warmup + self.profile_iters
+        return sum(
+            self.profile_compile_s + steps * curve.step_time(k)
+            for k in (self.profile_ks if ks is None else ks)
+        )
+
+    def _job_curve(self, job: Job) -> GoodputCurve:
+        key = self._curve_key(job)
+        curve = self._curves.get(key)
         if curve is not None:
             return curve
-        if self.cache is not None and model_name in self.cache:
-            curve = self.cache.get(model_name)
+        if self.cache is not None and key in self.cache:
+            curve = self.cache.get(key)
         elif self.online:
             # the reference's online-profiling boundary (SURVEY.md §3.2 ★):
             # a real measured run, here a jitted train step on live devices
             from gpuschedule_tpu.profiler.harness import profile_model
 
+            sp, tp = getattr(job, "sp", 1), getattr(job, "tp", 1)
+            unit = sp * tp
+            # profile_model requires ks divisible by the replica unit:
+            # profile at replica multiples for parallelism-spec jobs
+            ks = tuple(k * unit for k in self.profile_ks) if unit > 1 else self.profile_ks
             curve = profile_model(
-                model_name,
-                ks=self.profile_ks,
+                job.model_name,
+                ks=ks,
                 batch_size=self.profile_batch,
                 seq_len=self.profile_seq,
+                sp=sp,
+                tp=tp,
                 cache=self.cache,
             )
-            if self.profile_time_cost > 0.0:
-                self._profile_charge_pending.add(model_name)
+            charge = self._profile_charge(curve, ks=ks)
+            if charge > 0.0:
+                self._profile_charge_pending[key] = charge
+        elif self.cache is not None and job.model_name in self.cache:
+            # offline, no measured variant: the bare-model curve beats the
+            # featureless default.  (Online runs never take this branch —
+            # the variant deserves its own profile; a bare-model cache hit
+            # must not shadow it.)
+            curve = self.cache.get(job.model_name)
         else:
             curve = DEFAULT_CURVE
-        self._curves[model_name] = curve
+        self._curves[key] = curve
         return curve
 
     # ------------------------------------------------------------------ #
 
     def schedule(self, sim) -> Optional[float]:
+        self._cluster_pod = getattr(sim.cluster, "pod_chips", None)
         active = [j for j in sim.pending + sim.running if not j.finished]
         if not active:
             return None
@@ -121,17 +177,35 @@ class OptimusPolicy(Policy):
 
     def _remaining_at(self, job: Job, k: int) -> float:
         """Wall seconds to finish job on k chips per its curve (the curve
-        ratio rescales the trace-declared reference-speed work)."""
-        curve = self._curve(job.model_name)
-        return job.remaining_work * curve.step_time(k) / curve.step_time(job.num_chips)
+        ratio rescales the trace-declared reference-speed work).
+
+        Planning uses ``step_time_dcn``: beyond one pod the analytic DCN
+        allreduce phase degrades the estimate, so marginal gain sees the
+        ICI->DCN cliff — comm-heavy models decline whale growth that
+        compute-heavy models accept (round-4 verdict #3)."""
+        curve = self._job_curve(job)
+        pod = self._cluster_pod
+        return (
+            job.remaining_work
+            * curve.step_time_dcn(k, pod_chips=pod)
+            / curve.step_time_dcn(job.num_chips, pod_chips=pod)
+        )
 
     def _gain(self, job: Job, k: int) -> float:
         """Marginal remaining-time reduction per chip for doubling k."""
         return (self._remaining_at(job, k) - self._remaining_at(job, 2 * k)) / k
 
     def _max_chips(self, sim, job: Job) -> int:
-        cap = getattr(sim.cluster, "pod_chips", sim.cluster.total_chips)
-        return cap
+        """Growth ceiling: one pod for curves that carry no DCN model (a
+        smooth extrapolation across the pod boundary would overestimate
+        multislice gain), the whole fleet for multislice-aware curves —
+        the cliff in step_time_dcn is then what self-terminates growth."""
+        pod = getattr(sim.cluster, "pod_chips", sim.cluster.total_chips)
+        # the payload is what makes the DCN phase computable; the boundary
+        # itself comes from the scheduled cluster (_remaining_at)
+        if self._job_curve(job).dcn_grad_bytes is not None:
+            return sim.cluster.total_chips
+        return pod
 
     def _plan(self, sim, active) -> Dict[str, int]:
         """Greedy marginal-gain chip assignment; returns job_id -> chips."""
@@ -141,7 +215,9 @@ class OptimusPolicy(Policy):
         by_id: Dict[str, Job] = {}
         for job in ordered:
             by_id[job.job_id] = job
-            k0 = self.min_chips
+            # one model replica spans sp*tp chips: a parallelism-spec job
+            # cannot seed below its replica size
+            k0 = max(self.min_chips, getattr(job, "sp", 1) * getattr(job, "tp", 1))
             if budget >= k0 and sim.cluster.is_satisfiable(k0):
                 plan[job.job_id] = k0
                 budget -= k0
@@ -178,7 +254,11 @@ class OptimusPolicy(Policy):
     # enactment
 
     def _speed(self, job: Job, k: int) -> float:
-        return self._curve(job.model_name).speed_factor(k, job.num_chips)
+        """Enacted progress rate: the PLAIN (DCN-free) curve ratio.  The
+        engine multiplies in the DCN toll itself via job.locality_factor
+        (cluster `_multislice_speed_factor`); using step_time_dcn here
+        would charge a multislice job the toll twice."""
+        return self._job_curve(job).speed_factor(k, job.num_chips)
 
     def _enact(self, sim, plan: Dict[str, int]) -> None:
         # shrink & evict first: frees chips (and boxes) for the growers
@@ -201,16 +281,16 @@ class OptimusPolicy(Policy):
             if k > 0:
                 overhead = self.resize_overhead if job.executed_work > 0.0 else 0.0
                 # The first job of a freshly online-profiled model carries
-                # the profiling run: its slice is occupied for
-                # profile_time_cost seconds before training progresses.
-                profiling = job.model_name in self._profile_charge_pending
-                if profiling:
-                    overhead += self.profile_time_cost
+                # the profiling run: its slice is occupied for the derived
+                # charge (see _profile_charge) before training progresses.
+                key = self._curve_key(job)
+                charge = self._profile_charge_pending.get(key, 0.0)
                 if (
                     sim.try_start(
-                        job, chips=k, speed=self._speed(job, k), overhead=overhead
+                        job, chips=k, speed=self._speed(job, k),
+                        overhead=overhead + charge,
                     )
-                    and profiling
+                    and charge > 0.0
                 ):
-                    self._profile_charge_pending.discard(job.model_name)
+                    self._profile_charge_pending.pop(key, None)
                     sim.metrics.count("profiling_runs")

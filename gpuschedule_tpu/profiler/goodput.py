@@ -41,15 +41,49 @@ from gpuschedule_tpu.profiler.ici import (
 
 @dataclass(frozen=True)
 class GoodputCurve:
-    """Fitted step-time curve for one model."""
+    """Fitted step-time curve for one model.
+
+    ``pod_chips``/``dcn_grad_bytes`` (optional) make the curve
+    *multislice-aware*: the smooth three-parameter family is fit on
+    intra-pod points only (it cannot represent the ICI→DCN cliff — a step
+    discontinuity at the pod boundary), and :meth:`step_time_dcn` adds the
+    analytic cross-pod allreduce phase for k beyond one pod.  Schedulers
+    must plan with ``step_time_dcn`` but enact speed from the plain
+    ``speed_factor``: the sim engine charges the DCN toll separately
+    through ``job.locality_factor`` (cluster/tpu.py
+    ``_multislice_speed_factor``), so a DCN-aware enacted speed would
+    double-count it.
+    """
 
     theta: Tuple[float, float, float]
+    pod_chips: Optional[int] = None      # multislice boundary (None: no DCN model)
+    dcn_grad_bytes: Optional[float] = None  # per-chip dp-sync payload over DCN
 
     def step_time(self, k: int) -> float:
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
         t0, t1, t2 = self.theta
         return t0 / k + t1 + t2 * (k - 1)
+
+    @property
+    def multislice_aware(self) -> bool:
+        return self.pod_chips is not None and self.dcn_grad_bytes is not None
+
+    def step_time_dcn(self, k: int, *, pod_chips: Optional[int] = None) -> float:
+        """Planning-time step estimate including the DCN phase beyond one
+        pod — the number marginal-gain decisions must use.  Falls back to
+        the smooth family when the curve carries no multislice fields.
+
+        ``pod_chips`` overrides the curve's own boundary: the cliff sits
+        where the *cluster being scheduled* crosses pods (a curve profiled
+        against the nominal v5e 256-chip pod would otherwise misplace the
+        boundary on a custom-dims fleet)."""
+        base = self.step_time(k)
+        boundary = pod_chips if pod_chips is not None else self.pod_chips
+        if boundary is not None and self.dcn_grad_bytes is not None and k > boundary:
+            m = math.ceil(k / boundary)
+            base += cross_pod_allreduce_seconds(self.dcn_grad_bytes, m)
+        return base
 
     def throughput(self, k: int) -> float:
         """Steps per second at k chips."""
@@ -213,15 +247,27 @@ class CurveCache:
     def load(self) -> None:
         raw = json.loads(self.path.read_text())
         for name, entry in raw.items():
-            self._curves[name] = GoodputCurve(tuple(entry["theta"]))
-            self._meta[name] = {k: v for k, v in entry.items() if k != "theta"}
+            ms = entry.get("multislice") or {}
+            self._curves[name] = GoodputCurve(
+                tuple(entry["theta"]),
+                pod_chips=ms.get("pod_chips"),
+                dcn_grad_bytes=ms.get("dcn_grad_bytes"),
+            )
+            self._meta[name] = {
+                k: v for k, v in entry.items() if k not in ("theta", "multislice")
+            }
 
     def save(self) -> None:
         self.path.parent.mkdir(parents=True, exist_ok=True)
-        payload = {
-            name: {"theta": list(curve.theta), **self._meta.get(name, {})}
-            for name, curve in self._curves.items()
-        }
+        payload = {}
+        for name, curve in self._curves.items():
+            entry = {"theta": list(curve.theta), **self._meta.get(name, {})}
+            if curve.multislice_aware:
+                entry["multislice"] = {
+                    "pod_chips": curve.pod_chips,
+                    "dcn_grad_bytes": curve.dcn_grad_bytes,
+                }
+            payload[name] = entry
         self.path.write_text(json.dumps(payload, indent=2, sort_keys=True))
 
     def put(

@@ -242,7 +242,35 @@ def profile_model(
         )
         points.update(dict(zip(synth_ks, synth)))
 
-    curve = fit_step_time_curve(sorted(points), [points[k] for k in sorted(points)])
+    # Fit the smooth family on intra-pod points only: the three-parameter
+    # family cannot represent the ICI->DCN step discontinuity at the pod
+    # boundary, so multislice points would corrupt the intra-pod fit.  The
+    # curve instead carries (pod_chips, dcn_grad_bytes) and adds the
+    # analytic DCN phase in step_time_dcn — the same cross-pod term the
+    # synthesized points above used, so planning and synthesis agree.
+    import math as _math
+
+    from gpuschedule_tpu.cluster.tpu import GENERATIONS
+    from gpuschedule_tpu.profiler.ici import dp_gradient_bytes as _dp_bytes
+
+    pod = _math.prod(GENERATIONS[generation]["pod_dims"])
+    intra = {k: v for k, v in points.items() if k <= pod}
+    if intra:
+        curve = fit_step_time_curve(sorted(intra), [intra[k] for k in sorted(intra)])
+        curve = GoodputCurve(
+            curve.theta,
+            pod_chips=pod,
+            dcn_grad_bytes=_dp_bytes(cfg.param_count // tp),
+        )
+    else:
+        # every requested k lies beyond one pod: the synthesized points
+        # already carry the DCN phase, so fit the smooth family on them
+        # and leave the curve non-multislice-aware — step_time_dcn adding
+        # the phase AGAIN on top of a DCN-baked fit would double-count it
+        # (consumers then keep the conservative one-pod growth cap)
+        curve = fit_step_time_curve(
+            sorted(points), [points[k] for k in sorted(points)]
+        )
     if cache is not None:
         # sp/tp variants get their own cache key: the scheduler's replay
         # looks curves up by bare model name, and a dp curve silently

@@ -67,6 +67,12 @@ class Job:
     user: str = ""                      # submitting user/vc (Philly has VCs)
     utilization: float = 1.0            # profiled device utilization in [0,1];
                                         # Gandiva's packing signal (SURVEY.md §3.3)
+    sp: int = 1                         # declared sequence-parallel factor: one
+    tp: int = 1                         # model replica spans sp*tp chips, and
+                                        # goodput curves resolve to the
+                                        # @sp{s}tp{t} cache variant when set
+                                        # (round-4 verdict #3: parallelism-aware
+                                        # curves get a policy consumer)
 
     # ---- runtime accounting (engine-owned) ----
     state: JobState = JobState.PENDING

@@ -91,6 +91,74 @@ def test_main_cpu_fallback_labels_the_line(monkeypatch, capsys):
     assert parsed["attempts"] == ["a: hang"]
 
 
+def test_attach_extras_folds_flash_and_longctx_into_the_line(monkeypatch):
+    """Round-4 verdict #1: the driver's default line must carry the kernel
+    and long-context chip proofs as fields, not as builder-run one-offs."""
+    child_lines = {
+        "--child-flash": {
+            "metric": "flash", "value": 900.0, "unit": "tokens/s",
+            "vs_baseline": 0.2, "kernel_speedup_vs_dense": 2.1,
+            "fwd_maxerr": 1e-3, "bwd_relerr": 2e-3, "mfu": 0.06,
+            "compiled": True, "backend": "tpu",
+        },
+        "--child-longctx": {
+            "metric": "longctx", "value": 400.0, "unit": "tokens/s",
+            "vs_baseline": 1.0, "seq_len": 32768,
+            "dense_feasible": False, "mfu": 0.09,
+        },
+    }
+
+    def fake(m, t, child_flag="--child", env=None):
+        return dict(child_lines[child_flag]), ""
+
+    monkeypatch.setattr(bench, "_run_attempt", fake)
+    line = {"metric": "main", "value": 1.0, "vs_baseline": 2.0, "backend": "tpu"}
+    bench._attach_extras(line, time.monotonic())
+    assert line["flash"]["kernel_vs_dense"] == 2.1
+    assert line["flash"]["fwd_maxerr"] == 1e-3
+    assert line["flash"]["compiled"] is True
+    assert line["longctx"]["seq_len"] == 32768
+    assert line["longctx"]["dense_feasible"] is False
+    assert line["longctx"]["mfu"] == 0.09
+
+
+def test_attach_extras_failure_is_nonfatal_and_skipped_off_tpu(monkeypatch):
+    monkeypatch.setattr(
+        bench, "_run_attempt",
+        lambda m, t, child_flag="--child", env=None: (None, f"{m}: hang"),
+    )
+    line = {"metric": "main", "backend": "tpu"}
+    bench._attach_extras(line, time.monotonic())
+    assert "failed" in line["flash"] and "failed" in line["longctx"]
+
+    cpu_line = {"metric": "main", "backend": "cpu"}
+    bench._attach_extras(cpu_line, time.monotonic())
+    assert "flash" not in cpu_line and "longctx" not in cpu_line
+
+    monkeypatch.setenv("GSTPU_BENCH_EXTRAS", "0")
+    off = {"metric": "main", "backend": "tpu"}
+    bench._attach_extras(off, time.monotonic())
+    assert "flash" not in off and "longctx" not in off
+
+
+def test_attach_extras_respects_the_wall_clock_budget(monkeypatch):
+    """When the main attempts already burned the budget, the extras are
+    skipped with a labeled note rather than pushing the parent past the
+    driver's kill window (the BENCH_r02 rc=124 failure mode)."""
+    calls = []
+    monkeypatch.setattr(
+        bench, "_run_attempt",
+        lambda m, t, child_flag="--child", env=None: calls.append(child_flag)
+        or ({"metric": "x"}, ""),
+    )
+    line = {"metric": "main", "backend": "tpu"}
+    # pretend the main bench started TOTAL_BUDGET_S ago
+    bench._attach_extras(line, time.monotonic() - bench.TOTAL_BUDGET_S)
+    assert calls == []  # no child was launched
+    assert "skipped" in line["flash"] and "skipped" in line["longctx"]
+    assert "budget" in line["flash"]["skipped"]
+
+
 def test_main_success_path_relays_child_json(monkeypatch, capsys):
     good = {"metric": "x", "value": 1.0, "unit": "u", "vs_baseline": 2.0}
     calls = []

@@ -161,6 +161,116 @@ def test_registry_constructs_optimus():
     assert isinstance(pol, OptimusPolicy)
 
 
+# --------------------------------------------------------------------- #
+# round-4 verdict #3: the policy consumes the parallelism the profiler
+# measures — sp/tp curve variants, and multislice growth gated by the
+# DCN segment of the curve
+
+
+def test_dcn_segment_changes_the_growth_decision(tmp_path):
+    """The ICI->DCN cliff is a *scheduling input*: on a 2-pod fleet, a
+    compute-heavy model (small grad payload) doubles past the pod
+    boundary while a comm-heavy one (large payload) declines the same
+    growth — identical compute curves, different DCN phase."""
+    pod = 16  # v5e dims (4, 4)
+    light = GoodputCurve((1.0, 0.0, 1e-6), pod_chips=pod, dcn_grad_bytes=1e6)
+    heavy = GoodputCurve((1.0, 0.0, 1e-6), pod_chips=pod, dcn_grad_bytes=1e9)
+    # sanity on the family itself: the smooth part is identical, only the
+    # planning estimate beyond one pod diverges
+    assert light.step_time(32) == heavy.step_time(32)
+    assert heavy.step_time_dcn(32) > heavy.step_time_dcn(16)   # cliff
+    assert light.step_time_dcn(32) < light.step_time_dcn(16)   # still scales
+
+    def plan_for(curve):
+        cache = CurveCache(tmp_path / f"c{id(curve)}.json")
+        cache.put("m", curve)
+        pol = OptimusPolicy(curve_cache=cache)
+        job = Job("j", 0.0, num_chips=4, duration=1000.0, model_name="m")
+        sim = Simulator(TpuCluster("v5e", dims=(4, 4), num_pods=2), pol, [job])
+        return pol._plan(sim, [job])["j"]
+
+    assert plan_for(light) == 32  # grows into multislice
+    assert plan_for(heavy) == 16  # stops at the pod boundary
+
+
+def test_curve_without_dcn_fields_keeps_the_one_pod_cap(tmp_path):
+    """A plain fitted curve carries no DCN model; extrapolating it across
+    the pod boundary would overestimate multislice gain, so growth stays
+    capped at one pod — the pre-round-5 behavior, now a deliberate
+    fallback rather than a global ceiling."""
+    cache = CurveCache(tmp_path / "c.json")
+    cache.put("m", GoodputCurve((1.0, 0.0, 1e-9)))  # near-perfect scaling
+    pol = OptimusPolicy(curve_cache=cache)
+    job = Job("j", 0.0, num_chips=4, duration=1000.0, model_name="m")
+    sim = Simulator(TpuCluster("v5e", dims=(4, 4), num_pods=2), pol, [job])
+    assert pol._plan(sim, [job])["j"] == 16
+
+
+def test_parallelism_spec_resolves_sp_tp_curve_variant(tmp_path):
+    """A job declaring (sp, tp) plans from the @sp{s}tp{t} cache variant
+    (harness.py cache keys), and its replica size floors the seed
+    allocation at sp*tp chips."""
+    cache = CurveCache(tmp_path / "c.json")
+    cache.put("m", GoodputCurve((1.0, 0.0, 0.5)))          # bare: stops at k=2
+    cache.put("m@sp2tp2", GoodputCurve((1.0, 0.0, 1e-6)))  # variant: scales
+    pol = OptimusPolicy(curve_cache=cache)
+    plain = Job("p", 0.0, num_chips=4, duration=100.0, model_name="m")
+    spec = Job("s", 0.0, num_chips=4, duration=100.0, model_name="m", sp=2, tp=2)
+    assert pol._job_curve(plain).theta == (1.0, 0.0, 0.5)
+    assert pol._job_curve(spec).theta == (1.0, 0.0, 1e-6)
+
+    sim = Simulator(TpuCluster("v5e", dims=(4, 4)), pol, [spec])
+    plan = pol._plan(sim, [spec])
+    assert plan["s"] >= 4  # never below one replica
+
+    # an unmeasured variant falls back to the bare-model curve
+    other = Job("o", 0.0, num_chips=4, duration=100.0, model_name="m", sp=4, tp=1)
+    assert pol._job_curve(other).theta == (1.0, 0.0, 0.5)
+
+
+def test_multislice_growth_runs_end_to_end(tmp_path):
+    """A lone compute-heavy job on a 2-pod fleet grows across the DCN
+    boundary, pays the engine's locality toll (speed_factor < 1), and
+    still finishes sooner than a one-pod cap would allow."""
+    cache = CurveCache(tmp_path / "c.json")
+    cache.put(
+        "transformer-tiny",
+        GoodputCurve((1.0, 0.0, 1e-6), pod_chips=16, dcn_grad_bytes=1e6),
+    )
+    job = Job("j", 0.0, num_chips=4, duration=800.0, model_name="transformer-tiny")
+    res = Simulator(
+        TpuCluster("v5e", dims=(4, 4), num_pods=2),
+        OptimusPolicy(curve_cache=cache, resize_overhead=0.0),
+        [job],
+    ).run()
+    (j,) = res.jobs
+    assert j.state is JobState.DONE
+    assert j.executed_work == pytest.approx(800.0)
+    # grown to 32 chips (~8x the 4-chip reference speed): well under the
+    # ~200 s a 16-chip (one-pod-capped) run would need
+    assert j.end_time < 160.0
+
+
+# --------------------------------------------------------------------- #
+# round-4 verdict #7: the profiling charge is derived from the workload
+
+
+def test_profile_charge_scales_with_ks_and_iters(tmp_path):
+    curve = GoodputCurve((1.0, 0.1, 0.0))
+    few = OptimusPolicy(profile_ks=(1, 2), profile_compile_s=30.0)
+    many = OptimusPolicy(profile_ks=(1, 2, 4, 8), profile_compile_s=30.0)
+    assert many._profile_charge(curve) > few._profile_charge(curve)
+    # per-k composition: compile + (warmup + iters) * step_time(k)
+    expected = sum(30.0 + 12 * curve.step_time(k) for k in (1, 2))
+    assert few._profile_charge(curve) == pytest.approx(expected)
+    # more iters, bigger charge
+    slow = OptimusPolicy(profile_ks=(1, 2), profile_iters=100)
+    assert slow._profile_charge(curve) > few._profile_charge(curve)
+    # the flat override still wins when given (legacy knob)
+    flat = OptimusPolicy(profile_ks=(1, 2, 4, 8), profile_time_cost=120.0)
+    assert flat._profile_charge(curve) == 120.0
+
+
 def test_online_profiling_in_the_loop(tmp_path):
     """BASELINE config #4: the online JAX profiler feeds curves mid-run.
 
