@@ -76,8 +76,8 @@ class TokenFileDataset:
             self.num_batches
         )
         for i in order[start:]:
-            start = int(i) * self.block
-            chunk = np.asarray(self._tokens[start:start + self.block])
+            off = int(i) * self.block  # byte-block offset; never clobber `start`
+            chunk = np.asarray(self._tokens[off:off + self.block])
             yield chunk.astype(np.int32).reshape(self.batch_size, self.seq_len)
 
     @staticmethod

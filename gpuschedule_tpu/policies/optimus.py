@@ -133,18 +133,30 @@ class OptimusPolicy(Policy):
             # profile_model requires ks divisible by the replica unit:
             # profile at replica multiples for parallelism-spec jobs
             ks = tuple(k * unit for k in self.profile_ks) if unit > 1 else self.profile_ks
-            curve = profile_model(
-                job.model_name,
-                ks=ks,
-                batch_size=self.profile_batch,
-                seq_len=self.profile_seq,
-                sp=sp,
-                tp=tp,
-                cache=self.cache,
-            )
-            charge = self._profile_charge(curve, ks=ks)
-            if charge > 0.0:
-                self._profile_charge_pending[key] = charge
+            try:
+                curve = profile_model(
+                    job.model_name,
+                    ks=ks,
+                    batch_size=self.profile_batch,
+                    seq_len=self.profile_seq,
+                    sp=sp,
+                    tp=tp,
+                    cache=self.cache,
+                )
+            except ValueError:
+                # unmeasurable here (e.g. one replica spans more devices
+                # than this host exposes): a degraded curve must not
+                # abort the whole simulation — fall back like the
+                # offline path, with no profiling charge (nothing ran)
+                curve = (
+                    self.cache.get(job.model_name)
+                    if self.cache is not None and job.model_name in self.cache
+                    else DEFAULT_CURVE
+                )
+            else:
+                charge = self._profile_charge(curve, ks=ks)
+                if charge > 0.0:
+                    self._profile_charge_pending[key] = charge
         elif self.cache is not None and job.model_name in self.cache:
             # offline, no measured variant: the bare-model curve beats the
             # featureless default.  (Online runs never take this branch —

@@ -75,29 +75,47 @@ def time_callable(fn, *args, iters: int = 8, warmup: int = 2) -> float:
 
 def _mesh_trainer(
     model_name, devices, batch_size, seq_len, *,
-    sp: int = 1, tp: int = 1, seq_shard: bool = False, warmup: int = 1,
+    sp: int = 1, tp: int = 1, pp: int = 1, seq_shard: bool = False,
+    warmup: int = 1, num_microbatches: int = 4,
 ):
     """Shared setup for measurement and trace capture: a (dp, sp, tp) mesh
     over the devices — dp takes whatever the sp/tp factors leave — with
     batch rounded down to a dp multiple (one fallback formula, so the
-    traced step is exactly the measured step), compile fenced."""
+    traced step is exactly the measured step), compile fenced.
+
+    ``pp >= 2`` builds the staged :class:`PipelinedLM` on a (pp, dp) mesh
+    instead (round-4 verdict #5: pp is a first-class measurement target);
+    sp/tp must stay 1 — the pipeline composes with dp only."""
     import jax
 
-    from gpuschedule_tpu.parallel import ShardedTrainer, make_mesh
+    from gpuschedule_tpu.parallel import PipelinedLM, ShardedTrainer, make_mesh
 
     devs = list(devices) if devices is not None else list(jax.devices())
-    if sp < 1 or tp < 1 or len(devs) % (sp * tp) != 0:
+    if sp < 1 or tp < 1 or pp < 1 or len(devs) % (sp * tp * pp) != 0:
         raise ValueError(
-            f"{len(devs)} devices do not factor as dp x sp={sp} x tp={tp}"
+            f"{len(devs)} devices do not factor as dp x sp={sp} x tp={tp} "
+            f"x pp={pp}"
         )
-    dp = len(devs) // (sp * tp)
-    mesh = make_mesh(dp=dp, sp=sp, tp=tp, devices=devs)
-    bs = batch_size
-    if bs % dp != 0:
-        bs = max(dp, bs - bs % dp)
-    trainer = ShardedTrainer(
-        model_name, mesh, batch_size=bs, seq_len=seq_len, seq_shard=seq_shard
-    )
+    if pp > 1 and (sp > 1 or tp > 1):
+        raise ValueError(f"pp={pp} composes with dp only; got sp={sp}, tp={tp}")
+    dp = len(devs) // (sp * tp * pp)
+    if pp > 1:
+        mesh = make_mesh(dp=dp, pp=pp, devices=devs)
+        # batch must split into M microbatches whose size divides dp
+        bs = max(batch_size - batch_size % (num_microbatches * dp),
+                 num_microbatches * dp)
+        trainer = PipelinedLM(
+            model_name, mesh, batch_size=bs, seq_len=seq_len,
+            num_microbatches=num_microbatches,
+        )
+    else:
+        mesh = make_mesh(dp=dp, sp=sp, tp=tp, devices=devs)
+        bs = batch_size
+        if bs % dp != 0:
+            bs = max(dp, bs - bs % dp)
+        trainer = ShardedTrainer(
+            model_name, mesh, batch_size=bs, seq_len=seq_len, seq_shard=seq_shard
+        )
     state = trainer.init(seed=0)
     batch = trainer.make_batch(seed=0)
     for _ in range(max(1, warmup)):  # first step compiles
@@ -117,17 +135,21 @@ def measure_step_time(
     repeats: int = 1,
     sp: int = 1,
     tp: int = 1,
+    pp: int = 1,
     seq_shard: bool = False,
+    num_microbatches: int = 4,
 ) -> float:
     """Median seconds per optimizer step on a (dp, sp, tp) mesh over
-    ``devices`` (dp is inferred as ``len(devices) / (sp * tp)``; the
+    ``devices`` (dp is inferred as ``len(devices) / (sp * tp * pp)``; the
     round-3 verdict's "profile-able over an arbitrary Mesh" gap).
+    ``pp >= 2`` measures the staged pipeline trainer instead.
 
     ``repeats=1`` keeps live-profiling device time at ``iters`` steps per
     (model, k) point; bench.py uses more blocks for a stabler median."""
     trainer, state, batch = _mesh_trainer(
         model_name, devices, batch_size, seq_len,
-        sp=sp, tp=tp, seq_shard=seq_shard, warmup=warmup,
+        sp=sp, tp=tp, pp=pp, seq_shard=seq_shard, warmup=warmup,
+        num_microbatches=num_microbatches,
     )
     step_s, _ = time_steps(trainer.step, state, batch, iters=iters, repeats=repeats)
     return step_s
@@ -178,27 +200,33 @@ def profile_model(
     cache: Optional[CurveCache] = None,
     sp: int = 1,
     tp: int = 1,
+    pp: int = 1,
 ) -> GoodputCurve:
     """Fit a goodput curve for ``model_name``, measuring what the hardware
     allows and extending analytically.
 
     Every k <= len(devices) is measured on a real (dp, sp, tp) mesh with
     dp = k/(sp*tp) — so tp/sp-sharded configurations are first-class
-    measurement targets, not just dp (the round-3 verdict's harness gap).
+    measurement targets, not just dp (the round-3 verdict's harness gap);
+    ``pp >= 2`` measures the staged pipeline trainer on (pp, dp) meshes
+    (round-4 verdict #5 — a pp curve lands in the cache like any other).
     Larger k are synthesized from the smallest measured unit + the
     analytic ICI allreduce over the slice shape the allocator would grant
     (SURVEY.md §7 "Step-time model fidelity" — the one-chip mitigation);
-    the dp-sync payload per chip shrinks by tp because the params are
-    tp-sharded.  The fitted curve is stored in ``cache`` when given.
+    the dp-sync payload per chip shrinks by tp (params tp-sharded) and by
+    pp (each stage holds 1/pp of the layers).  The fitted curve is stored
+    in ``cache`` when given.
     """
     import jax
 
     devs = list(devices) if devices is not None else list(jax.devices())
     cfg = MODEL_CONFIGS[model_name]
-    unit = sp * tp  # smallest k that forms one model replica
+    if pp > 1 and (sp > 1 or tp > 1):
+        raise ValueError(f"pp={pp} composes with dp only; got sp={sp}, tp={tp}")
+    unit = sp * tp * pp  # smallest k that forms one model replica
     bad = [k for k in ks if k % unit]
     if bad:
-        raise ValueError(f"ks {bad} not divisible by sp*tp={unit}")
+        raise ValueError(f"ks {bad} not divisible by sp*tp*pp={unit}")
 
     # an sp axis only means something when the sequence is sharded over
     # it — without seq_shard the "sp mesh" would silently measure a
@@ -214,6 +242,7 @@ def profile_model(
                 seq_len=seq_len,
                 sp=sp,
                 tp=tp,
+                pp=pp,
                 seq_shard=seq_shard,
             )
     synth_ks = [k for k in ks if k not in measured]
@@ -224,18 +253,20 @@ def profile_model(
         # unrequested point into the fit)
         if unit > len(devs):
             raise ValueError(
-                f"sp*tp={unit} exceeds the {len(devs)} available devices; "
+                f"sp*tp*pp={unit} exceeds the {len(devs)} available devices; "
                 "nothing is measurable"
             )
         measured[unit] = measure_step_time(
             model_name, devices=devs[:unit], batch_size=batch_size,
-            seq_len=seq_len, sp=sp, tp=tp, seq_shard=seq_shard,
+            seq_len=seq_len, sp=sp, tp=tp, pp=pp, seq_shard=seq_shard,
         )
     points = dict(measured)
+    # per-chip dp-grad payload: tp shards the params, pp splits the layers
+    per_chip_params = cfg.param_count // (tp * pp)
     if synth_ks:
         synth = synthesize_step_times(
             single_chip_step_s=measured[unit],
-            param_count=cfg.param_count // tp,  # per-chip dp-grad payload
+            param_count=per_chip_params,
             generation=generation,
             ks=synth_ks,
             unit=unit,
@@ -260,7 +291,7 @@ def profile_model(
         curve = GoodputCurve(
             curve.theta,
             pod_chips=pod,
-            dcn_grad_bytes=_dp_bytes(cfg.param_count // tp),
+            dcn_grad_bytes=_dp_bytes(per_chip_params),
         )
     else:
         # every requested k lies beyond one pod: the synthesized points
@@ -272,15 +303,20 @@ def profile_model(
             sorted(points), [points[k] for k in sorted(points)]
         )
     if cache is not None:
-        # sp/tp variants get their own cache key: the scheduler's replay
+        # sp/tp/pp variants get their own cache key: the scheduler's replay
         # looks curves up by bare model name, and a dp curve silently
-        # replaced by an sp/tp one would feed it the wrong step times
-        key = model_name if sp == 1 and tp == 1 else f"{model_name}@sp{sp}tp{tp}"
+        # replaced by a parallelism variant would feed it wrong step times
+        if sp == 1 and tp == 1 and pp == 1:
+            key = model_name
+        elif pp == 1:
+            key = f"{model_name}@sp{sp}tp{tp}"
+        else:
+            key = f"{model_name}@sp{sp}tp{tp}pp{pp}"
         cache.put(
             key,
             curve,
             source=(
-                f"measured<= {len(devs)} chips (sp={sp}, tp={tp}), "
+                f"measured<= {len(devs)} chips (sp={sp}, tp={tp}, pp={pp}), "
                 f"analytic beyond ({generation})"
             ),
             points=points,

@@ -20,6 +20,15 @@ back through the hosts that feed the slice —
 
 Pure Python over :data:`~gpuschedule_tpu.models.config.MODEL_CONFIGS` and
 the generation table — no jax import (sim-core rule).
+
+**Measured vs modeled** (round-5; tests/test_elastic_loop.py): an
+engine-driven Optimus shrink executing the REAL orbax save+restore of a
+transformer-tiny ShardedTrainer (8 -> 4 devices, ~17 MB of train state,
+CPU mesh + local disk) measures ~0.3-3 s of mechanism time against
+``migrate_seconds('transformer-tiny', 4)`` ~= 5.0 s — the same order of
+magnitude, with the modeled figure dominated by the ``base_s`` floor
+standing in for process-restart/compile costs the in-process measurement
+does not pay.  The test pins the agreement to within two orders.
 """
 
 from __future__ import annotations

@@ -310,6 +310,71 @@ def test_train_resume_with_schedule_flags(tmp_path, capsys):
     assert s2["resumed_at_step"] == 2
 
 
+def test_train_subcommand_pipeline(tmp_path, capsys):
+    """`cli train --pp 2`: the staged PipelinedLM reachable from the
+    command line (round-4 verdict #5), with the remat memory schedule
+    selectable and invalid compositions rejected."""
+    pytest.importorskip("jax", reason="train needs the [profiler] extra")
+    rc, out = run_cli(
+        capsys,
+        "train", "--model", "transformer-tiny", "--steps", "2",
+        "--batch-size", "8", "--seq-len", "32", "--devices", "4",
+        "--pp", "2", "--microbatches", "2", "--pp-schedule", "remat",
+        "--ckpt", str(tmp_path / "ck"),
+    )
+    assert rc == 0
+    s = json.loads(out[-1])
+    assert s["mesh"] == {"dp": 2, "pp": 2, "sp": 1, "tp": 1}
+    assert s["last_loss"] == s["last_loss"]  # finite
+    assert (tmp_path / "ck").exists()
+
+    with pytest.raises(SystemExit, match="dp only"):
+        run_cli(
+            capsys,
+            "train", "--model", "transformer-tiny", "--steps", "1",
+            "--batch-size", "8", "--seq-len", "32", "--devices", "4",
+            "--pp", "2", "--tp", "2",
+        )
+
+
+def test_train_restore_warns_on_datastream_drift(tmp_path, capsys):
+    """A checkpoint saved with one (seed, shape, data) identity must warn
+    when resumed under another — count-based resume would silently
+    replay or skip data (round-4 ADVICE #3)."""
+    pytest.importorskip("jax")
+    rc, _ = run_cli(
+        capsys,
+        "train", "--model", "transformer-tiny", "--steps", "2",
+        "--batch-size", "4", "--seq-len", "32", "--devices", "2",
+        "--seed", "7", "--ckpt", str(tmp_path / "ck"),
+    )
+    assert rc == 0
+    assert (tmp_path / "ck.datastream.json").exists()
+
+    def run_with_err(*argv):
+        rc = main(list(argv))
+        captured = capsys.readouterr()
+        return rc, captured.err
+
+    # same stream -> no warning
+    rc2, err = run_with_err(
+        "train", "--model", "transformer-tiny", "--steps", "1",
+        "--batch-size", "4", "--seq-len", "32", "--devices", "2",
+        "--seed", "7", "--restore", str(tmp_path / "ck"),
+    )
+    assert rc2 == 0
+    assert "data stream differs" not in err
+
+    # different seed -> loud warning, run continues
+    rc3, err = run_with_err(
+        "train", "--model", "transformer-tiny", "--steps", "1",
+        "--batch-size", "4", "--seq-len", "32", "--devices", "2",
+        "--seed", "8", "--restore", str(tmp_path / "ck"),
+    )
+    assert rc3 == 0
+    assert "data stream differs" in err and "seed" in err
+
+
 def test_run_events_flag_writes_jsonl(tmp_path, capsys):
     """--events: the CLI wires the opt-in structured event log through to
     the engine (library behavior pinned in test_events.py)."""

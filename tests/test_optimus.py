@@ -251,6 +251,31 @@ def test_multislice_growth_runs_end_to_end(tmp_path):
     assert j.end_time < 160.0
 
 
+def test_online_profiling_unmeasurable_spec_degrades_not_crashes(monkeypatch):
+    """A parallelism-spec job whose replica spans more devices than the
+    host exposes must degrade to the fallback curve, not abort the whole
+    simulation (profile_model raises ValueError in that case and the
+    engine calls schedule() unguarded)."""
+    import gpuschedule_tpu.profiler.harness as harness
+
+    def boom(model_name, **kw):
+        raise ValueError("sp*tp*pp=4 exceeds the 1 available devices")
+
+    monkeypatch.setattr(harness, "profile_model", boom)
+    pol = OptimusPolicy(online=True)
+    job = Job("j", 0.0, num_chips=4, duration=100.0,
+              model_name="transformer-tiny", sp=2, tp=2)
+    curve = pol._job_curve(job)  # must not raise
+    assert curve.step_time(1) > 0
+    assert not pol._profile_charge_pending  # nothing ran, nothing charged
+    # and a full run completes
+    res = Simulator(SimpleCluster(8), pol, [Job(
+        "k", 0.0, num_chips=4, duration=50.0,
+        model_name="transformer-tiny", sp=2, tp=2,
+    )]).run()
+    assert res.num_finished == 1
+
+
 # --------------------------------------------------------------------- #
 # round-4 verdict #7: the profiling charge is derived from the workload
 

@@ -269,3 +269,102 @@ def test_pipeline_validates_stage_count():
     mesh = make_mesh(pp=2, dp=1, devices=jax.devices()[:2])
     with pytest.raises(ValueError, match="stages"):
         pipeline_apply(apply, stack_stage_params(params), x, mesh=mesh)
+
+
+def test_pipeline_rejects_sp_tp_meshes():
+    """The aux reduction is defined over (pp, dp) only; an sp/tp axis of
+    extent > 1 must be rejected, not silently mis-reduced (round-4 ADVICE:
+    check_vma=False skips the replication proof on those axes)."""
+    apply, params, x = _mlp_stages(2)
+    mesh = make_mesh(pp=2, dp=1, tp=2, devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="extra axes"):
+        pipeline_apply(apply, stack_stage_params(params), x, mesh=mesh)
+
+
+# --------------------------------------------------------------------- #
+# round-4 verdict #5/#6: the remat schedule and the bubble fraction
+
+
+def test_remat_schedule_matches_gpipe_exactly():
+    """schedule='remat' recomputes stage internals in the backward sweep;
+    values and gradients are the same math — f32 MLP stages agree to
+    numerical-noise tolerance in BOTH value and grad."""
+    pp = 2
+    apply, params, x = _mlp_stages(pp, m=4)
+    mesh = make_mesh(pp=pp, dp=1, devices=jax.devices()[:pp])
+    stacked = stack_stage_params(params)
+
+    def loss(schedule):
+        def f(stacked, x):
+            return (
+                pipeline_apply(
+                    apply, stacked, x, mesh=mesh, schedule=schedule
+                ) ** 2
+            ).sum()
+        return jax.value_and_grad(f)(stacked, x)
+
+    vg, gg = loss("gpipe")
+    vr, gr = loss("remat")
+    np.testing.assert_allclose(float(vg), float(vr), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(gg), jax.tree_util.tree_leaves(gr)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-6
+        )
+    with pytest.raises(ValueError, match="schedule"):
+        pipeline_apply(apply, stacked, x, mesh=mesh, schedule="1f1b")
+
+
+def test_pipelined_lm_remat_schedule_trains_same():
+    """End-to-end: the staged LM under schedule='remat' starts from the
+    same loss and trains like the gpipe default."""
+    from gpuschedule_tpu.parallel.pipeline import PipelinedLM
+
+    mesh = make_mesh(pp=2, dp=1, devices=jax.devices()[:2])
+    kwargs = dict(batch_size=4, seq_len=32, num_microbatches=2)
+    gp = PipelinedLM("transformer-tiny", mesh, **kwargs)
+    rm = PipelinedLM("transformer-tiny", mesh, schedule="remat", **kwargs)
+    tokens = gp.make_batch(seed=0)
+    g_state, r_state = gp.init(seed=0), rm.init(seed=0)
+    assert float(gp._loss_fn(g_state[0], tokens)) == pytest.approx(
+        float(rm._loss_fn(r_state[0], tokens)), rel=1e-6
+    )
+    for _ in range(2):
+        g_state, g_loss = gp.step(g_state, tokens)
+        r_state, r_loss = rm.step(r_state, tokens)
+    # same math, same trajectory (bf16 compute reorders tolerated)
+    assert float(g_loss) == pytest.approx(float(r_loss), rel=1e-3)
+
+
+def test_remat_schedule_cuts_saved_residual_memory():
+    """The memory proxy for the GPipe tradeoff fix: with schedule='remat'
+    the compiled backward holds ~one microbatch of stage internals
+    instead of all M — the peak temp allocation of the compiled
+    value_and_grad must drop, and the gpipe/remat gap must WIDEN as M
+    grows (the gpipe side scales with M, the remat side holds steady)."""
+    pp, mb, d = 2, 2, D
+
+    def temp_bytes(schedule, m):
+        apply, params, x = _mlp_stages(pp, m=m, mb=mb)
+        mesh = make_mesh(pp=pp, dp=1, devices=jax.devices()[:pp])
+        stacked = stack_stage_params(params)
+
+        def f(stacked, x):
+            return (
+                pipeline_apply(
+                    apply, stacked, x, mesh=mesh, schedule=schedule
+                ) ** 2
+            ).sum()
+
+        compiled = jax.jit(jax.value_and_grad(f)).lower(stacked, x).compile()
+        ma = compiled.memory_analysis()
+        if ma is None or not getattr(ma, "temp_size_in_bytes", 0):
+            pytest.skip("backend exposes no memory analysis")
+        return ma.temp_size_in_bytes
+
+    m_small, m_big = 4, 16
+    g_small, g_big = temp_bytes("gpipe", m_small), temp_bytes("gpipe", m_big)
+    r_small, r_big = temp_bytes("remat", m_small), temp_bytes("remat", m_big)
+    assert r_big < g_big  # remat strictly cheaper at large M
+    # gpipe grows ~linearly in M; remat's growth is the boundary
+    # activations only — the gap must widen with M
+    assert (g_big - r_big) > (g_small - r_small)

@@ -259,6 +259,66 @@ def test_profile_model_sp_mesh(tmp_path):
     assert "sp=2" in cache._meta["transformer-tiny@sp2tp1"]["source"]
 
 
+def test_profile_model_pp_mesh(tmp_path):
+    """A pp>=2 configuration is measurable and fittable end-to-end: the
+    harness builds the staged PipelinedLM on a (pp, dp) mesh and a pp
+    curve lands in the cache under its own variant key (round-4 verdict
+    #5: pipeline parallelism reaches the profiling surface)."""
+    pytest.importorskip("jax")
+    from gpuschedule_tpu.profiler.harness import profile_model
+
+    cache = CurveCache(tmp_path / "curves.json")
+    curve = profile_model(
+        "transformer-tiny",
+        ks=(2, 4, 64),              # 2, 4 measured as pp=2 x dp; 64 analytic
+        batch_size=8,
+        seq_len=32,
+        pp=2,
+        cache=cache,
+    )
+    assert curve.step_time(2) > 0
+    meta = cache._meta["transformer-tiny@sp1tp1pp2"]
+    assert "transformer-tiny" not in cache._meta
+    assert "pp=2" in meta["source"]
+    assert {"2", "4"} <= set(meta["points"])
+    # pp composes with dp only
+    with pytest.raises(ValueError, match="dp only"):
+        profile_model(
+            "transformer-tiny", ks=(4,), pp=2, tp=2, batch_size=4, seq_len=32
+        )
+
+
+@pytest.mark.slow
+def test_pipeline_bubble_fraction_trends_with_microbatches():
+    """The measured pipeline step time must follow the GPipe bubble law:
+    with S stages and M microbatches over a fixed batch, per-step work is
+    proportional to 1 + (S-1)/M, so fewer microbatches = a bigger bubble
+    = a slower step.  S=2: predicted t(1):t(2):t(4) = 2 : 1.5 : 1.25.
+    The assertion takes the direction and a loose magnitude, not the
+    exact ratios — and stops at M=4: beyond it the per-tick dispatch
+    overhead of the virtual CPU mesh (9 ticks of microbatch-2 work at
+    M=8) outweighs the shrinking bubble, which is a CPU-harness artifact,
+    not pipeline physics."""
+    jax = pytest.importorskip("jax")
+    from gpuschedule_tpu.profiler.harness import measure_step_time
+
+    devs = jax.devices()[:2]
+
+    def t(m):
+        return measure_step_time(
+            "transformer-tiny", devices=devs, batch_size=16, seq_len=64,
+            pp=2, num_microbatches=m, iters=5, repeats=3,
+        )
+
+    t1, t2, t4 = t(1), t(2), t(4)
+    # bubble fractions: M=1 -> 1/2, M=2 -> 1/3, M=4 -> 1/5: strictly
+    # shrinking, so measured step time must strictly improve
+    assert t1 > t2 > t4, (t1, t2, t4)
+    # magnitude: the M=1 -> M=4 improvement is predicted 1.6x; accept
+    # anything clearly beyond noise and below absurd
+    assert 1.15 < t1 / t4 < 3.0, (t1, t4)
+
+
 def test_capture_trace_writes_xprof_files(tmp_path):
     pytest.importorskip("jax")
     from gpuschedule_tpu.profiler.harness import capture_trace
