@@ -23,10 +23,28 @@ class ModelConfig:
     d_ff: int = 512
     max_seq: int = 512
     remat: bool = False
-    # n_experts > 0 turns each block's MLP into a top-1-routed MoE
+    # n_experts > 0 turns each block's MLP into a routed MoE
     # (models/transformer.py MoeMlp, experts sharded over the tp axis)
     n_experts: int = 0
     capacity_factor: float = 1.25
+    # experts each token routes to (1 = Switch, 2 = GShard-style top-2)
+    router_top_k: int = 1
+    # router z-loss coefficient RELATIVE to the trainer's moe_aux_weight
+    # (it rides the same sown channel as the load-balancing aux): the
+    # effective loss term is moe_aux_weight * router_z_weight * z, with
+    # z = mean(logsumexp(router_logits)^2).  0 disables.
+    router_z_weight: float = 0.0
+
+    def __post_init__(self):
+        # active_param_count subtracts (n_experts - router_top_k) FFN
+        # copies; an out-of-range k would silently skew every FLOPs/MFU/
+        # goodput figure while MoeMlp clamps or raises — fail here so the
+        # two can never disagree
+        if self.n_experts and not (1 <= self.router_top_k <= self.n_experts):
+            raise ValueError(
+                f"router_top_k={self.router_top_k} must be in "
+                f"[1, n_experts={self.n_experts}]"
+            )
 
     @property
     def param_count(self) -> int:
@@ -40,19 +58,20 @@ class ModelConfig:
 
     @property
     def active_param_count(self) -> int:
-        """Params a single token actually exercises: for top-1 MoE that is
-        ONE expert FFN per block (plus the router), not all n_experts —
+        """Params a single token actually exercises: for top-k MoE that is
+        k expert FFNs per block (plus the router), not all n_experts —
         the count FLOPs and goodput estimates must use.  Derived from
         ``param_count`` (single source of the arithmetic): the inactive
-        mass is exactly the n_experts-1 unused FFN copies per block."""
+        mass is exactly the n_experts-k unused FFN copies per block."""
         if not self.n_experts:
             return self.param_count
         ffn = 2 * self.d_model * self.d_ff
-        return self.param_count - self.n_layers * (self.n_experts - 1) * ffn
+        inactive = max(0, self.n_experts - self.router_top_k)
+        return self.param_count - self.n_layers * inactive * ffn
 
     def flops_per_token(self) -> float:
         """~6N FLOPs/token for fwd+bwd, N = ACTIVE params (equals total
-        params for dense configs; one expert per token for MoE — the
+        params for dense configs; router_top_k experts per token for MoE — the
         standard estimate the MFU arithmetic in bench.py uses)."""
         return 6.0 * self.active_param_count
 
@@ -164,6 +183,18 @@ MODEL_CONFIGS: Dict[str, "ModelConfig | CnnConfig"] = {
         ModelConfig(
             "moe-tiny", d_model=128, n_layers=2, n_heads=4, d_ff=256,
             n_experts=4,
+        ),
+        # top-2 (GShard-style) variants: two experts per token with
+        # renormalized gates + router z-loss for logit stability
+        ModelConfig(
+            "transformer-moe-top2",
+            d_model=256, n_layers=4, n_heads=8, d_ff=1024, n_experts=8,
+            router_top_k=2, router_z_weight=0.1, capacity_factor=2.0,
+        ),
+        ModelConfig(
+            "moe-top2-tiny", d_model=128, n_layers=2, n_heads=4, d_ff=256,
+            n_experts=4, router_top_k=2, router_z_weight=0.1,
+            capacity_factor=2.0,
         ),
     )
 }

@@ -62,7 +62,7 @@ class ProjectedAttention(nn.Module):
 
 
 class MoeMlp(nn.Module):
-    """Top-1 (Switch) routed mixture-of-experts FFN, GShard-style.
+    """Top-k routed mixture-of-experts FFN (k=1: Switch; k=2: GShard).
 
     TPU-native by construction: routing is expressed as dense one-hot
     **dispatch/combine einsums** over an (experts, capacity, d) buffer —
@@ -74,10 +74,22 @@ class MoeMlp(nn.Module):
     dispatch einsum's sharding mismatch into the all-to-all the GShard
     paper inserts by hand.
 
-    Tokens route to their argmax expert, f32 router math for stable
-    training; each expert processes at most ``capacity_factor * T / E``
-    tokens and overflow tokens are dropped (their block output is 0, so
-    the residual stream carries them through — standard Switch behavior).
+    Tokens route to their top ``cfg.router_top_k`` experts (f32 router
+    math for stable training); for k > 1 the kept gates renormalize to
+    sum to one.  Each expert processes at most ``capacity_factor * T / E``
+    tokens; overflow choices are dropped (that choice's contribution is
+    0, so the residual stream carries the token through — standard
+    Switch/GShard behavior).  Later choices queue behind earlier ones:
+    a token's second expert slot is assigned after every token's first
+    choice, GShard's sequential-capacity rule.
+
+    Sown losses (one ``moe_losses`` channel, consumed by both trainers at
+    ``moe_aux_weight``): the load-balancing aux ``E * sum_e f_e * P_e``
+    (f_e = fraction of routed choices to e, P_e = mean router prob;
+    minimized at uniform routing) plus, when ``cfg.router_z_weight > 0``,
+    the router z-loss ``mean(logsumexp(logits)^2)`` scaled by that
+    coefficient — it keeps router logits from drifting large, where bf16
+    softmax saturates and routing gradients vanish.
     """
 
     cfg: ModelConfig
@@ -87,41 +99,55 @@ class MoeMlp(nn.Module):
         c = self.cfg
         b, s, d = x.shape
         e = c.n_experts
+        k = max(1, int(c.router_top_k))
+        if k > e:
+            raise ValueError(f"router_top_k={k} exceeds n_experts={e}")
         t = b * s
         cap = max(1, int(c.capacity_factor * t / e))
 
         logits = nn.Dense(
             e, dtype=jnp.float32, param_dtype=jnp.float32, name="router"
         )(x.astype(jnp.float32))
-        probs = jax.nn.softmax(logits, axis=-1).reshape(t, e)
-        gate = jnp.max(probs, axis=-1)                      # (T,)
-        choice = jnp.argmax(probs, axis=-1)                 # (T,)
-        onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)
-        # Switch load-balancing auxiliary loss: E * sum_e f_e * P_e, where
-        # f_e = fraction of tokens routed to e, P_e = mean router prob.
-        # Minimized (= 1) at uniform routing; without it top-1 routing
-        # collapses onto a few experts and overflow tokens stop getting
-        # FFN compute.  Sown; the trainer adds it at moe_aux_weight.
-        frac = jnp.mean(onehot, axis=0)
+        logits = logits.reshape(t, e)
+        probs = jax.nn.softmax(logits, axis=-1)
+        top_probs, top_idx = jax.lax.top_k(probs, k)        # (T, k)
+        if k > 1:
+            gates = top_probs / jnp.sum(top_probs, axis=-1, keepdims=True)
+        else:
+            gates = top_probs  # Switch keeps the raw argmax prob
+        onehots = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # (T, k, E)
+        # load-balancing aux over ALL routed choices (k=1 reduces to the
+        # Switch formula): minimized (= 1) at uniform routing
+        frac = jnp.mean(jnp.sum(onehots, axis=1), axis=0)  # (E,) choices/e / T
         mean_prob = jnp.mean(probs, axis=0)
-        self.sow("moe_losses", "aux", e * jnp.sum(frac * mean_prob))
-        # position of each token inside its expert's buffer, in token
-        # order: the chosen column holds count-1 (>= 0), all others -1,
-        # so the row max extracts it (a row SUM would add the -1s)
-        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0     # (T, E)
-        pos_tok = jnp.max(pos, axis=-1)                     # (T,) position, >= 0
-        keep = (pos_tok >= 0) & (pos_tok < cap)
-        pos_clip = jnp.clip(pos_tok, 0, cap - 1).astype(jnp.int32)
-        # dispatch: (T, E, C) one-hot of (expert, slot), zero for dropped
-        dispatch = (
-            onehot[:, :, None]
-            * jax.nn.one_hot(pos_clip, cap, dtype=jnp.float32)[:, None, :]
-            * keep[:, None, None]
-        )
+        aux = e / k * jnp.sum(frac * mean_prob)
+        if c.router_z_weight > 0.0:
+            z = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+            aux = aux + c.router_z_weight * z
+        self.sow("moe_losses", "aux", aux)
+        # slot assignment, choice-major (GShard): all first choices claim
+        # capacity before any second choice.  Within one choice rank j,
+        # the chosen column of cumsum holds count-1 (>= 0), others -1,
+        # so the row max extracts it (a row SUM would add the -1s).
+        base = jnp.zeros((e,), jnp.float32)   # slots already claimed per expert
+        dispatch = jnp.zeros((t, e, cap), jnp.bfloat16)
+        combine = jnp.zeros((t, e, cap), jnp.bfloat16)
+        for j in range(k):                    # static unroll, k is tiny
+            oh = onehots[:, j, :]                            # (T, E)
+            pos = (jnp.cumsum(oh, axis=0) + base[None, :]) * oh - 1.0
+            pos_tok = jnp.max(pos, axis=-1)                  # (T,) >= 0 if chosen
+            keep = (pos_tok >= 0) & (pos_tok < cap)
+            pos_clip = jnp.clip(pos_tok, 0, cap - 1).astype(jnp.int32)
+            dj = (
+                oh[:, :, None]
+                * jax.nn.one_hot(pos_clip, cap, dtype=jnp.float32)[:, None, :]
+                * keep[:, None, None]
+            )
+            dispatch = dispatch + dj.astype(jnp.bfloat16)
+            combine = combine + (dj * gates[:, j, None, None]).astype(jnp.bfloat16)
+            base = base + jnp.sum(oh, axis=0)
         xf = x.reshape(t, d)
-        expert_in = jnp.einsum(
-            "td,tec->ecd", xf.astype(jnp.bfloat16), dispatch.astype(jnp.bfloat16)
-        )
+        expert_in = jnp.einsum("td,tec->ecd", xf.astype(jnp.bfloat16), dispatch)
 
         kin = nn.initializers.lecun_normal()
         w_up = self.param("w_up", kin, (e, d, c.d_ff), jnp.float32)
@@ -137,15 +163,15 @@ class MoeMlp(nn.Module):
             jnp.einsum("ecf,efd->ecd", h, w_down.astype(jnp.bfloat16))
             + b_down[:, None, :].astype(jnp.bfloat16)
         )
-        # combine: gather each token's slot back, weighted by its gate prob
-        combine = dispatch * gate[:, None, None]
-        y = jnp.einsum("ecd,tec->td", out, combine.astype(jnp.bfloat16))
+        # combine: gather each token's k slots back, gate-weighted
+        y = jnp.einsum("ecd,tec->td", out, combine)
         return y.reshape(b, s, d)
 
 
 class Block(nn.Module):
     """Pre-LN causal self-attention + MLP block, bf16 compute.  The MLP is
-    a dense FFN, or a top-1 MoE when the config sets ``n_experts``."""
+    a dense FFN, or a top-``router_top_k`` MoE when the config sets
+    ``n_experts``."""
 
     cfg: ModelConfig
     attn_fn: Any = None  # None -> dense SelfAttention; else (q,k,v)->out core

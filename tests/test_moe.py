@@ -123,6 +123,142 @@ def test_aux_loss_sown_and_charged():
     assert float(loss_on) > float(loss_off)
 
 
+def test_top2_routing_matches_manual_two_expert_apply():
+    """Round-4 verdict #8 (widen): with router_top_k=2 each surviving
+    token's output is g1*FFN_e1(x) + g2*FFN_e2(x) with gates renormalized
+    over the kept pair — checked against a direct per-token loop at
+    overflow-free capacity."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        MODEL_CONFIGS["moe-top2-tiny"], capacity_factor=8.0
+    )
+    assert cfg.router_top_k == 2
+    layer = MoeMlp(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, cfg.d_model))
+    params = layer.init(jax.random.PRNGKey(1), x)
+    y = layer.apply(params, x)
+
+    p = params["params"]
+    logits = x.astype(jnp.float32) @ p["router"]["kernel"] + p["router"]["bias"]
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    w_up, b_up = np.asarray(p["w_up"]), np.asarray(p["b_up"])
+    w_dn, b_dn = np.asarray(p["w_down"]), np.asarray(p["b_down"])
+    xb = np.asarray(x.astype(jnp.bfloat16).astype(jnp.float32))
+
+    def ffn(e, v):
+        h = v @ w_up[e] + b_up[e]
+        h = np.asarray(jax.nn.gelu(jnp.asarray(h, jnp.bfloat16)), np.float32)
+        return h @ w_dn[e] + b_dn[e]
+
+    for bi in range(2):
+        for si in range(8):
+            pr = probs[bi, si]
+            e1, e2 = np.argsort(pr)[::-1][:2]
+            g = pr[[e1, e2]] / pr[[e1, e2]].sum()  # renormalized pair
+            ref = g[0] * ffn(int(e1), xb[bi, si]) + g[1] * ffn(int(e2), xb[bi, si])
+            np.testing.assert_allclose(
+                np.asarray(y[bi, si], np.float32), ref.astype(np.float32),
+                atol=0.15, rtol=0.15,  # bf16 einsum path vs f32 loop
+            )
+
+
+def test_top2_capacity_queues_second_choices_behind_first():
+    """GShard's sequential-capacity rule under pressure: at
+    capacity_factor=1.0 most second choices (and unbalanced firsts)
+    overflow and drop — the output must stay finite and must genuinely
+    differ from the overflow-free run on identical params (proof the
+    capacity path engaged rather than silently over-allocating)."""
+    import dataclasses
+
+    tight = dataclasses.replace(
+        MODEL_CONFIGS["moe-top2-tiny"], capacity_factor=1.0
+    )
+    roomy = dataclasses.replace(
+        MODEL_CONFIGS["moe-top2-tiny"], capacity_factor=8.0
+    )
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, tight.d_model))
+    params = MoeMlp(roomy).init(jax.random.PRNGKey(1), x)
+    y_tight = np.asarray(MoeMlp(tight).apply(params, x), np.float32)
+    y_roomy = np.asarray(MoeMlp(roomy).apply(params, x), np.float32)
+    assert np.isfinite(y_tight).all()
+    # drops happened: some token's contribution shrank vs the roomy run
+    assert np.max(np.abs(y_tight - y_roomy)) > 1e-3
+
+
+def test_router_top_k_validated_at_config_construction():
+    import dataclasses
+
+    with pytest.raises(ValueError, match="router_top_k"):
+        dataclasses.replace(MODEL_CONFIGS["moe-tiny"], router_top_k=0)
+    with pytest.raises(ValueError, match="router_top_k"):
+        dataclasses.replace(MODEL_CONFIGS["moe-tiny"], router_top_k=5)
+    # dense configs ignore the knob entirely
+    dataclasses.replace(MODEL_CONFIGS["transformer-tiny"], router_top_k=0)
+
+
+def test_top2_active_params_and_flops_count_two_experts():
+    top1 = MODEL_CONFIGS["transformer-moe"]
+    top2 = MODEL_CONFIGS["transformer-moe-top2"]
+    assert top2.param_count == pytest.approx(top1.param_count)  # same weights
+    ffn = 2 * top2.d_model * top2.d_ff
+    assert top2.active_param_count - top1.active_param_count == (
+        top2.n_layers * ffn
+    )  # one extra active expert per block
+    assert top2.flops_per_token() > top1.flops_per_token()
+
+
+def test_router_z_loss_charged_when_configured():
+    """router_z_weight > 0 adds mean(logsumexp(logits)^2) * weight to the
+    sown channel: the top-2 config's sown aux exceeds the pure
+    load-balancing term, and zeroing the weight removes the difference."""
+    import dataclasses
+
+    model, cfg = build_model("moe-top2-tiny")
+    assert cfg.router_z_weight > 0
+    tokens = jnp.zeros((2, 16), dtype=jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    _, mods = model.apply(
+        {"params": variables["params"]}, tokens, mutable=["moe_losses"]
+    )
+    with_z = sum(
+        float(jnp.asarray(a, jnp.float32).mean())
+        for a in jax.tree_util.tree_leaves(mods["moe_losses"])
+    )
+
+    from gpuschedule_tpu.models.transformer import TransformerLM
+
+    cfg_noz = dataclasses.replace(cfg, router_z_weight=0.0)
+    model_noz = TransformerLM(cfg_noz)
+    _, mods_noz = model_noz.apply(
+        {"params": variables["params"]}, tokens, mutable=["moe_losses"]
+    )
+    no_z = sum(
+        float(jnp.asarray(a, jnp.float32).mean())
+        for a in jax.tree_util.tree_leaves(mods_noz["moe_losses"])
+    )
+    assert with_z > no_z  # z-loss is a positive, live term
+    # and the balancing part alone still sits at its uniform floor
+    assert no_z >= cfg.n_layers * (1.0 - 1e-3)
+
+
+def test_top2_trains_with_expert_sharding():
+    """End-to-end on a dp x tp mesh: the top-2 config trains (finite,
+    decreasing loss) with the expert dim sharded over tp."""
+    mesh = make_mesh(dp=2, sp=1, tp=2, devices=jax.devices()[:4])
+    tr = ShardedTrainer("moe-top2-tiny", mesh, batch_size=4, seq_len=32)
+    state = tr.init(seed=0)
+    spec = state[0]["params"]["block0"]["moe"]["w_up"].sharding.spec
+    assert spec[0] == "tp"
+    batch = tr.make_batch(seed=0)
+    losses = []
+    for _ in range(3):
+        state, loss = tr.step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert all(l == l for l in losses)
+
+
 def test_build_model_moe_path():
     model, cfg = build_model("transformer-moe")
     assert cfg.n_experts == 8
