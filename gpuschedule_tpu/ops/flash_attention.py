@@ -372,6 +372,14 @@ def _flash_fwd_impl(q, k, v, *, causal, block_q, block_k, interpret,
             pltpu.VMEM((block_q, LANES), jnp.float32),   # running denom
             pltpu.VMEM((block_q, d_pad), jnp.float32),   # running numerator
         ],
+        # bh and q-block iterations are independent (state is carried only
+        # across kb): declaring them parallel lets Mosaic overlap grid
+        # steps instead of serializing on an assumed loop dependency —
+        # the per-step overhead, not HBM, bounds this kernel at these
+        # block counts (ROOFLINE.md)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret if interpret is not None else _pick_interpret(),
     )(qp, kp, vp)
     return _unprep(out, b, s, h, d), lse
@@ -425,6 +433,9 @@ def _flash_bwd_impl(q, k, v, out, lse, g, *, causal, block_q, block_k, interpret
         out_specs=pl.BlockSpec((1, block_q, d_pad), lambda i, j, kb: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s_pad, d_pad), out_dtype or q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d_pad), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interp,
     )(qp, kp, vp, gp, lse, delta)
 
@@ -452,6 +463,10 @@ def _flash_bwd_impl(q, k, v, out, lse, g, *, causal, block_q, block_k, interpret
             pltpu.VMEM((block_k, d_pad), jnp.float32),
             pltpu.VMEM((block_k, d_pad), jnp.float32),
         ],
+        # state carried across qi only: bh and k-block dims are parallel
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interp,
     )(qp, kp, vp, gp, lse, delta)
 

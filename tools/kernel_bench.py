@@ -70,7 +70,7 @@ def run(b, s, h, d, dtype):
 
     for bq, bk in (
         (128, 128), (256, 256), (128, 512), (512, 128), (256, 512),
-        (128, 1024),
+        (128, 1024), (512, 512), (512, 1024),
     ):
         f = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash_attention(
             q, k, v, causal=True, block_q=bq, block_k=bk))

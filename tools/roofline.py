@@ -1,0 +1,171 @@
+"""Analytic roofline for the flash-attention kernels (round-4 verdict #2).
+
+Computes, for a given shape and block pair, exactly what the three pallas
+kernels execute — visited causal tiles, matmul FLOPs (including the
+recomputed s/dp tiles), and HBM traffic under the DMA-clamp fetch rules —
+and turns them into per-kernel compute/memory time bounds on v5e.  A
+grid-overhead term (seconds per grid step) can be fit from one measured
+point to attribute the gap between the roofline and reality.
+
+Device-free: pure arithmetic over the kernels' documented fetch/skip
+rules (ops/flash_attention.py), usable without the chip.  Run as a
+script to print the analysis for the KERNEL_BENCH shapes:
+
+    python tools/roofline.py            # analytic only
+    python tools/roofline.py --fit MS   # + per-step overhead fit from a
+                                        # measured fwd+bwd milliseconds
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gpuschedule_tpu.cluster.tpu import GENERATIONS  # noqa: E402
+from gpuschedule_tpu.ops.flash_attention import (  # noqa: E402
+    LANES,
+    _effective_blocks,
+)
+
+BF16 = 2
+F32 = 4
+
+
+def visited_tiles(s_pad: int, bq: int, bk: int, causal: bool) -> int:
+    """Tiles the kernels actually compute (the pl.when skip rule)."""
+    nq, nk = s_pad // bq, s_pad // bk
+    if not causal:
+        return nq * nk
+    return sum(
+        sum(1 for kb in range(nk) if kb * bk <= qi * bq + bq - 1)
+        for qi in range(nq)
+    )
+
+
+def analyze(
+    b: int, s: int, h: int, d: int,
+    *, block_q: int = 256, block_k: int = 512, causal: bool = True,
+    generation: str = "v5e",
+) -> dict:
+    spec = GENERATIONS[generation]
+    peak = spec["bf16_tflops"] * 1e12
+    bw = spec["hbm_gbps"] / 8 * 1e9  # bytes/s
+
+    bq, bk = _effective_blocks(s, block_q, block_k)
+    s_mult = math.lcm(bq, bk)
+    s_pad = s + ((-s) % s_mult)
+    d_pad = -(-d // LANES) * LANES
+    bh = b * h
+    nq, nk = s_pad // bq, s_pad // bk
+    v = visited_tiles(s_pad, bq, bk, causal)
+
+    tile_flops = 2 * bq * bk * d_pad  # every tile matmul is (bq x bk x d)
+    # matmuls per visited tile: fwd 2 (s, pv); dq 3 (s, dp, ds*k);
+    # dkdv 4 (s, dp, p^T g, ds^T q) — the s/dp recomputes are counted,
+    # that's the point of an EXECUTED-flops roofline
+    flops = {
+        "fwd": v * 2 * tile_flops * bh,
+        "dq": v * 3 * tile_flops * bh,
+        "dkdv": v * 4 * tile_flops * bh,
+    }
+    # "useful" attention FLOPs, the kernel_bench convention (fwd = 2
+    # matmuls over the causal half, fwd+bwd = 3.5x that)
+    useful_fwd = 2 * 2 * b * h * s * s * d / 2
+
+    qblk = bq * d_pad
+    kblk = bk * d_pad
+    lane_row = bq * LANES
+    traffic = {
+        # fwd: q/o per q-block, k+v per visited tile (DMA clamp), lse out
+        "fwd": bh * (
+            nq * qblk * BF16 + v * 2 * kblk * BF16
+            + nq * qblk * BF16 + nq * lane_row * F32
+        ),
+        # dq: q,g per q-block; k+v per visited tile; lse,delta per
+        # q-block; dq out
+        "dq": bh * (
+            2 * nq * qblk * BF16 + v * 2 * kblk * BF16
+            + 2 * nq * lane_row * F32 + nq * qblk * BF16
+        ),
+        # dkdv: k,v per k-block; q,g,lse,delta per visited tile (their
+        # specs move with the inner qi); dk,dv out
+        "dkdv": bh * (
+            2 * nk * kblk * BF16
+            + v * (2 * qblk * BF16 + 2 * lane_row * F32)
+            + 2 * nk * kblk * BF16
+        ),
+    }
+    grid_steps = {
+        "fwd": bh * nq * nk,
+        "dq": bh * nq * nk,
+        "dkdv": bh * nk * nq,
+    }
+
+    bounds = {}
+    total_bound = 0.0
+    for k in flops:
+        t_c = flops[k] / peak
+        t_m = traffic[k] / bw
+        bounds[k] = {
+            "t_compute_ms": t_c * 1e3,
+            "t_hbm_ms": t_m * 1e3,
+            "bound": "compute" if t_c >= t_m else "hbm",
+            "intensity_flop_per_byte": flops[k] / traffic[k],
+        }
+        total_bound += max(t_c, t_m)
+
+    return {
+        "shape": f"b{b}s{s}h{h}d{d}",
+        "blocks": (bq, bk),
+        "visited_tiles": v,
+        "total_tiles": nq * nk,
+        "grid_steps": grid_steps,
+        "executed_gflops": {k: round(f / 1e9, 1) for k, f in flops.items()},
+        "hbm_mb": {k: round(t / 1e6, 1) for k, t in traffic.items()},
+        "bounds_ms": {
+            k: {kk: round(vv, 3) if isinstance(vv, float) else vv
+                for kk, vv in bb.items()}
+            for k, bb in bounds.items()
+        },
+        "roofline_fwdbwd_ms": round(total_bound * 1e3, 3),
+        "roofline_fwd_ms": round(
+            max(flops["fwd"] / peak, traffic["fwd"] / bw) * 1e3, 3
+        ),
+        "useful_fwdbwd_gflops": round(3.5 * useful_fwd / 1e9, 1),
+        "roofline_useful_tflops": round(
+            3.5 * useful_fwd / total_bound / 1e12, 2
+        ),
+    }
+
+
+def fit_overhead(measured_fwdbwd_ms: float, a: dict) -> dict:
+    """Attribute measured - roofline to a per-grid-step overhead."""
+    steps = sum(a["grid_steps"].values())
+    gap_ms = measured_fwdbwd_ms - a["roofline_fwdbwd_ms"]
+    return {
+        "measured_fwdbwd_ms": measured_fwdbwd_ms,
+        "roofline_fwdbwd_ms": a["roofline_fwdbwd_ms"],
+        "gap_ms": round(gap_ms, 3),
+        "total_grid_steps": steps,
+        "implied_us_per_step": round(gap_ms * 1e3 / steps, 3),
+    }
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--fit", type=float, default=None,
+                   help="measured fwd+bwd ms to fit a per-step overhead")
+    p.add_argument("--shape", default="2,4096,8,128")
+    p.add_argument("--blocks", default="256,512")
+    args = p.parse_args()
+    b, s, h, d = (int(x) for x in args.shape.split(","))
+    bq, bk = (int(x) for x in args.blocks.split(","))
+    a = analyze(b, s, h, d, block_q=bq, block_k=bk)
+    print(json.dumps(a, indent=2))
+    if args.fit is not None:
+        print(json.dumps(fit_overhead(args.fit, a), indent=2))
