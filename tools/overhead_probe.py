@@ -1,0 +1,100 @@
+"""Measure pallas-TPU per-grid-step overhead directly (ROOFLINE.md).
+
+The roofline analysis attributes the flash kernels' 5.6x gap to a fixed
+~1.4 us cost per grid step.  This probe tests that hypothesis in
+isolation: a kernel whose per-step compute is negligible (one small VMEM
+copy) run at geometrically growing grid sizes — the slope of time vs
+steps IS the per-step overhead, with the kernel's work subtracted out by
+the regression's intercept.  A second sweep with a matmul per step
+separates "overhead per step" from "pipeline drain" effects, and a
+third runs the same grids under dimension_semantics=parallel to price
+what declaring independence buys.
+
+One JSON line per point; operator-invoked on the real chip:
+
+    timeout 300 python tools/overhead_probe.py
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# sitecustomize registers the axon plugin at interpreter boot, overriding
+# JAX_PLATFORMS from the environment; only a programmatic update before
+# the first backend touch restores it (same hook as bench.py / cli.py)
+_plat = os.environ.get("GSTPU_BENCH_PLATFORM") or os.environ.get("JAX_PLATFORMS")
+if _plat:
+    try:
+        jax.config.update("jax_platforms", _plat)
+    except Exception:
+        pass
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from gpuschedule_tpu.profiler.harness import time_callable
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def _matmul_kernel(x_ref, o_ref):
+    x = x_ref[...]  # (8, block)
+    s = jnp.dot(x.T, x, preferred_element_type=jnp.float32)  # (block, block)
+    o_ref[...] = s[: o_ref.shape[0], :].astype(o_ref.dtype)
+
+
+def run_grid(n_steps: int, *, kernel, block=128, parallel=False):
+    """Time a 1-D grid of n_steps blocks; returns seconds per call."""
+    x = jnp.ones((n_steps * 8, block), jnp.bfloat16)
+    params = None
+    if parallel:
+        params = pltpu.CompilerParams(dimension_semantics=("parallel",))
+    f = pl.pallas_call(
+        kernel,
+        grid=(n_steps,),
+        in_specs=[pl.BlockSpec((8, block), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, block), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=jax.default_backend() != "tpu",
+        **({"compiler_params": params} if params is not None else {}),
+    )
+    return time_callable(jax.jit(f), x, iters=10, warmup=2)
+
+
+def sweep(kernel, name: str, parallel: bool):
+    steps = [64, 256, 1024, 4096]
+    times = []
+    for n in steps:
+        t = run_grid(n, kernel=kernel, parallel=parallel)
+        times.append(t)
+        print(json.dumps({
+            "probe": name, "parallel": parallel, "grid_steps": n,
+            "ms": round(t * 1e3, 4),
+        }), flush=True)
+    slope, intercept = np.polyfit(steps, times, 1)
+    print(json.dumps({
+        "probe": name, "parallel": parallel,
+        "us_per_step": round(slope * 1e6, 4),
+        "intercept_ms": round(intercept * 1e3, 4),
+    }), flush=True)
+    return slope
+
+
+if __name__ == "__main__":
+    print(json.dumps({"backend": jax.default_backend(),
+                      "device": str(jax.devices()[0])}), flush=True)
+    sweep(_copy_kernel, "copy", parallel=False)
+    sweep(_copy_kernel, "copy", parallel=True)
+    sweep(_matmul_kernel, "matmul128", parallel=False)
+    sweep(_matmul_kernel, "matmul128", parallel=True)
