@@ -28,6 +28,8 @@ def _trainer(dp, tp, n):
     return ShardedTrainer("transformer-tiny", mesh, batch_size=4, seq_len=32)
 
 
+@pytest.mark.slow  # strictly weaker than the cross-mesh restore test
+# below, which also asserts exact value equality
 def test_save_restore_same_mesh_roundtrip(tmp_path):
     tr = _trainer(dp=4, tp=1, n=4)
     state = tr.init(seed=0)

@@ -194,6 +194,9 @@ def test_acceptance_band_semantics():
     json.dumps(z)  # must remain strict JSON
 
 
+@pytest.mark.slow  # heaviest CLI path; the pieces stay default-covered:
+# token-file train+resume, schedule-flag resume, pp train, datastream
+# drift (this file) and cross-mesh restore (test_checkpoint)
 def test_train_subcommand_end_to_end(tmp_path, capsys):
     """`cli train`: synthetic feed -> sharded steps -> checkpoint; then a
     second invocation resumes from it on a different mesh shape."""
@@ -231,6 +234,9 @@ def test_train_subcommand_end_to_end(tmp_path, capsys):
     assert s2["last_loss"] == s2["last_loss"]
 
 
+@pytest.mark.slow  # the composition itself is dryrun-driven every round
+# (driver) and numerically pinned in test_ringflash; this covers only the
+# flag plumbing on top
 def test_train_subcommand_ring_flash_composition(capsys):
     """`cli train --ring-attn --flash-attn`: the long-context composition
     (sequence-sharded ring over sp with the pallas kernel per chunk)

@@ -89,6 +89,7 @@ def test_trainer_validates_divisibility():
         ShardedTrainer("transformer-tiny", mesh, batch_size=8, seq_len=2048)
 
 
+@pytest.mark.slow  # the driver runs these exact contracts itself every round
 def test_graft_entry_contract():
     import __graft_entry__ as g
 

@@ -58,6 +58,8 @@ def test_ring_degenerate_sp1():
     )
 
 
+@pytest.mark.slow  # training-descent duplicate: the init-parity
+# test pins the numerics and the driver dryrun trains this path
 def test_ring_trainer_e2e_loss_decreases():
     mesh = make_mesh(dp=2, sp=2, tp=2, devices=jax.devices()[:8])
     tr = ShardedTrainer(
@@ -74,6 +76,8 @@ def test_ring_trainer_e2e_loss_decreases():
     assert all(l == l for l in losses)  # no NaNs
 
 
+@pytest.mark.slow  # module-level ring parity is pinned above; the
+# trainer wiring is dryrun-driven every round
 def test_ring_trainer_matches_dense_at_init():
     """Same seed, same param structure: first-step loss must agree with the
     dense-attention trainer to bf16-accumulation tolerance."""
