@@ -254,6 +254,7 @@ def _datastream_identity(args) -> dict:
         "seed": args.seed,
         "batch_size": args.batch_size,
         "seq_len": args.seq_len,
+        "host_shard": getattr(args, "host_shard", None),
         "data": None,
     }
     if args.data and os.path.exists(args.data):
@@ -400,18 +401,49 @@ def cmd_train(args) -> int:
         counts = optax.tree_utils.tree_get_all_with_path(state[1], "count")
         resumed_at = max((int(v) for _, v in counts), default=0)
 
+    # The optimizer count (resumed_at) counts batches THIS HOST consumed;
+    # stream `start` offsets are in GLOBAL positions.  Unsharded the two
+    # coincide; under --host-shard i,n the host consumed global positions
+    # i, i+n, ..., so its next global position is resumed_at * n.
+    host_shard = None
+    shard_n = 1
+    if args.host_shard:
+        try:
+            i, n = (int(x) for x in args.host_shard.split(","))
+        except ValueError:
+            raise SystemExit(
+                f"--host-shard wants INDEX,COUNT; got {args.host_shard!r}"
+            )
+        if n < 1 or not (0 <= i < n):
+            raise SystemExit(
+                f"--host-shard needs 0 <= INDEX < COUNT; got {i},{n}"
+            )
+        host_shard = (i, n)
+        shard_n = n
     if args.data:
         ds = TokenFileDataset(
             args.data, batch_size=trainer.batch_size, seq_len=args.seq_len,
             dtype=args.data_dtype, seed=args.seed,
         )
+        if ds.num_batches % shard_n:
+            # unequal per-host epoch lengths would desync the count-based
+            # resume arithmetic across epoch boundaries
+            raise SystemExit(
+                f"--host-shard COUNT={shard_n} must divide the dataset's "
+                f"{ds.num_batches} batches for resumable streams"
+            )
+        per_host_epoch = ds.num_batches // shard_n
 
         def batches():
             # O(1) jump to the resume position: whole epochs are encoded
-            # in the count, the remainder slices the epoch's permutation
-            epoch, start = divmod(resumed_at, ds.num_batches)
+            # in the per-host count, the remainder maps back to a global
+            # position in the epoch's permutation
+            epoch, k = divmod(resumed_at, per_host_epoch)
+            start = k * shard_n
             while True:
-                yield from ds.batches(epoch=epoch, start=start)
+                yield from ds.batches(
+                    epoch=epoch, start=start, host_shard=host_shard
+                )
                 epoch += 1
                 start = 0
     else:
@@ -419,9 +451,10 @@ def cmd_train(args) -> int:
             yield from synthetic_lm_batches(
                 batch_size=trainer.batch_size, seq_len=args.seq_len,
                 vocab=trainer.cfg.vocab,
-                num_batches=resumed_at + args.steps,
+                num_batches=(resumed_at + args.steps) * shard_n,
                 seed=args.seed,
-                start=resumed_at,  # per-index keying makes this O(1)
+                start=resumed_at * shard_n,  # per-index keying: O(1)
+                host_shard=host_shard,
             )
 
     import itertools
@@ -632,6 +665,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "ring-flash composition)")
     tr.add_argument("--data", help="flat binary token file (see data/)")
     tr.add_argument("--data-dtype", default="uint16")
+    tr.add_argument("--host-shard", default=None, metavar="INDEX,COUNT",
+                    help="multi-host input split: this host yields every "
+                         "COUNT-th batch starting at INDEX (streams "
+                         "partition the epoch exactly; resume offsets "
+                         "stay host-count-independent)")
     tr.add_argument("--ckpt", help="save final state here (orbax)")
     tr.add_argument("--restore", help="resume from this checkpoint")
     tr.set_defaults(fn=cmd_train)

@@ -66,17 +66,30 @@ class TokenFileDataset:
     def __len__(self) -> int:
         return self.num_batches
 
-    def batches(self, *, epoch: int = 0, start: int = 0) -> Iterator[np.ndarray]:
+    def batches(
+        self, *, epoch: int = 0, start: int = 0,
+        host_shard: "tuple[int, int] | None" = None,
+    ) -> Iterator[np.ndarray]:
         """Yield every batch once, order shuffled per (seed, epoch).
 
         ``start`` skips that many batches of the epoch in O(1) — resume
         jumps straight to its position instead of reading and discarding
-        every already-consumed batch."""
+        every already-consumed batch.
+
+        ``host_shard=(index, count)`` is the multi-host split: host
+        ``index`` of ``count`` yields only its every-``count``-th batch
+        of the SAME (seed, epoch) permutation, so the hosts' streams
+        partition the epoch exactly (disjoint, union = full epoch) with
+        zero coordination — each host mmaps the same file and reads only
+        its own blocks.  ``start`` stays in *global* stream positions so
+        resume arithmetic is host-count-independent."""
         order = np.random.default_rng((self.seed, epoch)).permutation(
             self.num_batches
         )
-        for i in order[start:]:
-            off = int(i) * self.block  # byte-block offset; never clobber `start`
+        idx, count = _check_host_shard(host_shard)
+        first = start + ((idx - start) % count)  # first host-owned pos >= start
+        for pos in range(first, self.num_batches, count):
+            off = int(order[pos]) * self.block  # byte-block offset
             chunk = np.asarray(self._tokens[off:off + self.block])
             yield chunk.astype(np.int32).reshape(self.batch_size, self.seq_len)
 
@@ -100,6 +113,15 @@ class TokenFileDataset:
         return path
 
 
+def _check_host_shard(host_shard) -> "tuple[int, int]":
+    if host_shard is None:
+        return 0, 1
+    idx, count = host_shard
+    if count < 1 or not (0 <= idx < count):
+        raise ValueError(f"host_shard must be (index, count), 0 <= index < count; got {host_shard}")
+    return int(idx), int(count)
+
+
 def synthetic_lm_batches(
     *,
     batch_size: int,
@@ -108,14 +130,20 @@ def synthetic_lm_batches(
     num_batches: int,
     seed: int = 0,
     start: int = 0,
+    host_shard: "tuple[int, int] | None" = None,
 ) -> Iterator[np.ndarray]:
     """Deterministic random token batches with the dataset iterator
     contract — the zero-IO feed for benchmarks and profiling.
 
     Each batch is keyed by (seed, index), so ``start`` resumes the stream
     at any position in O(1): batch i is identical whether the stream was
-    consumed from 0 or entered at i."""
-    for i in range(start, num_batches):
+    consumed from 0 or entered at i.  ``host_shard=(index, count)``
+    splits the stream across hosts exactly like
+    :meth:`TokenFileDataset.batches` (global positions, per-host
+    every-``count``-th batch)."""
+    idx, count = _check_host_shard(host_shard)
+    first = start + ((idx - start) % count)  # first host-owned pos >= start
+    for i in range(first, num_batches, count):
         yield np.random.default_rng((seed, i)).integers(
             0, vocab, size=(batch_size, seq_len), dtype=np.int32
         )

@@ -127,3 +127,61 @@ def test_token_dataset_start_skips_in_order(tmp_path):
     assert len(tail) == len(full) - 2
     for a, b in zip(full[2:], tail):
         np.testing.assert_array_equal(a, b)
+
+
+def test_host_shard_partitions_the_stream_exactly(tmp_path):
+    """Multi-host input: the per-host streams are disjoint and their
+    union (in global position order) IS the unsharded stream — for both
+    the token-file and synthetic feeds, with no host coordination."""
+    from gpuschedule_tpu.data import synthetic_lm_batches
+
+    corpus = TokenFileDataset.write(
+        np.arange(6 * 2 * 8) % 100, tmp_path / "t.bin"
+    )
+    ds = TokenFileDataset(corpus, batch_size=2, seq_len=8, seed=1)
+    full = list(ds.batches(epoch=1))
+    n_hosts = 3
+    shards = [
+        list(ds.batches(epoch=1, host_shard=(i, n_hosts)))
+        for i in range(n_hosts)
+    ]
+    # reinterleave by global position: host i holds positions i, i+n, ...
+    merged = [shards[pos % n_hosts][pos // n_hosts]
+              for pos in range(len(full))]
+    assert sum(len(s) for s in shards) == len(full)
+    for a, b in zip(full, merged):
+        np.testing.assert_array_equal(a, b)
+
+    sfull = list(synthetic_lm_batches(
+        batch_size=2, seq_len=8, vocab=50, num_batches=7, seed=3))
+    sshards = [
+        list(synthetic_lm_batches(
+            batch_size=2, seq_len=8, vocab=50, num_batches=7, seed=3,
+            host_shard=(i, 2)))
+        for i in range(2)
+    ]
+    smerged = [sshards[pos % 2][pos // 2] for pos in range(7)]
+    for a, b in zip(sfull, smerged):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_host_shard_composes_with_start_resume(tmp_path):
+    """`start` stays in GLOBAL stream positions under host sharding, so
+    a resumed multi-host run computes one offset for every host."""
+    from gpuschedule_tpu.data import synthetic_lm_batches
+
+    full = list(synthetic_lm_batches(
+        batch_size=2, seq_len=8, vocab=50, num_batches=10, seed=5,
+        host_shard=(1, 2)))
+    resumed = list(synthetic_lm_batches(
+        batch_size=2, seq_len=8, vocab=50, num_batches=10, seed=5,
+        host_shard=(1, 2), start=4))
+    # host 1 of 2 holds global positions 1,3,5,7,9; start=4 keeps 5,7,9
+    assert len(full) == 5 and len(resumed) == 3
+    for a, b in zip(full[2:], resumed):
+        np.testing.assert_array_equal(a, b)
+
+    with pytest.raises(ValueError, match="host_shard"):
+        list(synthetic_lm_batches(
+            batch_size=2, seq_len=8, vocab=50, num_batches=4,
+            host_shard=(2, 2)))
