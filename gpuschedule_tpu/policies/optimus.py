@@ -95,13 +95,18 @@ class OptimusPolicy(Policy):
 
     @staticmethod
     def _curve_key(job: Job) -> str:
-        """Cache key for a job's curve: the @sp{s}tp{t} variant when the
-        job declares a parallelism spec, else the bare model name — the
-        consumer side of profile_model's variant keys (harness.py)."""
-        sp, tp = getattr(job, "sp", 1), getattr(job, "tp", 1)
-        if sp == 1 and tp == 1:
+        """Cache key for a job's curve: the @sp{s}tp{t}[pp{p}] variant
+        when the job declares a parallelism spec, else the bare model
+        name — the consumer side of profile_model's variant keys
+        (harness.py)."""
+        sp = getattr(job, "sp", 1)
+        tp = getattr(job, "tp", 1)
+        pp = getattr(job, "pp", 1)
+        if sp == 1 and tp == 1 and pp == 1:
             return job.model_name
-        return f"{job.model_name}@sp{sp}tp{tp}"
+        if pp == 1:
+            return f"{job.model_name}@sp{sp}tp{tp}"
+        return f"{job.model_name}@sp{sp}tp{tp}pp{pp}"
 
     def _profile_charge(self, curve: GoodputCurve, ks=None) -> float:
         """Simulated seconds one online-profiling run occupies its slice:
@@ -128,8 +133,10 @@ class OptimusPolicy(Policy):
             # a real measured run, here a jitted train step on live devices
             from gpuschedule_tpu.profiler.harness import profile_model
 
-            sp, tp = getattr(job, "sp", 1), getattr(job, "tp", 1)
-            unit = sp * tp
+            sp = getattr(job, "sp", 1)
+            tp = getattr(job, "tp", 1)
+            pp = getattr(job, "pp", 1)
+            unit = sp * tp * pp
             # profile_model requires ks divisible by the replica unit:
             # profile at replica multiples for parallelism-spec jobs
             ks = tuple(k * unit for k in self.profile_ks) if unit > 1 else self.profile_ks
@@ -141,6 +148,7 @@ class OptimusPolicy(Policy):
                     seq_len=self.profile_seq,
                     sp=sp,
                     tp=tp,
+                    pp=pp,
                     cache=self.cache,
                 )
             except ValueError:
@@ -227,9 +235,14 @@ class OptimusPolicy(Policy):
         by_id: Dict[str, Job] = {}
         for job in ordered:
             by_id[job.job_id] = job
-            # one model replica spans sp*tp chips: a parallelism-spec job
-            # cannot seed below its replica size
-            k0 = max(self.min_chips, getattr(job, "sp", 1) * getattr(job, "tp", 1))
+            # one model replica spans sp*tp*pp chips: a parallelism-spec
+            # job cannot seed below its replica size
+            k0 = max(
+                self.min_chips,
+                getattr(job, "sp", 1)
+                * getattr(job, "tp", 1)
+                * getattr(job, "pp", 1),
+            )
             if budget >= k0 and sim.cluster.is_satisfiable(k0):
                 plan[job.job_id] = k0
                 budget -= k0

@@ -68,11 +68,13 @@ class Job:
     utilization: float = 1.0            # profiled device utilization in [0,1];
                                         # Gandiva's packing signal (SURVEY.md §3.3)
     sp: int = 1                         # declared sequence-parallel factor: one
-    tp: int = 1                         # model replica spans sp*tp chips, and
-                                        # goodput curves resolve to the
-                                        # @sp{s}tp{t} cache variant when set
-                                        # (round-4 verdict #3: parallelism-aware
-                                        # curves get a policy consumer)
+    tp: int = 1                         # model replica spans sp*tp*pp chips,
+    pp: int = 1                         # and goodput curves resolve to the
+                                        # @sp{s}tp{t} / @sp{s}tp{t}pp{p} cache
+                                        # variant when set (round-4 verdict #3:
+                                        # parallelism-aware curves get a policy
+                                        # consumer; pp mirrors the profiler's
+                                        # pipeline-mesh keys)
 
     # ---- runtime accounting (engine-owned) ----
     state: JobState = JobState.PENDING

@@ -228,6 +228,19 @@ def test_parallelism_spec_resolves_sp_tp_curve_variant(tmp_path):
     assert pol._job_curve(other).theta == (1.0, 0.0, 0.5)
 
 
+def test_parallelism_spec_resolves_pp_curve_variant(tmp_path):
+    """pp mirrors sp/tp: a pp-spec job plans from the profiler's
+    @sp{s}tp{t}pp{p} cache key and seeds at >= one pp-deep replica."""
+    cache = CurveCache(tmp_path / "c.json")
+    cache.put("m", GoodputCurve((1.0, 0.0, 0.5)))
+    cache.put("m@sp1tp1pp2", GoodputCurve((1.0, 0.0, 1e-6)))
+    pol = OptimusPolicy(curve_cache=cache)
+    spec = Job("s", 0.0, num_chips=4, duration=100.0, model_name="m", pp=2)
+    assert pol._job_curve(spec).theta == (1.0, 0.0, 1e-6)
+    sim = Simulator(TpuCluster("v5e", dims=(4, 4)), pol, [spec])
+    assert pol._plan(sim, [spec])["s"] >= 2  # floor: one pp=2 replica
+
+
 def test_multislice_growth_runs_end_to_end(tmp_path):
     """A lone compute-heavy job on a 2-pod fleet grows across the DCN
     boundary, pays the engine's locality toll (speed_factor < 1), and
