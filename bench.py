@@ -57,9 +57,11 @@ TARGET_MFU = 0.30
 # (model, hard timeout seconds).  transformer-large is the flagship (62% MFU
 # config — models/config.py); transformer-base compiles faster and is the
 # fallback if the tunnel is slow rather than dead.  Worst case ~8.5 min of
-# TPU attempts plus up to 5 min of CPU fallback (~13.5 min total), inside
-# the driver's budget (r02 ran >26 min before rc=124).
-# Overridable for tests: GSTPU_BENCH_MODELS="m1,m2" GSTPU_BENCH_TIMEOUT=30.
+# TPU attempts, then EITHER the flash/longctx extras (success path; capped
+# by TOTAL_BUDGET_S ~23 min overall, see _attach_extras) OR up to 5 min of
+# CPU fallback — both inside the driver's budget (r02 ran >26 min before
+# rc=124).  Overridable for tests: GSTPU_BENCH_MODELS="m1,m2"
+# GSTPU_BENCH_TIMEOUT=30.
 def _attempt_plan():
     models = os.environ.get("GSTPU_BENCH_MODELS")
     if models:
