@@ -287,17 +287,20 @@ def _effective_blocks(s: int, block_q: int, block_k: int) -> tuple[int, int]:
     When the clamped pair's common multiple still overshoots that cap
     (mismatched sizes, e.g. (256, 384) for S=300 -> lcm 768), collapse to
     one full-sequence tile pair — strictly less padded work than padding
-    past the lane round-up — but only while cap stays at the default
-    block scale (<= 512): a (cap, cap) f32 score tile lives in VMEM, and
-    collapsing at large S would materialize the very O(S, S) tile the
-    kernel exists to avoid (cap=2048 alone is a 16.8 MB tile — over a
-    v5e's VMEM).  Past that bound, mismatched custom blocks keep their
-    lcm padding: more padded FLOPs, bounded VMEM.  Deterministic in
-    (s, blocks): the backward recomputes the identical clamp, keeping
-    its padded layout aligned with the forward's saved lse."""
+    past the lane round-up — but only while cap stays at or below the
+    default block_k scale (<= 1024, a 4 MB f32 score tile + K/V
+    double-buffers, comfortably inside v5e VMEM): collapsing at larger S
+    would materialize the very O(S, S) tile the kernel exists to avoid
+    (cap=2048 alone is a 16.8 MB tile — over a v5e's VMEM).  Past that
+    bound, mismatched custom blocks keep their lcm padding: more padded
+    FLOPs, bounded VMEM.  The bound matters for the (512, 1024) defaults:
+    S=640 clamps to (512, 640), lcm 2560 — collapsing to (640, 640) pads
+    nothing, while the lcm would pad 4x.  Deterministic in (s, blocks):
+    the backward recomputes the identical clamp, keeping its padded
+    layout aligned with the forward's saved lse."""
     cap = -(-s // LANES) * LANES
     bq, bk = min(block_q, cap), min(block_k, cap)
-    if math.lcm(bq, bk) > cap and cap <= 512:
+    if math.lcm(bq, bk) > cap and cap <= 1024:
         bq = bk = cap
     return bq, bk
 
@@ -506,7 +509,7 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_chunk_fwd(
-    q, k, v, *, causal, block_q=256, block_k=512, interpret=None
+    q, k, v, *, causal, block_q=512, block_k=1024, interpret=None
 ):
     """(out, lse_rows) for one (q-chunk, k-chunk) pair — the per-chunk op
     of the cross-chip ring composition (parallel/ringflash.py).
@@ -526,7 +529,7 @@ def flash_chunk_fwd(
 
 
 def flash_chunk_bwd(
-    q, k, v, out, lse_rows, g, *, causal, block_q=256, block_k=512,
+    q, k, v, out, lse_rows, g, *, causal, block_q=512, block_k=1024,
     interpret=None,
 ):
     """(dq, dk, dv) contribution of one (q-chunk, k-chunk) pair given the
@@ -556,8 +559,8 @@ def flash_attention(
     v: jax.Array,
     *,
     causal: bool = True,
-    block_q: int = 256,
-    block_k: int = 512,
+    block_q: int = 512,
+    block_k: int = 1024,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Blockwise attention over (B, S, H, D); differentiable end-to-end
@@ -566,11 +569,14 @@ def flash_attention(
     long sequences never materializes an (S, S) intermediate.
 
     Default blocks are the measured v5e sweet spot (tools/kernel_bench.py
-    on the real chip, b2 S4096 h8 bf16, KERNEL_BENCH_r04.jsonl): with the
-    masked-block DMA clamp, (256, 512) runs fwd+bwd 2.1x faster than the
-    dense-XLA path and ~2x faster than naive (128, 128) blocks; blocks
-    are clamped to the sequence's lane-tile round-up so short sequences
-    never pad to the large default.
+    on the real chip, b2 S4096 h8 bf16, KERNEL_BENCH_r05.jsonl): the
+    kernels are per-grid-step-overhead-bound (ROOFLINE.md), so the
+    fewest-steps pair wins — (512, 1024) with parallel
+    dimension_semantics runs fwd+bwd 1.54x faster than round 4's
+    (256, 512) point at d128 (6.65 ms vs 10.23 ms, 36.2 TFLOP/s) and
+    2.9x faster than the dense-XLA path at d32; blocks are clamped to
+    the sequence's lane-tile round-up so short sequences never pad to
+    the large default.
 
     ``interpret=None`` auto-selects pallas interpret mode off-TPU.  The
     call signature matches the model zoo's ``attn_fn`` hook, so

@@ -181,8 +181,8 @@ def ring_flash_attention(
     mesh: Optional[Mesh] = None,
     axis: str = "sp",
     causal: bool = True,
-    block_q: int = 256,
-    block_k: int = 512,
+    block_q: int = 512,
+    block_k: int = 1024,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Causal attention over (B, S, H, D) with S sharded on mesh axis

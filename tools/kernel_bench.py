@@ -11,12 +11,13 @@ Usage (each run compiles ~6 variants; expect a few minutes):
 Shapes default to the transformer-long attention shape (b2 S4096 h8 d32)
 plus a wider-head shape (d128) where no padding waste exists.
 
-The round-4 v5e sweep is committed as ``KERNEL_BENCH_r04.jsonl``; its
-headline: with the masked-block DMA clamp, flash fwd+bwd at (bq256,
-bk512) is 2.1x faster than dense XLA at both head widths, and the
-original (128, 128) default was the slowest flash configuration measured
-— which is why the kernel defaults changed twice (block shape, then the
-clamp).
+Committed sweeps: ``KERNEL_BENCH_r04.jsonl`` (pre dimension-semantics)
+and ``KERNEL_BENCH_r05.jsonl`` (parallel dimension_semantics + the
+(512, 512)/(512, 1024) rows).  The r5 headline: the kernels are
+grid-step-overhead-bound (ROOFLINE.md), so the fewest-steps pair
+(bq512, bk1024) wins — 1.54x over the r4 d128 fwd+bwd point and 2.9x
+over dense at d32 — which is why the kernel defaults have changed three
+times (block shape, the DMA clamp, then this).
 """
 
 from __future__ import annotations

@@ -49,7 +49,7 @@ def visited_tiles(s_pad: int, bq: int, bk: int, causal: bool) -> int:
 
 def analyze(
     b: int, s: int, h: int, d: int,
-    *, block_q: int = 256, block_k: int = 512, causal: bool = True,
+    *, block_q: int = 512, block_k: int = 1024, causal: bool = True,
     generation: str = "v5e",
 ) -> dict:
     spec = GENERATIONS[generation]
@@ -161,7 +161,9 @@ if __name__ == "__main__":
     p.add_argument("--fit", type=float, default=None,
                    help="measured fwd+bwd ms to fit a per-step overhead")
     p.add_argument("--shape", default="2,4096,8,128")
-    p.add_argument("--blocks", default="256,512")
+    # Default follows the kernel defaults (flash_attention.py); pass
+    # --blocks 256,512 to reproduce the r4 analysis ROOFLINE.md opens with.
+    p.add_argument("--blocks", default="512,1024")
     args = p.parse_args()
     b, s, h, d = (int(x) for x in args.shape.split(","))
     bq, bk = (int(x) for x in args.blocks.split(","))
