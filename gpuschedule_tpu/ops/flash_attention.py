@@ -568,13 +568,16 @@ def flash_attention(
     blockwise too (saved-logsumexp recompute per tile), so training with
     long sequences never materializes an (S, S) intermediate.
 
-    Default blocks are the measured v5e sweet spot (tools/kernel_bench.py
+    Default blocks are the measured v5e optimum (tools/kernel_bench.py
     on the real chip, b2 S4096 h8 bf16, KERNEL_BENCH_r05.jsonl): the
     kernels are per-grid-step-overhead-bound (ROOFLINE.md), so the
-    fewest-steps pair wins — (512, 1024) with parallel
-    dimension_semantics runs fwd+bwd 1.54x faster than round 4's
-    (256, 512) point at d128 (6.65 ms vs 10.23 ms, 36.2 TFLOP/s) and
-    2.9x faster than the dense-XLA path at d32; blocks are clamped to
+    fewest-steps pair (512, 1024) ranks first in every measured
+    transport state (standalone-kernel wall times carry ~±40% session
+    variance on this tunnel — the *ordering* and the dense-normalized
+    ratio are what reproduce).  Fwd+bwd beats the dense-XLA path
+    2.1-3.4x at S=4096, and at S=32k the 4x grid-step reduction
+    compounds into 0.088 -> 0.205 MFU on the full train step
+    (LONGCTX_r05.json, reproducible to 0.01%); blocks are clamped to
     the sequence's lane-tile round-up so short sequences never pad to
     the large default.
 

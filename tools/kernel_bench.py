@@ -12,12 +12,18 @@ Shapes default to the transformer-long attention shape (b2 S4096 h8 d32)
 plus a wider-head shape (d128) where no padding waste exists.
 
 Committed sweeps: ``KERNEL_BENCH_r04.jsonl`` (pre dimension-semantics)
-and ``KERNEL_BENCH_r05.jsonl`` (parallel dimension_semantics + the
-(512, 512)/(512, 1024) rows).  The r5 headline: the kernels are
-grid-step-overhead-bound (ROOFLINE.md), so the fewest-steps pair
-(bq512, bk1024) wins — 1.54x over the r4 d128 fwd+bwd point and 2.9x
-over dense at d32 — which is why the kernel defaults have changed three
-times (block shape, the DMA clamp, then this).
+and ``KERNEL_BENCH_r05.jsonl`` (two same-day sweeps + a b*h scaling
+block).  The r5 headline: the kernels are grid-step-overhead-bound
+(ROOFLINE.md), so the fewest-steps pair (bq512, bk1024) ranks first in
+every measured state — which is why the kernel defaults have changed
+three times (block shape, the DMA clamp, then this).
+
+MEASUREMENT CAVEAT (ROOFLINE.md round-5 section): standalone flash-row
+wall times on this tunnel swing ~±40% between sessions while the dense
+rows are stable to ~2%; compare rows only WITHIN one sweep, prefer the
+dense-normalized ratio, and for ranking block pairs use interleaved
+repeated medians in one process (stable to ±2%).  Whole-model numbers
+(bench.py, --longctx) are immune and reproduce to <0.1%.
 """
 
 from __future__ import annotations
