@@ -571,15 +571,15 @@ def flash_attention(
     Default blocks are the measured v5e optimum (tools/kernel_bench.py
     on the real chip, b2 S4096 h8 bf16, KERNEL_BENCH_r05.jsonl): the
     kernels are per-grid-step-overhead-bound (ROOFLINE.md), so the
-    fewest-steps pair (512, 1024) ranks first in every measured
-    transport state (standalone-kernel wall times carry ~±40% session
-    variance on this tunnel — the *ordering* and the dense-normalized
-    ratio are what reproduce).  Fwd+bwd beats the dense-XLA path
-    2.1-3.4x at S=4096, and at S=32k the 4x grid-step reduction
-    compounds into 0.088 -> 0.205 MFU on the full train step
-    (LONGCTX_r05.json, reproducible to 0.01%); blocks are clamped to
-    the sequence's lane-tile round-up so short sequences never pad to
-    the large default.
+    fewest-steps pairs win: (512, 1024) ranks first by interleaved
+    repeated medians, with (512, 512) within a few percent — standalone
+    single-row timings carry ~±40% session variance on this tunnel, so
+    only repeated-median rankings and dense-normalized ratios are
+    trusted.  Fwd+bwd beats the dense-XLA path 2.1-3.4x at S=4096, and
+    at S=32k the 4x grid-step reduction compounds into 0.088 -> 0.205
+    MFU on the full train step (LONGCTX_r05.json, ~0.5% spread across
+    three runs); blocks are clamped to the sequence's lane-tile
+    round-up so short sequences never pad to the large default.
 
     ``interpret=None`` auto-selects pallas interpret mode off-TPU.  The
     call signature matches the model zoo's ``attn_fn`` hook, so

@@ -10,6 +10,7 @@ from gpuschedule_tpu.policies.fifo import FifoPolicy
 from gpuschedule_tpu.policies.gandiva import GandivaPolicy
 from gpuschedule_tpu.policies.optimus import OptimusPolicy
 from gpuschedule_tpu.policies.srtf import SrtfPolicy
+from gpuschedule_tpu.policies.themis import ThemisPolicy
 
 _REGISTRY = {
     "fifo": FifoPolicy,
@@ -17,6 +18,7 @@ _REGISTRY = {
     "dlas": DlasPolicy,
     "gandiva": GandivaPolicy,
     "optimus": OptimusPolicy,
+    "themis": ThemisPolicy,
 }
 
 
@@ -43,6 +45,7 @@ __all__ = [
     "DlasPolicy",
     "GandivaPolicy",
     "OptimusPolicy",
+    "ThemisPolicy",
     "make_policy",
     "available",
     "register",

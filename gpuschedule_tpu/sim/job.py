@@ -164,6 +164,15 @@ class Job:
             return None
         return self.first_start_time - self.submit_time
 
+    def slowdown(self) -> Optional[float]:
+        """JCT relative to a dedicated-cluster run (the trace duration at
+        the requested gang).  1.0 = ran immediately with no interference;
+        the fairness policies (Themis) minimize the tail of this ratio."""
+        j = self.jct()
+        if j is None:
+            return None
+        return j / max(self.duration, 1e-9)
+
     def __repr__(self) -> str:  # compact for debugging/log lines
         return (
             f"Job({self.job_id}, chips={self.num_chips}, state={self.state.value}, "

@@ -26,6 +26,7 @@ JOB_CSV_FIELDS = [
     "end_time",
     "jct",
     "queueing_delay",
+    "slowdown",
     "executed_work",
     "attained_service",
     "preempt_count",
@@ -59,6 +60,12 @@ class SimResult:
     counters: Dict[str, int]
     end_time: float
     num_rejected: int = 0
+    # Fairness tail: slowdown = JCT / dedicated-run duration per job
+    # (sim/job.py).  avg JCT rewards policies that favor short jobs;
+    # these expose what that costs the worst-treated job (Themis's
+    # objective is minimizing exactly this tail).
+    p95_slowdown: float = 0.0
+    max_slowdown: float = 0.0
     jobs: List[Job] = field(repr=False, default_factory=list)
 
     def summary(self) -> Dict[str, float]:
@@ -66,6 +73,8 @@ class SimResult:
             "avg_jct": self.avg_jct,
             "makespan": self.makespan,
             "p95_queueing_delay": self.p95_queueing_delay,
+            "p95_slowdown": self.p95_slowdown,
+            "max_slowdown": self.max_slowdown,
             "mean_utilization": self.mean_utilization,
             "num_finished": self.num_finished,
             "num_unfinished": self.num_unfinished,
@@ -138,6 +147,7 @@ class MetricsLog:
             "end_time": job.end_time,
             "jct": job.jct(),
             "queueing_delay": job.queueing_delay(),
+            "slowdown": job.slowdown(),
             "executed_work": round(job.executed_work, 6),
             "attained_service": round(job.attained_service, 6),
             "preempt_count": job.preempt_count,
@@ -190,6 +200,7 @@ class MetricsLog:
         ]
         jcts = [j.jct() for j in finished]
         qdelays = [j.queueing_delay() for j in finished if j.queueing_delay() is not None]
+        slowdowns = [j.slowdown() for j in finished if j.slowdown() is not None]
         if finished:
             start = min(j.submit_time for j in finished)
             makespan = max(j.end_time for j in finished) - start
@@ -203,6 +214,8 @@ class MetricsLog:
             avg_jct=sum(jcts) / len(jcts) if jcts else 0.0,
             makespan=makespan,
             p95_queueing_delay=_percentile(qdelays, 95.0),
+            p95_slowdown=_percentile(slowdowns, 95.0),
+            max_slowdown=max(slowdowns) if slowdowns else 0.0,
             mean_utilization=util,
             num_finished=len(finished),
             num_unfinished=len(jobs) - len(finished) - rejected,

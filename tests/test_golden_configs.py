@@ -41,6 +41,18 @@ def test_golden_config1_fifo_64dev_poisson():
     pin(res, 56378.711675000006, 199827.89700000003)
 
 
+def test_golden_themis_64dev_poisson():
+    """Beyond-parity policy #6 (finish-time fairness) on the config #1
+    trace; the slowdown tail the policy optimizes is pinned alongside
+    JCT/makespan."""
+    res = Simulator(
+        SimpleCluster(64), make_policy("themis"), generate_poisson_trace(200, seed=42)
+    ).run()
+    pin(res, 9729.680539999994, 118885.449)
+    assert res.max_slowdown == pytest.approx(4.3747757300842, rel=REL)
+    assert res.p95_slowdown == pytest.approx(4.179454435165738, rel=REL)
+
+
 def test_golden_config2_srtf_philly():
     """Config #2a: SRTF on the calibrated Philly sample over a v5e pod.
 

@@ -14,16 +14,20 @@ plus a wider-head shape (d128) where no padding waste exists.
 Committed sweeps: ``KERNEL_BENCH_r04.jsonl`` (pre dimension-semantics)
 and ``KERNEL_BENCH_r05.jsonl`` (two same-day sweeps + a b*h scaling
 block).  The r5 headline: the kernels are grid-step-overhead-bound
-(ROOFLINE.md), so the fewest-steps pair (bq512, bk1024) ranks first in
-every measured state — which is why the kernel defaults have changed
-three times (block shape, the DMA clamp, then this).
+(ROOFLINE.md), so the fewest-steps pairs win: (bq512, bk1024) ranks
+first by interleaved repeated medians with (bq512, bk512) a few percent
+behind — which is why the kernel defaults have changed three times
+(block shape, the DMA clamp, then this).
 
 MEASUREMENT CAVEAT (ROOFLINE.md round-5 section): standalone flash-row
-wall times on this tunnel swing ~±40% between sessions while the dense
-rows are stable to ~2%; compare rows only WITHIN one sweep, prefer the
-dense-normalized ratio, and for ranking block pairs use interleaved
-repeated medians in one process (stable to ±2%).  Whole-model numbers
-(bench.py, --longctx) are immune and reproduce to <0.1%.
+wall times on this tunnel swing ~±40% between sessions — and single
+rows bounce WITHIN a sweep (sweep B's (512, 512) row landed 37% under
+its (512, 1024) row; the interleaved-median ranking puts them 1% apart)
+— while the dense rows are stable to ~2%.  So: never rank block pairs
+from single rows, prefer the dense-normalized ratio, and use
+interleaved repeated medians in one process (stable to ±2%).
+Whole-model numbers (bench.py, --longctx) are far steadier: ~0.5%
+spread across three longctx runs.
 """
 
 from __future__ import annotations
