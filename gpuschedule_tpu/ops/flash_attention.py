@@ -571,15 +571,18 @@ def flash_attention(
     Default blocks are the measured v5e optimum (tools/kernel_bench.py
     on the real chip, b2 S4096 h8 bf16, KERNEL_BENCH_r05.jsonl): the
     kernels are per-grid-step-overhead-bound (ROOFLINE.md), so the
-    fewest-steps pairs win: (512, 1024) ranks first by interleaved
-    repeated medians, with (512, 512) within a few percent — standalone
-    single-row timings carry ~±40% session variance on this tunnel, so
-    only repeated-median rankings and dense-normalized ratios are
-    trusted.  Fwd+bwd beats the dense-XLA path 2.1-3.4x at S=4096, and
-    at S=32k the 4x grid-step reduction compounds into 0.088 -> 0.205
-    MFU on the full train step (LONGCTX_r05.json, ~0.5% spread across
-    three runs); blocks are clamped to the sequence's lane-tile
-    round-up so short sequences never pad to the large default.
+    fewest-steps pairs win: (512, 1024) ranks first both by interleaved
+    repeated-median wall clocks and by xprof device time
+    (TRACE_r05.jsonl: 2.87 ms/iter fwd+bwd at b2 S4096 d128 against a
+    1.82 ms executed-FLOPs roofline, ~42% of v5e bf16 peak, 5.3x faster
+    than the dense-XLA path on device; (128, 128) costs 4.7x more
+    device time).  Standalone wall clocks additionally pay a
+    session-varying per-dispatch tunnel constant — trust device traces
+    (tools/trace_flash.py) and whole-model steps: at S=32k the 4x
+    grid-step reduction compounds into 0.088 -> 0.205 MFU on the full
+    train step (LONGCTX_r05.json, ~0.5% spread across three runs).
+    Blocks are clamped to the sequence's lane-tile round-up so short
+    sequences never pad to the large default.
 
     ``interpret=None`` auto-selects pallas interpret mode off-TPU.  The
     call signature matches the model zoo's ``attn_fn`` hook, so

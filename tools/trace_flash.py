@@ -1,0 +1,130 @@
+"""Operator tool: xprof-trace the flash kernels and report DEVICE time.
+
+The round-5 discovery this tool exists for: wall-clock microbenchmarks of
+standalone pallas kernels on the axon tunnel are dominated by per-dispatch
+host/tunnel latency (4-8 ms per call, varying by session — the ±40%
+"transport state" of ROOFLINE.md), while the device-side spans in a
+`jax.profiler.trace` capture show the kernel itself.  First capture on a
+v5e chip: flash fwd+bwd at the d128 point ran **2.87 ms on-device** per
+iteration against a 1.82 ms roofline (~84 useful TFLOP/s, ~42% of bf16
+peak) while the same iterations measured 9.8-10.7 ms by wall clock —
+i.e. the "12% of peak" story in KERNEL_BENCH wall times was transport,
+not kernel.
+
+Usage:
+    timeout 900 python tools/trace_flash.py            # default variants
+Prints one JSON line per variant: total device ms/iter plus the top
+device ops.  Trace capture itself is slow over the tunnel (~5 s/iter of
+streaming overhead); device-span durations are measured by the device
+clock and unaffected.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import shutil
+import sys
+import tempfile
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from gpuschedule_tpu.ops import flash_attention
+from gpuschedule_tpu.ops.reference import dense_attention
+
+ITERS = 10
+
+
+def device_times(trace_dir: str) -> dict:
+    """Aggregate complete-event durations on the /device: plane of the
+    chrome trace xprof wrote under ``trace_dir``."""
+    paths = glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
+    )
+    if not paths:
+        return {"error": "no trace written"}
+    tr = json.loads(gzip.open(paths[0]).read())
+    evs = tr["traceEvents"]
+    device_pids = {
+        e["pid"]
+        for e in evs
+        if e.get("ph") == "M"
+        and e.get("name") == "process_name"
+        and "/device:" in e["args"].get("name", "")
+    }
+    agg = defaultdict(float)
+    for e in evs:
+        if e.get("ph") == "X" and e["pid"] in device_pids:
+            agg[e["name"]] += e.get("dur", 0.0)  # microseconds
+    # the jit entry span covers each whole on-device iteration; numbered
+    # spans ("0", "1", ...) are xprof's per-invocation step markers
+    total_us = sum(v for k, v in agg.items() if k.startswith("jit_"))
+    ops = sorted(
+        ((k, v) for k, v in agg.items()
+         if not k.startswith("jit_") and not k.isdigit()),
+        key=lambda kv: -kv[1],
+    )[:6]
+    return {
+        "device_ms_per_iter": round(total_us / ITERS / 1e3, 3),
+        "top_device_ops_ms_per_iter": {
+            k[:48]: round(v / ITERS / 1e3, 3) for k, v in ops
+        },
+    }
+
+
+def trace_one(name: str, fn, *args) -> None:
+    jax.block_until_ready(fn(*args))  # compile outside the trace
+    d = tempfile.mkdtemp(prefix=f"trace_{name}_")
+    try:
+        with jax.profiler.trace(d):
+            out = None
+            for _ in range(ITERS):
+                out = fn(*args)
+            jax.block_until_ready(out)
+        rec = {"case": name, "iters": ITERS, **device_times(d)}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    print(json.dumps(rec), flush=True)
+
+
+def main() -> None:
+    # sitecustomize's axon plugin overrides the JAX_PLATFORMS env var, so
+    # re-apply it programmatically (same two-env fallback as
+    # tools/overhead_probe.py).
+    plat = os.environ.get("GSTPU_BENCH_PLATFORM") or os.environ.get(
+        "JAX_PLATFORMS"
+    )
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    print(json.dumps({"backend": jax.default_backend(),
+                      "device": str(jax.devices()[0])}), flush=True)
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (2, 4096, 8, 128), jnp.bfloat16)
+    k = jax.random.normal(kk, (2, 4096, 8, 128), jnp.bfloat16)
+    v = jax.random.normal(kv, (2, 4096, 8, 128), jnp.bfloat16)
+
+    def dense_loss(q, k, v):
+        return (dense_attention(q, k, v, causal=True).astype(jnp.float32) ** 2).sum()
+
+    trace_one("dense fwd+bwd", jax.jit(jax.grad(dense_loss, argnums=(0, 1, 2))), q, k, v)
+
+    for bq, bk in ((128, 128), (256, 512), (512, 1024)):
+        def loss(q, k, v, bq=bq, bk=bk):
+            return (flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk
+            ).astype(jnp.float32) ** 2).sum()
+
+        trace_one(
+            f"flash fwd+bwd bq{bq} bk{bk}",
+            jax.jit(jax.grad(loss, argnums=(0, 1, 2))), q, k, v,
+        )
+
+
+if __name__ == "__main__":
+    main()
