@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import statistics
 import time
+import warnings
 from typing import Dict, List, Optional, Sequence
 
 from gpuschedule_tpu.models import MODEL_CONFIGS
@@ -104,6 +105,17 @@ def _mesh_trainer(
         # batch must split into M microbatches whose size divides dp
         bs = max(batch_size - batch_size % (num_microbatches * dp),
                  num_microbatches * dp)
+        if bs != batch_size:
+            # same cross-k comparability hazard as the dp-branch warning
+            # below, at pipeline granularity (num_microbatches * dp)
+            warnings.warn(
+                f"batch {batch_size} not divisible by microbatches*dp="
+                f"{num_microbatches * dp}: measuring batch {bs} instead — "
+                f"step times at this k are NOT comparable to ks that kept "
+                f"the requested batch; use a batch size divisible by "
+                f"num_microbatches * every profiled dp",
+                stacklevel=3,
+            )
         trainer = PipelinedLM(
             model_name, mesh, batch_size=bs, seq_len=seq_len,
             num_microbatches=num_microbatches,
@@ -113,6 +125,19 @@ def _mesh_trainer(
         bs = batch_size
         if bs % dp != 0:
             bs = max(dp, bs - bs % dp)
+            # A silent round-down poisons cross-k comparisons: a curve fit
+            # over ks where some points secretly ran a smaller global batch
+            # mixes workloads (the round-5 hold-out failure: ks {3, 6}
+            # measured batch 6 against batch-8 fit points and broke the
+            # 10% MAPE band).  Warn so operators pick a batch every k
+            # divides (e.g. lcm of the ks) instead of trusting the bias.
+            warnings.warn(
+                f"batch {batch_size} not divisible by dp={dp}: measuring "
+                f"batch {bs} instead — step times at this k are NOT "
+                f"comparable to ks that kept the full batch; use a batch "
+                f"size divisible by every profiled k",
+                stacklevel=3,
+            )
         trainer = ShardedTrainer(
             model_name, mesh, batch_size=bs, seq_len=seq_len, seq_shard=seq_shard
         )

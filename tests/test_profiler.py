@@ -4,6 +4,7 @@ contract on synthetic data (BASELINE.json), cache roundtrip, and a real
 """
 
 import math
+import warnings
 
 import pytest
 
@@ -168,6 +169,14 @@ def test_holdout_mape_on_measured_points():
     statistically void, but predicting unseen interior points from 4 is a
     real generalization test.  Run-to-run noise on this box is ~5-7%, so
     the 10% band is a genuine (not vacuous) bar.
+
+    Batch is 24 — divisible by EVERY k in play — because the harness
+    rounds a non-dividing batch down (8 at k=3 silently measured batch
+    6), which handed the fit a mixed-workload curve no smooth family
+    should explain: the round-5 full-suite failure was exactly that, a
+    12% "MAPE" that was really a 25%-smaller workload at the hold-out
+    ks.  The harness now warns on the round-down; this test must never
+    trigger it.
     """
     jax = pytest.importorskip("jax")
     from gpuschedule_tpu.profiler.harness import measure_step_time
@@ -181,10 +190,16 @@ def test_holdout_mape_on_measured_points():
         # poisons a single block).  A min-of-3-separate-calls variant was
         # tried first: equally robust but 3x the cost, because each call
         # rebuilds the trainer and recompiles (~8 min of a ~25-min suite)
-        return measure_step_time(
-            "transformer-tiny", devices=jax_devs[:k], batch_size=8,
-            seq_len=32, iters=10, repeats=4,
-        )
+        with warnings.catch_warnings():
+            # no silent resize — pinned to the harness's message so an
+            # unrelated jax/numpy UserWarning can't fail the contract
+            warnings.filterwarnings(
+                "error", message="batch .* not divisible"
+            )
+            return measure_step_time(
+                "transformer-tiny", devices=jax_devs[:k], batch_size=24,
+                seq_len=32, iters=10, repeats=4,
+            )
 
     fit_ks = [1, 2, 4, 8]
     holdout_ks = [3, 6]
