@@ -159,14 +159,17 @@ def write_report(
             w.writerow(["jct_seconds", "cum_fraction"])
             w.writerows(jct_cdf(res))
     lines = [
-        "| config | avg JCT (s) | makespan (s) | p95 queue (s) | util | finished | rejected |",
-        "|---|---|---|---|---|---|---|",
+        "| config | avg JCT (s) | makespan (s) | p95 queue (s) | "
+        "p95 slowdown | util | finished | rejected |",
+        "|---|---|---|---|---|---|---|---|",
     ]
     for name in sorted(results):
         s = summary[name]
         lines.append(
             f"| {name} | {s['avg_jct']:.1f} | {s['makespan']:.1f} | "
-            f"{s['p95_queueing_delay']:.1f} | {s['mean_utilization']:.3f} | "
+            f"{s['p95_queueing_delay']:.1f} | "
+            f"{s['p95_slowdown']:.2f} | "
+            f"{s['mean_utilization']:.3f} | "
             f"{int(s['num_finished'])} | {int(s.get('num_rejected', 0))} |"
         )
     if extra and "acceptance" in extra:
