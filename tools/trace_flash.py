@@ -41,9 +41,10 @@ from gpuschedule_tpu.ops.reference import dense_attention
 ITERS = 10
 
 
-def device_times(trace_dir: str) -> dict:
+def device_times(trace_dir: str, iters: int = ITERS) -> dict:
     """Aggregate complete-event durations on the /device: plane of the
-    chrome trace xprof wrote under ``trace_dir``."""
+    chrome trace xprof wrote under ``trace_dir``, per-iteration over
+    ``iters`` traced invocations."""
     paths = glob.glob(
         os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
     )
@@ -71,25 +72,34 @@ def device_times(trace_dir: str) -> dict:
         key=lambda kv: -kv[1],
     )[:6]
     return {
-        "device_ms_per_iter": round(total_us / ITERS / 1e3, 3),
+        "device_ms_per_iter": round(total_us / iters / 1e3, 3),
         "top_device_ops_ms_per_iter": {
-            k[:48]: round(v / ITERS / 1e3, 3) for k, v in ops
+            k[:48]: round(v / iters / 1e3, 3) for k, v in ops
         },
     }
 
 
-def trace_one(name: str, fn, *args) -> None:
-    jax.block_until_ready(fn(*args))  # compile outside the trace
-    d = tempfile.mkdtemp(prefix=f"trace_{name}_")
+def capture_device_record(fn, *args, iters: int = ITERS) -> dict:
+    """Warm up (compile OUTSIDE the trace — capture streaming over the
+    tunnel is slow enough without a compile in it), trace ``iters``
+    invocations, and return the :func:`device_times` record.  The one
+    capture loop shared by this tool and bench.py's flash smoke."""
+    jax.block_until_ready(fn(*args))
+    d = tempfile.mkdtemp(prefix="trace_cap_")
     try:
         with jax.profiler.trace(d):
             out = None
-            for _ in range(ITERS):
+            for _ in range(iters):
                 out = fn(*args)
             jax.block_until_ready(out)
-        rec = {"case": name, "iters": ITERS, **device_times(d)}
+        return device_times(d, iters=iters)
     finally:
         shutil.rmtree(d, ignore_errors=True)
+
+
+def trace_one(name: str, fn, *args) -> None:
+    rec = {"case": name, "iters": ITERS,
+           **capture_device_record(fn, *args, iters=ITERS)}
     print(json.dumps(rec), flush=True)
 
 
