@@ -284,8 +284,10 @@ def _q_fetch_idx(block_q: int, block_k: int, causal: bool):
 def _effective_blocks(s: int, block_q: int, block_k: int) -> tuple[int, int]:
     """Clamp block sizes to the sequence rounded up to one lane tile, so
     large defaults never force a short sequence to pad to lcm(blocks).
-    When the clamped pair's common multiple still overshoots that cap
-    (mismatched sizes, e.g. (256, 384) for S=300 -> lcm 768), collapse to
+    When the clamped pair's PADDED length — S rounded up to one lcm
+    multiple — still overshoots that cap (mismatched sizes, e.g.
+    (256, 384) for S=300 -> lcm 768; or (64, 96) for S=193, whose lcm
+    192 fits the 256 cap but whose padding rounds to 384), collapse to
     one full-sequence tile pair — strictly less padded work than padding
     past the lane round-up — but only while cap stays at or below the
     default block_k scale (<= 1024, a 4 MB f32 score tile + K/V
@@ -300,7 +302,13 @@ def _effective_blocks(s: int, block_q: int, block_k: int) -> tuple[int, int]:
     layout aligned with the forward's saved lse."""
     cap = -(-s // LANES) * LANES
     bq, bk = min(block_q, cap), min(block_k, cap)
-    if math.lcm(bq, bk) > cap and cap <= 1024:
+    # Collapse when the PADDED length (S rounded up to one lcm multiple)
+    # overshoots the lane round-up — not merely when the lcm itself does:
+    # lcm(64, 96)=192 <= cap=256 at S=193, yet padding rounds 193 up to
+    # 384, 1.5x the rows a (cap, cap) tile needs.  (Hypothesis-found,
+    # tests/test_flash_attention.py::test_effective_blocks_properties.)
+    pad = s + (-s) % math.lcm(bq, bk)
+    if pad > cap and cap <= 1024:
         bq = bk = cap
     return bq, bk
 

@@ -125,10 +125,11 @@ def test_flash_gradients_long_context():
 
 def test_effective_blocks_never_pad_past_lane_roundup():
     """The padding contract behind the block clamp: whatever blocks the
-    caller asks for, the effective pair's common multiple (= the padded
-    sequence length) never exceeds S rounded up to one lane tile —
-    mismatched clamped pairs like (256, 384) at S=300 must collapse
-    rather than pad to lcm 768."""
+    caller asks for, the padded sequence length (S rounded up to one
+    multiple of the effective pair's lcm) never exceeds S rounded up to
+    one lane tile — mismatched clamped pairs like (256, 384) at S=300
+    must collapse rather than pad to lcm 768.  (The lcm alone is not
+    the padded length: see the hypothesis property test below.)"""
     import math
 
     from gpuschedule_tpu.ops.flash_attention import LANES, _effective_blocks
@@ -149,6 +150,52 @@ def test_effective_blocks_never_pad_past_lane_roundup():
         np.asarray(_reference(q, k, v, True)),
         atol=3e-5, rtol=3e-5,
     )
+
+
+def test_effective_blocks_properties():
+    """Hypothesis sweep of the clamp contract over arbitrary (s, bq, bk):
+
+    1. deterministic (the backward recomputes the identical clamp — a
+       divergence would misalign its padded layout with the saved lse);
+    2. effective blocks never exceed the requested ones (the clamp only
+       shrinks or collapses-to-cap, it never invents a bigger tile);
+    3. lane alignment: each effective block is a multiple of LANES or
+       the caller's own sub-lane request passed through unchanged;
+    4. the padded length (one lcm multiple covering S) never exceeds
+       BOTH bounds the docstring promises: the lane round-up when the
+       collapse applies (cap <= 1024), and the caller's own lcm padding
+       otherwise.
+    """
+    import math
+
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    from gpuschedule_tpu.ops.flash_attention import LANES, _effective_blocks
+
+    blocks = st.sampled_from([64, 96, 128, 256, 384, 512, 768, 1024, 2048])
+
+    @settings(max_examples=300, deadline=None)
+    @given(
+        s=st.integers(min_value=1, max_value=8192),
+        bq=blocks,
+        bk=blocks,
+    )
+    def check(s, bq, bk):
+        cap = -(-s // LANES) * LANES
+        ebq, ebk = _effective_blocks(s, bq, bk)
+        assert (ebq, ebk) == _effective_blocks(s, bq, bk)          # (1)
+        assert ebq <= max(bq, cap) and ebk <= max(bk, cap)         # (2)
+        for eff, req in ((ebq, bq), (ebk, bk)):
+            assert eff % LANES == 0 or eff == min(req, cap)        # (3)
+        pad = s + ((-s) % math.lcm(ebq, ebk))
+        if cap <= 1024:
+            assert pad <= max(cap, s), (s, bq, bk, ebq, ebk)       # (4a)
+        else:
+            req_pad = s + ((-s) % math.lcm(min(bq, cap), min(bk, cap)))
+            assert pad <= req_pad, (s, bq, bk, ebq, ebk)           # (4b)
+
+    check()
 
 
 @pytest.mark.parametrize("causal", [True, False])
