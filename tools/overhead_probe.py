@@ -1,14 +1,21 @@
 """Measure pallas-TPU per-grid-step overhead directly (ROOFLINE.md).
 
-The roofline analysis attributes the flash kernels' 5.6x gap to a fixed
-~1.4 us cost per grid step.  This probe tests that hypothesis in
-isolation: a kernel whose per-step compute is negligible (one small VMEM
+HISTORICAL FRAMING CAVEAT (round-5 device-trace resolution): this probe
+measures WALL time, which the later xprof device-plane capture
+(tools/trace_flash.py, TRACE_r05.jsonl) showed to be device time plus a
+session-varying per-DISPATCH tunnel constant.  The slope of wall time
+vs grid steps still isolates the genuine on-device per-step cost (the
+dispatch constant lands in the regression's intercept, one per call),
+so the probe's slopes remain meaningful — but its absolute intercepts
+are transport, and cross-session comparisons of them are meaningless.
+
+Method: a kernel whose per-step compute is negligible (one small VMEM
 copy) run at geometrically growing grid sizes — the slope of time vs
-steps IS the per-step overhead, with the kernel's work subtracted out by
-the regression's intercept.  A second sweep with a matmul per step
-separates "overhead per step" from "pipeline drain" effects, and a
-third runs the same grids under dimension_semantics=parallel to price
-what declaring independence buys.
+steps IS the per-step overhead, with the kernel's fixed work and the
+dispatch constant subtracted out by the regression's intercept.  A
+second sweep with a matmul per step separates "overhead per step" from
+"pipeline drain" effects, and a third runs the same grids under
+dimension_semantics=parallel to price what declaring independence buys.
 
 One JSON line per point; operator-invoked on the real chip:
 
