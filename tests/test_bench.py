@@ -228,6 +228,36 @@ def test_end_to_end_success_on_cpu_backend():
     assert "transformer-tiny" in parsed["metric"]
 
 
+@pytest.mark.slow
+def test_flash_smoke_child_end_to_end_on_cpu():
+    """The real --flash-smoke child (parity, kernel-vs-dense, the round-5
+    device-trace stage, train step) runs end-to-end off-TPU: ~39 s with
+    transformer-tiny.  Off-TPU the line must not claim a chip or an MFU,
+    the device ratio degrades to null (no TPU device plane in the trace),
+    and the interpret fallback reports compiled=false."""
+    # child watchdog (300) strictly below the outer bound so a hang
+    # surfaces as the parent's labeled flash-smoke-failed line, never as
+    # a context-free TimeoutExpired
+    env = _cpu_env(GSTPU_FLASH_MODEL="transformer-tiny",
+                   GSTPU_BENCH_TIMEOUT="300")
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--flash-smoke"],
+        capture_output=True, text=True, env=env, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    parsed = _one_json_line(proc.stdout)
+    assert parsed["backend"] == "cpu"
+    assert parsed["compiled"] is False
+    assert "interpret-mode pallas" in parsed["metric"]
+    assert "MFU n/a off-TPU" in parsed["metric"]
+    assert "v5e" not in parsed["metric"] and "v5p" not in parsed["metric"]
+    assert "mfu" not in parsed  # off-TPU: the key is absent, not 0.0
+    assert parsed["vs_baseline"] == 0.0
+    assert parsed["kernel_speedup_vs_dense"] > 0
+    assert parsed["kernel_speedup_vs_dense_device"] is None
+    assert parsed["fwd_maxerr"] < 2e-2 and parsed["bwd_relerr"] < 2e-2
+
+
 def test_hung_child_is_killed_and_reported():
     """A child that can never finish inside the timeout must be SIGKILLed
     and the parent must still emit the diagnostic line, promptly."""
