@@ -123,6 +123,26 @@ def test_round_wakeup_reorders_between_events():
     )
 
 
+def test_themis_philly_replay_golden():
+    """Themis over the Philly schema: failed/killed terminal statuses and
+    whale gangs flow through the same rho ordering without special cases;
+    the pinned numbers freeze the behavior (the golden-test strategy of
+    test_golden_configs.py, policy #6 edition)."""
+    from gpuschedule_tpu.cluster import TpuCluster
+    from gpuschedule_tpu.sim.philly import load_philly_csv
+    from pathlib import Path
+
+    data = Path(__file__).resolve().parent.parent / "data" / "philly_sample.csv"
+    res = Simulator(
+        TpuCluster("v5e", dims=(8, 8)), make_policy("themis"),
+        load_philly_csv(data),
+    ).run()
+    assert res.num_unfinished == 0 and res.num_finished == 300
+    assert res.avg_jct == pytest.approx(10595.12827, rel=1e-9)
+    assert res.makespan == pytest.approx(321402.79799999995, rel=1e-9)
+    assert res.max_slowdown == pytest.approx(3.686721433532088, rel=1e-9)
+
+
 def test_themis_rejects_bad_round():
     with pytest.raises(ValueError):
         ThemisPolicy(round_s=0.0)
