@@ -24,4 +24,4 @@ Layering (SURVEY.md §1):
     ops/        pallas TPU kernels for the benchmark models
 """
 
-__version__ = "0.5.0"
+__version__ = "0.5.1"
