@@ -77,6 +77,15 @@ def _attempt_plan():
 RETRY_PAUSE_S = 5.0
 
 
+def _detect_generation(device_kind: str) -> str:
+    """Map a jax ``device_kind`` string to a GENERATIONS key — ONE copy
+    so a new generation or a heuristic fix lands in every child at once
+    (child_main, _flash_line, child_longctx all call this; the
+    _flash_line unit tests pin it)."""
+    kind = device_kind.lower()
+    return "v5p" if "v5p" in kind or "v5 pod" in kind else "v5e"
+
+
 def _stage(msg: str) -> None:
     """Child-side progress marker; the parent reports the last one seen when
     an attempt times out, turning a silent hang into a located hang."""
@@ -130,8 +139,7 @@ def child_main(model: str) -> None:
     achieved_tflops = flops_per_step / step_s / 1e12
 
     if jax.default_backend() == "tpu":
-        kind = getattr(dev, "device_kind", "").lower()
-        gen = "v5p" if "v5p" in kind or "v5 pod" in kind else "v5e"
+        gen = _detect_generation(getattr(dev, "device_kind", ""))
         peak_tflops = GENERATIONS[gen]["bf16_tflops"]
         mfu = achieved_tflops / peak_tflops
         tail = f"mfu={mfu:.3f} @ {achieved_tflops:.1f} TF on {gen}"
@@ -285,16 +293,33 @@ def child_flash(model: str) -> None:
     tokens_per_s = toks / step_s
     # attention-aware FLOPs: at S=4096 the 6N figure misses most of the work
     achieved_tflops = cfg.flops_per_token_attn(seq) * toks / step_s / 1e12
+    kind = getattr(dev, "device_kind", "").lower()
+    line = _flash_line(
+        model=model, seq=seq, s_time=s_time, backend=backend,
+        device_kind=kind, compiled=compiled, achieved_tflops=achieved_tflops,
+        tokens_per_s=tokens_per_s, kernel_speedup=kernel_speedup,
+        device_speedup=device_speedup, fwd_err=fwd_err, bwd_err=bwd_err,
+        generations=GENERATIONS,
+    )
+    print(json.dumps(line), flush=True)
+
+
+def _flash_line(
+    *, model, seq, s_time, backend, device_kind, compiled, achieved_tflops,
+    tokens_per_s, kernel_speedup, device_speedup, fwd_err, bwd_err,
+    generations,
+) -> dict:
+    """Pure formatter for the flash-smoke JSON line, unit-testable off-TPU
+    (tests/test_bench.py): the TPU branch claims a generation and carries
+    an ``mfu`` key; off-TPU the key is ABSENT (not 0.0), vs_baseline is
+    zeroed, and the backend is named — child_main's honesty rules.  The
+    mode word follows the actual interpret fallback."""
     if backend == "tpu":
-        kind = getattr(dev, "device_kind", "").lower()
-        gen = "v5p" if "v5p" in kind or "v5 pod" in kind else "v5e"
-        mfu = achieved_tflops / GENERATIONS[gen]["bf16_tflops"]
+        gen = _detect_generation(device_kind)
+        mfu = achieved_tflops / generations[gen]["bf16_tflops"]
         where = f"on {gen}: mfu={mfu:.3f}"
         vsb = round(mfu / TARGET_MFU, 3)
     else:
-        # CPU-sanity runs (tests, outages) must not claim a chip or an
-        # MFU — same honesty rule as child_main's off-TPU tail: no mfu
-        # key at all, vs_baseline zeroed, backend named in the metric
         mfu = None
         where = f"backend={backend}; MFU n/a off-TPU:"
         vsb = 0.0
@@ -317,7 +342,7 @@ def child_flash(model: str) -> None:
     }
     if mfu is not None:
         line["mfu"] = round(mfu, 3)
-    print(json.dumps(line), flush=True)
+    return line
 
 
 def child_longctx(model: str) -> None:
@@ -358,8 +383,7 @@ def child_longctx(model: str) -> None:
     # 6N alone understates long-context FLOPs ~5x: attention matmuls
     # dominate at S=32k, so MFU uses the attention-aware estimate
     achieved_tflops = cfg.flops_per_token_attn(seq) * seq / step_s / 1e12
-    kind = getattr(dev, "device_kind", "").lower()
-    gen = "v5p" if "v5p" in kind or "v5 pod" in kind else "v5e"
+    gen = _detect_generation(getattr(dev, "device_kind", ""))
     mfu = achieved_tflops / GENERATIONS[gen]["bf16_tflops"]
 
     def line(dense_feasible):

@@ -228,6 +228,37 @@ def test_end_to_end_success_on_cpu_backend():
     assert "transformer-tiny" in parsed["metric"]
 
 
+def test_flash_line_tpu_branch_formatting():
+    """The TPU branch of the flash-smoke line cannot be chip-verified
+    during a tunnel outage, so the pure formatter is pinned here: a v5e
+    device yields an mfu key, a generation claim, and a proportional
+    vs_baseline; the same inputs off-TPU drop the mfu key entirely."""
+    kwargs = dict(
+        model="m", seq=4096, s_time=4096, device_kind="tpu v5 lite",
+        compiled=True, achieved_tflops=19.7, tokens_per_s=1000.0,
+        kernel_speedup=2.0, device_speedup=5.6, fwd_err=1e-3, bwd_err=1e-3,
+        generations={"v5e": {"bf16_tflops": 197.0},
+                     "v5p": {"bf16_tflops": 459.0}},
+    )
+    tpu = bench._flash_line(backend="tpu", **kwargs)
+    assert tpu["mfu"] == pytest.approx(0.1)
+    assert "on v5e: mfu=0.100" in tpu["metric"]
+    assert "compiled pallas" in tpu["metric"]
+    assert tpu["vs_baseline"] == pytest.approx(round(0.1 / bench.TARGET_MFU, 3))
+    assert tpu["kernel_speedup_vs_dense_device"] == 5.6
+    v5p = bench._flash_line(
+        backend="tpu", **{**kwargs, "device_kind": "tpu v5p chip"}
+    )
+    assert "on v5p" in v5p["metric"]
+    cpu = bench._flash_line(
+        backend="cpu", **{**kwargs, "compiled": False}
+    )
+    assert "mfu" not in cpu
+    assert cpu["vs_baseline"] == 0.0
+    assert "interpret-mode pallas" in cpu["metric"]
+    assert "MFU n/a off-TPU" in cpu["metric"]
+
+
 @pytest.mark.slow
 def test_flash_smoke_child_end_to_end_on_cpu():
     """The real --flash-smoke child (parity, kernel-vs-dense, the round-5
