@@ -108,21 +108,83 @@ def build_policy(args):
 
 
 def cmd_run(args) -> int:
+    from pathlib import Path
+
     from gpuschedule_tpu.sim.metrics import MetricsLog
 
     if args.events and not args.out:
         raise SystemExit("--events requires --out (the stream is only persisted)")
+    from gpuschedule_tpu.obs import get_tracer
+
+    # --spans enables the tracer; GSTPU_TRACE=1 enables it at import.  Either
+    # way an enabled tracer gets its spans reported below — a run must never
+    # collect spans it then silently drops.
+    if args.spans:
+        get_tracer().enable().reset()
+    tracer = get_tracer() if get_tracer().enabled else None
+    registry = None
+    if args.out or args.prom:
+        from gpuschedule_tpu.obs import MetricsRegistry
+
+        registry = MetricsRegistry()
     cluster = build_cluster(args)
     jobs = load_jobs(args)
+    # With --events + --out the stream goes straight to its JSONL sink
+    # (constant memory at Philly scale); --perfetto alone buffers events in
+    # RAM just long enough to convert them.
+    events_sink = (
+        Path(args.out) / f"{args.prefix}events.jsonl" if args.events else None
+    )
+    metrics = MetricsLog(
+        record_events=args.events or bool(args.perfetto),
+        events_sink=events_sink,
+        registry=registry,
+    )
     sim = Simulator(
         cluster, build_policy(args), jobs,
-        metrics=MetricsLog(record_events=args.events),
+        metrics=metrics,
         max_time=args.max_time or float("inf"),
     )
     res = sim.run()
     print(json.dumps(res.summary(), sort_keys=True))
     if args.out:
         sim.metrics.write(args.out, prefix=args.prefix)
+    else:
+        metrics.close_events()
+    if args.perfetto:
+        from gpuschedule_tpu.obs import export_chrome_trace, load_events_jsonl
+
+        events = (
+            load_events_jsonl(events_sink) if events_sink is not None
+            else metrics.events
+        )
+        export_chrome_trace(events, args.perfetto)
+    if registry is not None:
+        if args.prom:
+            registry.write(prom_path=args.prom)
+        if args.out:
+            registry.write(
+                prom_path=Path(args.out) / f"{args.prefix}metrics.prom",
+                json_path=Path(args.out) / f"{args.prefix}metrics.json",
+            )
+    if tracer is not None:
+        if args.out:
+            tracer.write_chrome(Path(args.out) / f"{args.prefix}spans.trace.json")
+        print(json.dumps({"spans": tracer.summary()}, sort_keys=True),
+              file=sys.stderr)
+    return 0
+
+
+def cmd_obs_export(args) -> int:
+    """Convert a persisted events.jsonl into a ui.perfetto.dev-loadable
+    Chrome trace-event file (the offline half of `run --perfetto`)."""
+    from gpuschedule_tpu.obs import export_chrome_trace, load_events_jsonl
+
+    doc = export_chrome_trace(load_events_jsonl(args.events), args.out)
+    print(json.dumps({
+        "trace": str(args.out),
+        "trace_events": len(doc["traceEvents"]),
+    }, sort_keys=True))
     return 0
 
 
@@ -500,6 +562,14 @@ def cmd_train(args) -> int:
             sort_keys=True,
         )
     )
+    from gpuschedule_tpu.obs import get_tracer
+
+    if get_tracer().enabled:
+        # per-step spans (parallel/train.py) aggregated: fenced step times
+        # and tokens/s for the whole command, on stderr so the stdout JSON
+        # contract above stays one line
+        print(json.dumps({"spans": get_tracer().summary()}, sort_keys=True),
+              file=sys.stderr)
     return 0
 
 
@@ -592,7 +662,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     run.add_argument("--prefix", default="")
     run.add_argument("--events", action="store_true",
                      help="record a structured events.jsonl stream (opt-in: "
-                          "~1 record per state transition)")
+                          "~1 record per state transition; streamed "
+                          "incrementally, constant memory)")
+    run.add_argument("--perfetto", metavar="PATH",
+                     help="export the replay as a Chrome/Perfetto trace "
+                          "(one track per pod/slice, one slice per job "
+                          "occupancy interval); implies event recording")
+    run.add_argument("--spans", action="store_true",
+                     help="enable the obs span tracer (engine batches + "
+                          "policy invocations); writes spans.trace.json "
+                          "under --out and prints a span summary to stderr")
+    run.add_argument("--prom", metavar="PATH",
+                     help="write run counters/gauges/histograms in the "
+                          "Prometheus text exposition format (with --out, "
+                          "metrics.prom/metrics.json are written there too)")
     run.set_defaults(fn=cmd_run)
 
     gen = sub.add_parser("gen-trace", help="write a synthetic trace CSV")
@@ -673,6 +756,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     tr.add_argument("--ckpt", help="save final state here (orbax)")
     tr.add_argument("--restore", help="resume from this checkpoint")
     tr.set_defaults(fn=cmd_train)
+
+    obs = sub.add_parser("obs", help="observability utilities (trace export)")
+    obs_sub = obs.add_subparsers(dest="obs_cmd", required=True)
+    exp = obs_sub.add_parser(
+        "export",
+        help="convert a run's events.jsonl into a ui.perfetto.dev-loadable "
+             "Chrome trace-event JSON",
+    )
+    exp.add_argument("--events", required=True, metavar="EVENTS_JSONL",
+                     help="events.jsonl written by `run --events --out`")
+    exp.add_argument("--out", required=True, metavar="TRACE_JSON")
+    exp.set_defaults(fn=cmd_obs_export)
 
     prof = sub.add_parser("profile", help="fit goodput curves on live devices")
     prof.add_argument("--model", action="append", required=True)

@@ -1,0 +1,52 @@
+"""Observability layer: span tracer, metrics registry, timeline export.
+
+A zero-dependency, near-zero-cost-when-disabled telemetry substrate shared
+by every layer (ISSUE 1 tentpole; the measurement prerequisite for the
+ROADMAP's production-scale north star):
+
+- :mod:`gpuschedule_tpu.obs.tracer` — nested wall/sim-time spans behind a
+  process-wide singleton; disabled by default (``GSTPU_TRACE=1`` or
+  ``run --spans`` turns it on);
+- :mod:`gpuschedule_tpu.obs.metrics` — labeled counters/gauges/histograms
+  with Prometheus text + JSON exposition, absorbed by ``MetricsLog``;
+- :mod:`gpuschedule_tpu.obs.perfetto` — Chrome trace-event export of a
+  replay's event stream (one track per pod/slice, one slice per occupancy
+  interval), loadable in ui.perfetto.dev.
+
+Like the sim core, this package must stay jax-free: replay observability
+cannot pull an accelerator stack into the loop (tests/test_overhead.py
+pins the import boundary).
+"""
+
+from gpuschedule_tpu.obs.tracer import NULL_SPAN, Span, Tracer, get_tracer
+from gpuschedule_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from gpuschedule_tpu.obs.perfetto import (
+    export_chrome_trace,
+    load_events_jsonl,
+    trace_events,
+    track_label,
+    validate_chrome_trace,
+)
+
+__all__ = [
+    "NULL_SPAN",
+    "Span",
+    "Tracer",
+    "get_tracer",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "export_chrome_trace",
+    "load_events_jsonl",
+    "trace_events",
+    "track_label",
+    "validate_chrome_trace",
+]

@@ -1,0 +1,367 @@
+"""Metrics registry: labeled counters/gauges/histograms, Prometheus + JSON.
+
+The aggregation half of the observability layer (ISSUE 1 tentpole): where
+:class:`~gpuschedule_tpu.sim.metrics.MetricsLog` is a per-run recorder (CSV
+rows, event stream), this registry is a process-level surface in the
+Prometheus data model — monotone counters, point-in-time gauges, and
+bucketed histograms, each optionally labeled — with two exports:
+
+- :meth:`MetricsRegistry.prometheus_text`: the text exposition format, the
+  thing a scrape endpoint would serve (``# HELP`` / ``# TYPE`` / samples);
+- :meth:`MetricsRegistry.to_json`: the same state as one JSON document for
+  artifact files next to the run's CSVs.
+
+``MetricsLog`` absorbs this registry when constructed with one: its
+``counters`` keep working exactly as before (the BASELINE summary contract),
+and every ``count()``/``sample()`` additionally feeds the registry, which is
+how a replay's counters reach the Prometheus surface without a second
+bookkeeping path.
+
+Zero dependencies, thread-safe (one lock per metric family), and dormant
+unless something asks for a registry — nothing global is updated during an
+un-instrumented run.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Prometheus' default histogram buckets, trimmed to the second-to-minutes
+# range scheduling telemetry actually spans.
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0, 600.0, math.inf
+)
+
+_VALID_FIRST = set("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ_:")
+_VALID_REST = _VALID_FIRST | set("0123456789")
+
+
+def sanitize_name(name: str) -> str:
+    """Coerce an arbitrary key into a legal Prometheus metric name."""
+    out = "".join(c if c in _VALID_REST else "_" for c in name)
+    if not out or out[0] not in _VALID_FIRST:
+        out = "_" + out
+    return out
+
+
+def _fmt_labels(labelnames: Sequence[str], labelvalues: Sequence[str]) -> str:
+    if not labelnames:
+        return ""
+    inner = ",".join(
+        f'{k}="{str(v).replace(chr(92), chr(92) * 2).replace(chr(34), chr(92) + chr(34))}"'
+        for k, v in zip(labelnames, labelvalues)
+    )
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+class _Metric:
+    """One metric family: a name, help text, label schema, and its children
+    (one child per distinct label-value tuple; the unlabeled family is its
+    own single child keyed by ``()``)."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", labelnames: Sequence[str] = ()):
+        self.name = sanitize_name(name)
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._children: Dict[Tuple[str, ...], "_Metric"] = {}
+        self._lock = threading.Lock()
+        self._labelvalues: Tuple[str, ...] = ()
+
+    def labels(self, *values, **kv) -> "_Metric":
+        """The child for one label-value combination (created on first use)."""
+        if kv:
+            if values:
+                raise ValueError("pass label values positionally or by name, not both")
+            try:
+                values = tuple(str(kv[k]) for k in self.labelnames)
+            except KeyError as e:
+                raise ValueError(f"missing label {e} for {self.name}") from None
+            if set(kv) - set(self.labelnames):
+                raise ValueError(
+                    f"unknown labels {sorted(set(kv) - set(self.labelnames))} "
+                    f"for {self.name}"
+                )
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name} takes labels {self.labelnames}, got {values}"
+            )
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._make_child()
+                child._labelvalues = values
+                self._children[values] = child
+            return child
+
+    def _make_child(self) -> "_Metric":
+        return type(self)(self.name, self.help, ())
+
+    def _self_or_children(self) -> Iterable["_Metric"]:
+        if self.labelnames:
+            with self._lock:
+                return list(self._children.values())
+        return [self]
+
+    def _check_unlabeled(self) -> None:
+        if self.labelnames:
+            raise ValueError(
+                f"{self.name} is labeled {self.labelnames}; call .labels(...) first"
+            )
+
+    # exposition hooks ---------------------------------------------------
+    def samples(self) -> List[Tuple[str, Tuple[Tuple[str, ...], ...], float]]:
+        raise NotImplementedError
+
+    def to_json(self):
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help="", labelnames=()):
+        super().__init__(name, help, labelnames)
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        self._check_unlabeled()
+        if n < 0:
+            raise ValueError(f"counters only go up; inc({n})")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def samples(self):
+        return [
+            (self.name, c._labelvalues, c._value) for c in self._self_or_children()
+        ]
+
+    def to_json(self):
+        if not self.labelnames:
+            return self._value
+        return {
+            _fmt_labels(self.labelnames, lv) or "": c._value
+            for lv, c in self._children.items()
+        }
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help="", labelnames=()):
+        super().__init__(name, help, labelnames)
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        self._check_unlabeled()
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        self._check_unlabeled()
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def samples(self):
+        return [
+            (self.name, c._labelvalues, c._value) for c in self._self_or_children()
+        ]
+
+    def to_json(self):
+        if not self.labelnames:
+            return self._value
+        return {
+            _fmt_labels(self.labelnames, lv) or "": c._value
+            for lv, c in self._children.items()
+        }
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(), buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames)
+        bs = sorted(float(b) for b in buckets)
+        if not bs or bs[-1] != math.inf:
+            bs.append(math.inf)
+        self.buckets: Tuple[float, ...] = tuple(bs)
+        self._counts = [0] * len(self.buckets)
+        self._sum = 0.0
+        self._count = 0
+
+    def _make_child(self):
+        return Histogram(self.name, self.help, (), self.buckets)
+
+    def observe(self, v: float) -> None:
+        self._check_unlabeled()
+        with self._lock:
+            self._sum += v
+            self._count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    break
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def samples(self):
+        out = []
+        for c in self._self_or_children():
+            cum = 0
+            for b, n in zip(c.buckets, c._counts):
+                cum += n
+                le = ("+Inf" if b == math.inf else _fmt_value(b),)
+                out.append((self.name + "_bucket", c._labelvalues + ("__le__",) + le, cum))
+            out.append((self.name + "_sum", c._labelvalues, c._sum))
+            out.append((self.name + "_count", c._labelvalues, c._count))
+        return out
+
+    def to_json(self):
+        def one(c):
+            return {
+                "count": c._count,
+                "sum": c._sum,
+                "buckets": {
+                    ("+Inf" if b == math.inf else _fmt_value(b)): n
+                    for b, n in zip(c.buckets, c._counts)
+                },
+            }
+
+        if not self.labelnames:
+            return one(self)
+        return {
+            _fmt_labels(self.labelnames, lv) or "": one(c)
+            for lv, c in self._children.items()
+        }
+
+
+class MetricsRegistry:
+    """A named collection of metric families with idempotent constructors:
+    ``counter("x")`` returns the same family on every call, and re-declaring
+    a name as a different kind or label schema is an error (the same contract
+    prometheus_client enforces)."""
+
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_make(self, cls, name: str, help: str, labelnames, **kw) -> _Metric:
+        name = sanitize_name(name)
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, labelnames, **kw)
+                self._metrics[name] = m
+                return m
+        if not isinstance(m, cls) or m.labelnames != tuple(labelnames):
+            raise ValueError(
+                f"{name} already registered as {m.kind}{m.labelnames}; "
+                f"requested {cls.kind}{tuple(labelnames)}"
+            )
+        want_buckets = kw.get("buckets")
+        if want_buckets is not None:
+            bs = sorted(float(b) for b in want_buckets)
+            if not bs or bs[-1] != math.inf:
+                bs.append(math.inf)
+            if tuple(bs) != m.buckets:
+                raise ValueError(
+                    f"{name} already registered with buckets {m.buckets}; "
+                    f"requested {tuple(bs)}"
+                )
+        return m
+
+    def counter(self, name: str, help: str = "", labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_make(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "", labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_make(Gauge, name, help, labelnames)
+
+    def histogram(
+        self, name: str, help: str = "", labelnames: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._get_or_make(Histogram, name, help, labelnames, buckets=buckets)
+
+    # ------------------------------------------------------------------ #
+    # exposition
+
+    def prometheus_text(self) -> str:
+        """The Prometheus text exposition format — what a ``/metrics``
+        scrape endpoint would serve for this registry."""
+        lines: List[str] = []
+        with self._lock:
+            families = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in families:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for sample_name, labelvalues, value in m.samples():
+                # histogram buckets smuggle the 'le' label via the
+                # ("__le__", v) convention in Histogram.samples
+                if "__le__" in labelvalues:
+                    i = labelvalues.index("__le__")
+                    names = m.labelnames + ("le",)
+                    values = labelvalues[:i] + (labelvalues[i + 1],)
+                else:
+                    names, values = m.labelnames, labelvalues
+                lines.append(
+                    f"{sample_name}{_fmt_labels(names, values)} {_fmt_value(value)}"
+                )
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def to_json(self) -> Dict[str, dict]:
+        """One JSON document: {name: {kind, help, value|children}}."""
+        with self._lock:
+            families = sorted(self._metrics.values(), key=lambda m: m.name)
+        return {
+            m.name: {"kind": m.kind, "help": m.help, "value": m.to_json()}
+            for m in families
+        }
+
+    def write(self, prom_path=None, json_path=None) -> None:
+        if prom_path is not None:
+            with open(prom_path, "w") as f:
+                f.write(self.prometheus_text())
+        if json_path is not None:
+            with open(json_path, "w") as f:
+                json.dump(self.to_json(), f, indent=2, sort_keys=True)
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """Process-wide default registry (tests construct their own)."""
+    return _REGISTRY

@@ -17,6 +17,7 @@ HBM holds one copy of the state.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Any, Optional, Tuple
 
@@ -28,6 +29,7 @@ from jax.sharding import PartitionSpec as P
 
 from gpuschedule_tpu.models import build_model
 from gpuschedule_tpu.models.config import CnnConfig
+from gpuschedule_tpu.obs.tracer import get_tracer
 
 
 def make_optimizer(
@@ -300,10 +302,37 @@ class ShardedTrainer:
         return jax.device_put(tokens, self.batch_sharding)
 
     def step(self, state, tokens):
-        """One optimizer step; returns (new_state, loss)."""
+        """One optimizer step; returns (new_state, loss).
+
+        With the obs tracer enabled, every step is recorded as a span with
+        step-time and tokens/s.  The span is fenced by a host readback of the
+        loss (the only fence this image's transport honors — see
+        profiler/harness.py), so tracing serializes dispatch with execution:
+        honest per-step walls, at the cost of losing async overlap while the
+        tracer is on.  Tracing off (the default) is the bare jitted dispatch.
+        """
         params, opt_state = state
+        tracer = get_tracer()
+        if not tracer.enabled:
+            with self.mesh:
+                params, opt_state, loss = self._step(params, opt_state, tokens)
+            return (params, opt_state), loss
+        t0 = time.perf_counter()
         with self.mesh:
             params, opt_state, loss = self._step(params, opt_state, tokens)
+        loss_val = float(loss)  # fence: the readback makes wall time real
+        dt = time.perf_counter() - t0
+        n_tokens = self.batch_size * (1 if self.is_image else self.seq_len)
+        tracer.record(
+            "train.step",
+            wall_start=t0,
+            wall_dur=dt,
+            cat="train",
+            step_time_s=round(dt, 6),
+            tokens=n_tokens,
+            tokens_per_s=round(n_tokens / dt, 1) if dt > 0 else None,
+            loss=loss_val,
+        )
         return (params, opt_state), loss
 
     def step_fn_and_args(self, seed: int = 0):

@@ -22,6 +22,26 @@ class Policy:
         """Called once before the run starts; override for setup that needs
         the cluster/trace (e.g. Tiresias queue thresholds)."""
 
+    # ------------------------------------------------------------------ #
+    # scheduling-rationale channel (obs layer)
+
+    def explaining(self, sim) -> bool:
+        """True when rationale records should be built for this run — i.e.
+        the structured event stream is on.  Policies hoist this check once
+        per ``schedule()`` call so the disabled path never constructs a
+        rationale dict (the tools/check_overhead.py zero-overhead
+        contract)."""
+        return sim.metrics.record_events
+
+    def explain(self, rule: str, **detail) -> dict:
+        """One scheduling-rationale record: which rule fired and the numbers
+        behind it (queue rank, quantum age, goodput delta, ...).  Passed as
+        the ``why=`` argument of the engine's mutation API, which persists
+        it on the corresponding event in the run's event stream."""
+        d = {"policy": self.name, "rule": rule}
+        d.update(detail)
+        return d
+
     def schedule(self, sim) -> Optional[float]:
         """Make scheduling decisions at ``sim.now``.
 

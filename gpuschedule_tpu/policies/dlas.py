@@ -83,7 +83,17 @@ class DlasPolicy(Policy):
             self._maybe_promote(job, now)
 
         ordered = sorted(jobs, key=lambda j: (self._queue(j), j.arrival_seq))
-        apply_priority_schedule(sim, ordered, restart_overhead=self.restart_overhead)
+        apply_priority_schedule(
+            sim, ordered, restart_overhead=self.restart_overhead,
+            policy=self,
+            # which MLFQ band put the job here, and the service that earned
+            # it (quantum expiry = a higher queue index than last round)
+            detail_fn=lambda j: {
+                "queue": self._queue(j),
+                "service_chip_s": round(self._effective_service(j), 1),
+                "promotions": j.sched.get("dlas_promotions", 0),
+            },
+        )
 
         # Jobs (re)started this round are also "last seen running now".
         for job in sim.running:

@@ -25,14 +25,30 @@ class FifoPolicy(Policy):
         # ``sim.pending`` iterates in arrival order by construction (jobset.py
         # invariant; FIFO never preempts, so no job is ever re-appended out of
         # order) — no per-event sort.
+        ex = self.explaining(sim)
         if not self.backfill:
             # Head-of-line: peek the oldest pending job; each successful start
             # removes it from the set, so this is O(1) per start and O(1) per
             # blocked event — no snapshot of a possibly-huge backlog.
             while sim.pending:
-                if not sim.try_start(sim.pending[0]):
+                job = sim.pending[0]
+                why = (
+                    self.explain(
+                        "arrival-order-head",
+                        waited_s=round(sim.now - job.submit_time, 3),
+                    )
+                    if ex else None
+                )
+                if not sim.try_start(job, why=why):
                     break  # head-of-line blocks
             return None
         for job in list(sim.pending):  # backfill scans past blocked heads
-            sim.try_start(job)
+            why = (
+                self.explain(
+                    "backfill",
+                    waited_s=round(sim.now - job.submit_time, 3),
+                )
+                if ex else None
+            )
+            sim.try_start(job, why=why)
         return None

@@ -145,8 +145,20 @@ class GandivaPolicy(Policy):
         # oldest rounds first; suspend at most one victim per distinct waiter
         expired.sort(key=lambda j: j.sched.get("g_round_start", 0.0))
         n_waiters = len(sim.pending)
+        ex = self.explaining(sim)
         for job in expired[:n_waiters]:
-            sim.preempt(job, suspend=True)
+            why = (
+                self.explain(
+                    "quantum-expired",
+                    round_age_s=round(
+                        now - job.sched.get("g_round_start", job.submit_time), 3
+                    ),
+                    round_length_s=self.round_length,
+                    waiters=n_waiters,
+                )
+                if ex else None
+            )
+            sim.preempt(job, suspend=True, why=why)
             job.sched["g_wait_since"] = now
 
     def _resume_overhead(self, sim, job: Job) -> float:
@@ -155,8 +167,18 @@ class GandivaPolicy(Policy):
         return resolve_overhead(self.suspend_overhead, job, sim.cluster)
 
     def _start_waiters(self, sim, now: float) -> None:
+        ex = self.explaining(sim)
         for job in self._waiters(sim):
-            if sim.try_start(job, overhead=self._resume_overhead(sim, job)):
+            why = (
+                self.explain(
+                    "longest-waiting",
+                    waited_s=round(
+                        now - job.sched.get("g_wait_since", job.submit_time), 3
+                    ),
+                )
+                if ex else None
+            )
+            if sim.try_start(job, overhead=self._resume_overhead(sim, job), why=why):
                 job.sched["g_round_start"] = now
 
     # ------------------------------------------------------------------ #
@@ -183,7 +205,17 @@ class GandivaPolicy(Policy):
             # after in the same schedule pass, zero sim time elapsing) is the
             # single owner of the contention model for packed groups
             overhead = self._resume_overhead(sim, job)
-            if sim.try_start(job, overhead=overhead, speed=1.0, placement_hint=hint):
+            why = (
+                self.explain(
+                    "pack-low-utilization",
+                    host=host.job_id,
+                    combined_util=round(host.utilization + job.utilization, 3),
+                    threshold=self.pack_util_threshold,
+                )
+                if self.explaining(sim) else None
+            )
+            if sim.try_start(job, overhead=overhead, speed=1.0,
+                             placement_hint=hint, why=why):
                 job.sched["g_round_start"] = now
                 sim.metrics.count("packings")
                 groups = self._overlay_groups(sim)  # refresh: host now packed
@@ -221,6 +253,7 @@ class GandivaPolicy(Policy):
         by_alloc = {
             j.allocation.alloc_id: j for j in sim.running if j.allocation is not None
         }
+        ex = self.explaining(sim)
         grouped_ids = set()
         for base, overlays in groups.items():
             members = [by_alloc[a] for a in [base, *overlays] if a in by_alloc]
@@ -232,14 +265,23 @@ class GandivaPolicy(Policy):
                 # grown host) — packing no longer erases a host's growth
                 speed = self._nominal_speed(j) * factor
                 if abs(j.speed - speed) > 1e-12:
-                    sim.set_speed(j, speed)
+                    why = (
+                        self.explain(
+                            "pack-contention",
+                            combined_util=round(combined, 3),
+                            group_size=len(members),
+                        )
+                        if ex else None
+                    )
+                    sim.set_speed(j, speed, why=why)
         # jobs no longer sharing: restore nominal speed (which is the growth
         # speedup for an opportunistically grown job, not necessarily 1.0)
         for j in sim.running:
             if j.allocation is not None and j.allocation.alloc_id not in grouped_ids:
                 target = self._nominal_speed(j)
                 if j.speed != target:
-                    sim.set_speed(j, target)
+                    why = self.explain("pack-dissolved") if ex else None
+                    sim.set_speed(j, target, why=why)
 
     # ------------------------------------------------------------------ #
     # migration / defrag
@@ -268,13 +310,23 @@ class GandivaPolicy(Policy):
             (j for j in sim.running if not self._is_packed(sim, j, groups)),
             key=lambda j: (j.allocated_chips, j.arrival_seq),
         )
+        ex = self.explaining(sim)
         for job in movable:
             if budget == 0 or cluster.can_allocate(k):
                 break
             overhead = resolve_overhead(
                 self.migration_overhead, job, cluster, migration=True
             )
-            if sim.migrate(job, overhead=overhead):
+            why = (
+                self.explain(
+                    "defrag-for-blocked-waiter",
+                    waiter=head.job_id,
+                    waiter_chips=k,
+                    free_chips=cluster.free_chips,
+                )
+                if ex else None
+            )
+            if sim.migrate(job, overhead=overhead, why=why):
                 budget -= 1
 
     # ------------------------------------------------------------------ #
@@ -333,6 +385,14 @@ class GandivaPolicy(Policy):
                 chips=job.num_chips,
                 speed=1.0,
                 overhead=self.grow_overhead,
+                why=(
+                    self.explain(
+                        "shrink-for-demand",
+                        reclaimed_chips=job.allocated_chips - job.num_chips,
+                        pending=len(sim.pending),
+                    )
+                    if self.explaining(sim) else None
+                ),
             )
             self._start_waiters(sim, now)
 
@@ -371,8 +431,17 @@ class GandivaPolicy(Policy):
             # geometry may refuse the chosen box (fragmentation): halve until
             # a contiguous slice exists or growth stops being worthwhile
             while best_k > job.allocated_chips:
+                why = (
+                    self.explain(
+                        "grow-into-idle",
+                        speedup=round(best_speed, 4),
+                        idle_chips=cluster.free_chips,
+                    )
+                    if self.explaining(sim) else None
+                )
                 if sim.resize(
-                    job, chips=best_k, speed=best_speed, overhead=self.grow_overhead
+                    job, chips=best_k, speed=best_speed,
+                    overhead=self.grow_overhead, why=why,
                 ):
                     sim.metrics.count("grows")
                     break

@@ -286,20 +286,37 @@ class OptimusPolicy(Policy):
         return self._job_curve(job).speed_factor(k, job.num_chips)
 
     def _enact(self, sim, plan: Dict[str, int]) -> None:
+        ex = self.explaining(sim)
+
+        def why(job: Job, rule: str, k: int):
+            if not ex:
+                return None
+            # the marginal gain that justified (or failed to justify) the
+            # planned size: remaining-time reduction per chip of the next
+            # doubling, the quantity the greedy planner ranked on
+            d = {"planned_chips": k}
+            if k > 0:
+                d["marginal_gain_s_per_chip"] = round(self._gain(job, k), 6)
+            return self.explain(rule, **d)
+
         # shrink & evict first: frees chips (and boxes) for the growers
         for job in list(sim.running):
             k = plan.get(job.job_id, 0)
             if k == 0:
-                sim.preempt(job, suspend=False)
+                sim.preempt(job, suspend=False, why=why(job, "plan-evicted", k))
             elif k < job.allocated_chips:
                 sim.resize(
-                    job, chips=k, speed=self._speed(job, k), overhead=self.resize_overhead
+                    job, chips=k, speed=self._speed(job, k),
+                    overhead=self.resize_overhead,
+                    why=why(job, "plan-shrink", k),
                 )
         for job in list(sim.running):
             k = plan.get(job.job_id, 0)
             if k > job.allocated_chips:
                 sim.resize(
-                    job, chips=k, speed=self._speed(job, k), overhead=self.resize_overhead
+                    job, chips=k, speed=self._speed(job, k),
+                    overhead=self.resize_overhead,
+                    why=why(job, "plan-grow", k),
                 )
         for job in sorted(sim.pending, key=lambda j: j.arrival_seq):
             k = plan.get(job.job_id, 0)
@@ -314,6 +331,7 @@ class OptimusPolicy(Policy):
                     sim.try_start(
                         job, chips=k, speed=self._speed(job, k),
                         overhead=overhead + charge,
+                        why=why(job, "plan-start", k),
                     )
                     and charge > 0.0
                 ):

@@ -16,7 +16,7 @@ large gangs from starving on a fragmented pod.
 
 from __future__ import annotations
 
-from typing import Callable, List, Sequence
+from typing import Callable, List, Optional, Sequence
 
 from gpuschedule_tpu.sim.job import Job, JobState
 from gpuschedule_tpu.sim.overhead import resolve_overhead
@@ -27,6 +27,8 @@ def apply_priority_schedule(
     ordered: Sequence[Job],
     *,
     restart_overhead: float | str = 0.0,
+    policy=None,
+    detail_fn: Optional[Callable[[Job], dict]] = None,
 ) -> None:
     """Make the running set match the highest-priority prefix that fits.
 
@@ -35,6 +37,12 @@ def apply_priority_schedule(
     resumes after having run before (modeled checkpoint/restore, SURVEY.md
     §5 "Checkpoint / resume"); pass ``"auto"`` to derive the cost from the
     job's model size and slice shape (sim/overhead.py).
+
+    When ``policy`` is given and the run records events, every start /
+    preempt carries a rationale record (``Policy.explain``): the job's rank
+    in ``ordered`` plus whatever ``detail_fn(job)`` adds (the policy's
+    priority currency — remaining time, queue index, rho).  Rationale
+    construction is skipped entirely otherwise.
     """
     budget = sim.cluster.total_chips
     keep: List[Job] = []
@@ -44,10 +52,21 @@ def apply_priority_schedule(
             budget -= job.num_chips
     keep_ids = {id(j) for j in keep}
 
+    expl = None
+    if policy is not None and policy.explaining(sim):
+        ranks = {id(j): r for r, j in enumerate(ordered)}
+
+        def expl(job: Job, rule: str) -> dict:
+            detail = detail_fn(job) if detail_fn is not None else {}
+            return policy.explain(rule, rank=ranks.get(id(job)), **detail)
+
     # Preempt running losers first so their chips are free for winners.
     for job in list(sim.running):
         if id(job) not in keep_ids:
-            sim.preempt(job, suspend=False)
+            sim.preempt(
+                job, suspend=False,
+                why=expl(job, "displaced-by-priority-prefix") if expl else None,
+            )
 
     # Gang-start winners in priority order; geometry failures skip (the
     # budget reservation above already throttled lower priorities).
@@ -59,7 +78,10 @@ def apply_priority_schedule(
             if job.executed_work > 0.0
             else 0.0
         )
-        sim.try_start(job, overhead=overhead)
+        sim.try_start(
+            job, overhead=overhead,
+            why=expl(job, "priority-prefix") if expl else None,
+        )
 
 
 def active_jobs(sim) -> List[Job]:

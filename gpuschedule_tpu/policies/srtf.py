@@ -29,5 +29,9 @@ class SrtfPolicy(Policy):
             active_jobs(sim),
             key=lambda j: (j.remaining_work, j.arrival_seq),
         )
-        apply_priority_schedule(sim, ordered, restart_overhead=self.restart_overhead)
+        apply_priority_schedule(
+            sim, ordered, restart_overhead=self.restart_overhead,
+            policy=self,
+            detail_fn=lambda j: {"remaining_s": round(j.remaining_work, 3)},
+        )
         return None

@@ -112,7 +112,9 @@ class ThemisPolicy(Policy):
             ),
         )
         apply_priority_schedule(
-            sim, ordered, restart_overhead=self.restart_overhead
+            sim, ordered, restart_overhead=self.restart_overhead,
+            policy=self,
+            detail_fn=lambda j: {"rho": round(finish_time_rho(j, now), 4)},
         )
         # One outstanding tick, ever: the engine arms a _TICK for every
         # non-None return with no dedup (engine.run), and each tick

@@ -25,6 +25,7 @@ import warnings
 from typing import Dict, List, Optional, Sequence
 
 from gpuschedule_tpu.models import MODEL_CONFIGS
+from gpuschedule_tpu.obs.tracer import get_tracer
 from gpuschedule_tpu.profiler.goodput import (
     CurveCache,
     GoodputCurve,
@@ -43,14 +44,20 @@ def time_steps(step_fn, state, tokens, *, iters: int, repeats: int = 3):
     """
     if iters < 1 or repeats < 1:
         raise ValueError(f"iters/repeats must be >= 1, got {iters}/{repeats}")
+    tracer = get_tracer()
     block_times: List[float] = []
     loss = None
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            state, loss = step_fn(state, tokens)
-        float(loss)  # host readback: the only fence this transport honors
-        block_times.append((time.perf_counter() - t0) / iters)
+    for block in range(repeats):
+        with tracer.span(
+            "profiler.block", cat="profiler", block=block, iters=iters
+        ) as sp:
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                state, loss = step_fn(state, tokens)
+            float(loss)  # host readback: the only fence this transport honors
+            block_s = (time.perf_counter() - t0) / iters
+            sp.set(s_per_step=block_s)
+        block_times.append(block_s)
     return statistics.median(block_times), state
 
 
@@ -171,12 +178,20 @@ def measure_step_time(
 
     ``repeats=1`` keeps live-profiling device time at ``iters`` steps per
     (model, k) point; bench.py uses more blocks for a stabler median."""
-    trainer, state, batch = _mesh_trainer(
-        model_name, devices, batch_size, seq_len,
-        sp=sp, tp=tp, pp=pp, seq_shard=seq_shard, warmup=warmup,
-        num_microbatches=num_microbatches,
-    )
-    step_s, _ = time_steps(trainer.step, state, batch, iters=iters, repeats=repeats)
+    import jax
+
+    k = len(devices) if devices is not None else len(jax.devices())
+    with get_tracer().span(
+        "profiler.measure_step_time", cat="profiler",
+        model=model_name, k=k, sp=sp, tp=tp, pp=pp,
+    ) as sp_:
+        trainer, state, batch = _mesh_trainer(
+            model_name, devices, batch_size, seq_len,
+            sp=sp, tp=tp, pp=pp, seq_shard=seq_shard, warmup=warmup,
+            num_microbatches=num_microbatches,
+        )
+        step_s, _ = time_steps(trainer.step, state, batch, iters=iters, repeats=repeats)
+        sp_.set(step_s=step_s)
     return step_s
 
 
@@ -203,14 +218,17 @@ def capture_trace(
     """
     import jax
 
-    trainer, state, batch = _mesh_trainer(
-        model_name, devices, batch_size, seq_len,
-        sp=sp, tp=tp, seq_shard=sp > 1,
-    )
-    with jax.profiler.trace(str(out_dir)):
-        for _ in range(steps):
-            state, loss = trainer.step(state, batch)
-        float(loss)  # host fence inside the trace window
+    with get_tracer().span(
+        "profiler.capture_trace", cat="profiler", model=model_name, steps=steps
+    ):
+        trainer, state, batch = _mesh_trainer(
+            model_name, devices, batch_size, seq_len,
+            sp=sp, tp=tp, seq_shard=sp > 1,
+        )
+        with jax.profiler.trace(str(out_dir)):
+            for _ in range(steps):
+                state, loss = trainer.step(state, batch)
+            float(loss)  # host fence inside the trace window
     return str(out_dir)
 
 
@@ -258,18 +276,23 @@ def profile_model(
     # smaller dp mesh and mislabel the cached curve
     seq_shard = sp > 1
     measured: Dict[int, float] = {}
-    for k in ks:
-        if k <= len(devs):
-            measured[k] = measure_step_time(
-                model_name,
-                devices=devs[:k],
-                batch_size=batch_size,
-                seq_len=seq_len,
-                sp=sp,
-                tp=tp,
-                pp=pp,
-                seq_shard=seq_shard,
-            )
+    with get_tracer().span(
+        "profiler.profile_model", cat="profiler",
+        model=model_name, ks=list(ks), generation=generation,
+    ) as prof_sp:
+        for k in ks:
+            if k <= len(devs):
+                measured[k] = measure_step_time(
+                    model_name,
+                    devices=devs[:k],
+                    batch_size=batch_size,
+                    seq_len=seq_len,
+                    sp=sp,
+                    tp=tp,
+                    pp=pp,
+                    seq_shard=seq_shard,
+                )
+        prof_sp.set(measured_ks=sorted(measured))
     synth_ks = [k for k in ks if k not in measured]
     if synth_ks and unit not in measured:
         # the analytic extension anchors on the smallest-replica point;

@@ -22,6 +22,8 @@ import itertools
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
+from gpuschedule_tpu.obs.perfetto import track_label
+from gpuschedule_tpu.obs.tracer import get_tracer
 from gpuschedule_tpu.sim.job import END_STATES, Job, JobState
 from gpuschedule_tpu.sim.jobset import JobSet
 from gpuschedule_tpu.sim.metrics import MetricsLog, SimResult
@@ -63,6 +65,11 @@ class Simulator:
         self.metrics.attach_jobs(self.jobs)
         self.max_time = max_time
         self.eps = eps
+        # Observability (obs/): the span tracer is a process singleton whose
+        # ``enabled`` flag picks the run loop — the disabled path is the
+        # uninstrumented loop verbatim (tools/check_overhead.py guards that
+        # it stays overhead-free).
+        self._tracer = get_tracer()
 
         self.now: float = 0.0
         # Insertion-ordered, O(1)-mutation sets (see jobset.py): pending keeps
@@ -116,10 +123,17 @@ class Simulator:
         speed: float = 1.0,
         overhead: float = 0.0,
         placement_hint: Optional[dict] = None,
+        why: Optional[dict] = None,
     ) -> bool:
         """Gang-start (or resume) ``job`` on ``chips`` chips; False if the
         cluster cannot grant a valid allocation (all-or-nothing, SURVEY.md §3.1
-        placement step)."""
+        placement step).
+
+        ``why`` is the policy's scheduling rationale for this decision (the
+        ``Policy.explain`` channel): a small dict naming the rule that fired,
+        persisted into the event stream so a trace answers *why* a job
+        started, not just *that* it did.  Policies pass None when the event
+        stream is off, keeping the hot path allocation-free."""
         if job.state not in (JobState.PENDING, JobState.SUSPENDED):
             raise RuntimeError(f"try_start on non-schedulable job {job!r}")
         if speed <= 0.0:
@@ -143,17 +157,25 @@ class Simulator:
             self.pending.remove(job)
         self.running.append(job)
         self._schedule_completion(job)
-        self.metrics.event(
-            "start", self.now, job, chips=chips, speed=speed, overhead=overhead
-        )
+        if self.metrics.record_events:
+            extra = {"chips": chips, "speed": speed, "overhead": overhead,
+                     "track": track_label(alloc.detail)}
+            if why is not None:
+                extra["why"] = why
+            self.metrics.event("start", self.now, job, **extra)
         return True
 
-    def preempt(self, job: Job, *, suspend: bool = True) -> None:
+    def preempt(
+        self, job: Job, *, suspend: bool = True, why: Optional[dict] = None
+    ) -> None:
         """Take ``job`` off the cluster.  ``suspend=True`` marks it as a
         time-sliced victim with resume intent (Gandiva); ``suspend=False``
-        returns it to the pending queue (Tiresias/SRTF demotion)."""
+        returns it to the pending queue (Tiresias/SRTF demotion).  ``why``
+        is the rationale channel (see :meth:`try_start`)."""
         if job.state is not JobState.RUNNING:
             raise RuntimeError(f"preempt on non-running job {job!r}")
+        record = self.metrics.record_events
+        track = track_label(job.allocation.detail) if record else None
         job.advance(self.now)
         self.cluster.free(job.allocation)
         job.allocation = None
@@ -166,9 +188,13 @@ class Simulator:
         self.running.remove(job)
         self.pending.append(job)
         self.metrics.count("preemptions")
-        self.metrics.event("preempt", self.now, job, suspend=suspend)
+        if record:
+            extra = {"suspend": suspend, "track": track}
+            if why is not None:
+                extra["why"] = why
+            self.metrics.event("preempt", self.now, job, **extra)
 
-    def set_speed(self, job: Job, speed: float) -> None:
+    def set_speed(self, job: Job, speed: float, *, why: Optional[dict] = None) -> None:
         """Change a running job's progress rate (elastic resize effect)."""
         if job.state is not JobState.RUNNING:
             raise RuntimeError(f"set_speed on non-running job {job!r}")
@@ -178,9 +204,20 @@ class Simulator:
         job.speed = speed
         job.epoch += 1
         self._schedule_completion(job)
-        self.metrics.event("speed", self.now, job, speed=speed)
+        if self.metrics.record_events:
+            extra = {"speed": speed}
+            if why is not None:
+                extra["why"] = why
+            self.metrics.event("speed", self.now, job, **extra)
 
-    def migrate(self, job: Job, *, overhead: float, placement_hint: Optional[dict] = None) -> bool:
+    def migrate(
+        self,
+        job: Job,
+        *,
+        overhead: float,
+        placement_hint: Optional[dict] = None,
+        why: Optional[dict] = None,
+    ) -> bool:
         """Move a running job to a fresh allocation, paying ``overhead``
         seconds of modeled checkpoint/restore cost (SURVEY.md §3.3 migration).
 
@@ -214,10 +251,22 @@ class Simulator:
         job.epoch += 1
         self._schedule_completion(job)
         self.metrics.count("migrations")
-        self.metrics.event("migrate", self.now, job, overhead=overhead)
+        if self.metrics.record_events:
+            extra = {"overhead": overhead, "track": track_label(alloc.detail)}
+            if why is not None:
+                extra["why"] = why
+            self.metrics.event("migrate", self.now, job, **extra)
         return True
 
-    def resize(self, job: Job, *, chips: int, speed: float, overhead: float = 0.0) -> bool:
+    def resize(
+        self,
+        job: Job,
+        *,
+        chips: int,
+        speed: float,
+        overhead: float = 0.0,
+        why: Optional[dict] = None,
+    ) -> bool:
         """Elastic grow/shrink (Optimus, SURVEY.md §3.2): re-allocate ``job``
         at ``chips`` with new progress rate ``speed``."""
         if job.state is not JobState.RUNNING:
@@ -243,12 +292,19 @@ class Simulator:
         job.overhead_remaining += overhead
         job.epoch += 1
         self._schedule_completion(job)
-        self.metrics.event("resize", self.now, job, chips=chips, speed=speed)
+        if self.metrics.record_events:
+            extra = {"chips": chips, "speed": speed,
+                     "track": track_label(alloc.detail)}
+            if why is not None:
+                extra["why"] = why
+            self.metrics.event("resize", self.now, job, **extra)
         return True
 
     # ------------------------------------------------------------------ #
 
     def _finish(self, job: Job) -> None:
+        record = self.metrics.record_events
+        track = track_label(job.allocation.detail) if record else None
         job.advance(self.now)
         job.executed_work = job.duration  # absorb float residue
         self.cluster.free(job.allocation)
@@ -261,63 +317,125 @@ class Simulator:
         self.running.remove(job)
         self.finished.append(job)
         self.metrics.record_job(job)
-        self.metrics.event("finish", self.now, job, end_state=job.state.value)
+        if record:
+            self.metrics.event(
+                "finish", self.now, job, end_state=job.state.value, track=track
+            )
+
+    def _drain_batch(self, t: float) -> bool:
+        """Pop and apply every event at or before ``t``; True if any event
+        changed scheduler-visible state (the policy must then run)."""
+        dirty = False
+        while self._heap and self._heap[0][0] <= t:
+            _, kind, _, payload, epoch = heapq.heappop(self._heap)
+            if kind == _ARRIVAL:
+                job: Job = payload
+                job.last_update_time = t
+                self.metrics.count("arrivals")
+                if not self.cluster.is_satisfiable(job.num_chips):
+                    # Admission control: this gang size can never be
+                    # granted here (non-slice size, bigger than a pod).
+                    # Reject now instead of letting it wedge priority
+                    # schedulers that would reserve budget for it forever.
+                    # REJECTED is excluded from JCT/makespan aggregates
+                    # (metrics.result), so rejecting clusters don't score
+                    # artificially good headline numbers.
+                    job.state = JobState.REJECTED
+                    job.end_time = t
+                    self.finished.append(job)
+                    self.metrics.record_job(job)
+                    self.metrics.count("rejected_unsatisfiable")
+                    if self.metrics.record_events:
+                        self.metrics.event("reject", t, job, chips=job.num_chips)
+                else:
+                    self.pending.append(job)
+                    if self.metrics.record_events:
+                        self.metrics.event("arrival", t, job, chips=job.num_chips)
+                dirty = True
+            elif kind == _COMPLETION:
+                job = payload
+                if job.epoch != epoch or job.state is not JobState.RUNNING:
+                    continue  # stale prediction from before a preempt/resize
+                if job.remaining_runtime() > self.eps:
+                    # speed changed without epoch bump — repredict
+                    self._schedule_completion(job)
+                    continue
+                self._finish(job)
+                dirty = True
+            else:  # _TICK
+                dirty = True
+        return dirty
 
     def run(self) -> SimResult:
-        """Drive the event loop to completion and return summary metrics."""
+        """Drive the event loop to completion and return summary metrics.
+
+        Two bodies, one behavior: the traced loop wraps each event batch and
+        policy invocation in tracer spans (dual wall/sim clocks); the plain
+        loop is the uninstrumented hot path, selected when the tracer is
+        disabled so replay pays nothing for the telemetry layer's existence
+        (the tools/check_overhead.py contract)."""
+        if self._tracer.enabled:
+            return self._run_traced()
+        return self._run_plain()
+
+    def _cutoff_at_horizon(self) -> None:
+        """Horizon cutoff: charge running jobs up to max_time so executed
+        work and utilization cover the full simulated span.  Shared by both
+        run-loop bodies — cold code, one owner."""
+        self.now = self.max_time
+        self._advance_running(self.max_time)
+        self.metrics.sample(
+            self.now, self.cluster, len(self.running), len(self.pending)
+        )
+
+    def _run_plain(self) -> SimResult:
         while self._heap:
             t = self._heap[0][0]
             if t > self.max_time:
-                # Horizon cutoff: charge running jobs up to max_time so
-                # executed work and utilization cover the full simulated span.
-                self.now = self.max_time
-                self._advance_running(self.max_time)
-                self.metrics.sample(
-                    self.now, self.cluster, len(self.running), len(self.pending)
-                )
+                self._cutoff_at_horizon()
                 break
             self.now = t
             self._advance_running(t)
-            dirty = False
-            while self._heap and self._heap[0][0] <= t:
-                _, kind, _, payload, epoch = heapq.heappop(self._heap)
-                if kind == _ARRIVAL:
-                    job: Job = payload
-                    job.last_update_time = t
-                    self.metrics.count("arrivals")
-                    if not self.cluster.is_satisfiable(job.num_chips):
-                        # Admission control: this gang size can never be
-                        # granted here (non-slice size, bigger than a pod).
-                        # Reject now instead of letting it wedge priority
-                        # schedulers that would reserve budget for it forever.
-                        # REJECTED is excluded from JCT/makespan aggregates
-                        # (metrics.result), so rejecting clusters don't score
-                        # artificially good headline numbers.
-                        job.state = JobState.REJECTED
-                        job.end_time = t
-                        self.finished.append(job)
-                        self.metrics.record_job(job)
-                        self.metrics.count("rejected_unsatisfiable")
-                        self.metrics.event("reject", t, job, chips=job.num_chips)
-                    else:
-                        self.pending.append(job)
-                        self.metrics.event("arrival", t, job, chips=job.num_chips)
-                    dirty = True
-                elif kind == _COMPLETION:
-                    job = payload
-                    if job.epoch != epoch or job.state is not JobState.RUNNING:
-                        continue  # stale prediction from before a preempt/resize
-                    if job.remaining_runtime() > self.eps:
-                        # speed changed without epoch bump — repredict
-                        self._schedule_completion(job)
-                        continue
-                    self._finish(job)
-                    dirty = True
-                else:  # _TICK
-                    dirty = True
-            if dirty:
+            if self._drain_batch(t):
                 wakeup = self.policy.schedule(self)
                 if wakeup is not None:
                     self.request_wakeup(wakeup)
             self.metrics.sample(self.now, self.cluster, len(self.running), len(self.pending))
+        return self.metrics.result(self.jobs, self.now)
+
+    def _run_traced(self) -> SimResult:
+        tracer = self._tracer
+        with tracer.span(
+            "sim.run", cat="sim", sim_now=0.0,
+            policy=self.policy.name, jobs=len(self.jobs),
+        ) as run_sp:
+            n_batches = 0
+            while self._heap:
+                t = self._heap[0][0]
+                if t > self.max_time:
+                    self._cutoff_at_horizon()
+                    break
+                self.now = t
+                with tracer.span("sim.batch", cat="sim", sim_now=t) as sp:
+                    self._advance_running(t)
+                    dirty = self._drain_batch(t)
+                    if dirty:
+                        with tracer.span(
+                            "policy.schedule", cat="policy", sim_now=t,
+                            policy=self.policy.name,
+                        ) as psp:
+                            wakeup = self.policy.schedule(self)
+                            psp.set(
+                                running=len(self.running),
+                                pending=len(self.pending),
+                                wakeup=wakeup,
+                            )
+                        if wakeup is not None:
+                            self.request_wakeup(wakeup)
+                    sp.set(dirty=dirty).end_sim(self.now)
+                n_batches += 1
+                self.metrics.sample(
+                    self.now, self.cluster, len(self.running), len(self.pending)
+                )
+            run_sp.set(batches=n_batches).end_sim(self.now)
         return self.metrics.result(self.jobs, self.now)

@@ -14,9 +14,16 @@ import json
 from collections import Counter
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, List, Optional, Sequence
+from typing import IO, Dict, List, Optional, Sequence, Union
 
 from gpuschedule_tpu.sim.job import Job, JobState
+
+# JCT/queueing-delay histogram buckets for the obs registry: seconds to a
+# week, the span Philly-scale replays actually cover.
+_DELAY_BUCKETS = (
+    60.0, 300.0, 900.0, 3600.0, 4 * 3600.0, 24 * 3600.0, 7 * 24 * 3600.0,
+    float("inf"),
+)
 
 JOB_CSV_FIELDS = [
     "job_id",
@@ -95,14 +102,54 @@ class MetricsLog:
     """
 
     def __init__(
-        self, *, max_util_samples: int = 200_000, record_events: bool = False
+        self,
+        *,
+        max_util_samples: int = 200_000,
+        record_events: bool = False,
+        events_sink: Optional[Union[str, Path, IO]] = None,
+        registry=None,
     ) -> None:
         self.job_rows: List[dict] = []
         # Structured event stream (SURVEY.md §5 "Metrics/logging": CSVs plus
         # a structured JSONL event log).  Off by default: at Philly scale the
         # stream is ~10^6 dicts, so it is opt-in (CLI --events).
-        self.record_events = record_events
+        #
+        # ``events_sink`` (a path or an open text file) streams each event to
+        # JSONL as it happens instead of buffering: the in-memory list stays
+        # empty, so Philly-scale runs no longer hold ~10^6 dicts in RAM just
+        # to persist them at write() time (ISSUE 1 satellite).  Passing a
+        # sink implies ``record_events``.
+        self.record_events = record_events or events_sink is not None
         self.events: List[dict] = []
+        self._sink_path: Optional[Path] = None
+        self._sink_fh: Optional[IO] = None
+        self._owns_sink = False
+        self._sink_opened = False
+        if events_sink is not None:
+            if hasattr(events_sink, "write"):
+                self._sink_fh = events_sink
+            else:
+                self._sink_path = Path(events_sink)
+        # Optional obs-layer registry (obs/metrics.py): counters mirror into
+        # Prometheus counter families, per-job records feed JCT/queueing
+        # histograms, and every utilization sample updates the occupancy
+        # gauges.  None (the default) costs one attribute check per call.
+        self._registry = registry
+        self._reg_counters: Dict[str, object] = {}  # count() key -> Counter
+        if registry is not None:
+            self._reg_running = registry.gauge(
+                "sim_jobs_running", "jobs holding allocations")
+            self._reg_pending = registry.gauge(
+                "sim_jobs_pending", "jobs queued for allocations")
+            self._reg_used = registry.gauge(
+                "sim_chips_used", "chips currently allocated")
+            self._reg_total = registry.gauge(
+                "sim_chips_total", "cluster capacity in chips")
+            self._reg_jct = registry.histogram(
+                "sim_jct_seconds", "job completion time", buckets=_DELAY_BUCKETS)
+            self._reg_queue = registry.histogram(
+                "sim_queueing_delay_seconds", "submit-to-first-start delay",
+                buckets=_DELAY_BUCKETS)
         self.util_samples: List[tuple] = []  # (t, used, total, running, pending)
         self.counters: Counter = Counter()
         self._all_jobs: Sequence[Job] = ()   # set by attach_jobs(); lets write()
@@ -125,16 +172,53 @@ class MetricsLog:
     # ------------------------------------------------------------------ #
     def count(self, key: str, n: int = 1) -> None:
         self.counters[key] += n
+        if self._registry is not None:
+            c = self._reg_counters.get(key)
+            if c is None:
+                # resolve the family once per key: sanitize + registry lock
+                # stay off the per-event hot path
+                c = self._registry.counter(
+                    f"sim_{key}_total", "engine counter (MetricsLog)")
+                self._reg_counters[key] = c
+            c.inc(n)
+
+    def _sink(self) -> Optional[IO]:
+        if self._sink_fh is not None:
+            return self._sink_fh
+        if self._sink_path is not None:
+            self._sink_path.parent.mkdir(parents=True, exist_ok=True)
+            # "a" on reopen: a close_events()/write() mid-run must not let a
+            # later event truncate everything streamed before it
+            self._sink_fh = open(self._sink_path, "w" if not self._sink_opened else "a")
+            self._owns_sink = self._sink_opened = True
+            return self._sink_fh
+        return None
 
     def event(self, kind: str, t: float, job: Optional[Job] = None, **extra) -> None:
-        """Append one structured event (no-op unless ``record_events``)."""
+        """Record one structured event (no-op unless ``record_events``):
+        streamed straight to the JSONL sink when one is configured, buffered
+        in :attr:`events` otherwise."""
         if not self.record_events:
             return
         rec: dict = {"t": t, "event": kind}
         if job is not None:
             rec["job"] = job.job_id
         rec.update(extra)
-        self.events.append(rec)
+        sink = self._sink()
+        if sink is not None:
+            sink.write(json.dumps(rec) + "\n")
+        else:
+            self.events.append(rec)
+
+    def close_events(self) -> None:
+        """Flush and (when this log opened it) close the JSONL sink.  Safe
+        to call repeatedly; :meth:`write` calls it for you."""
+        if self._sink_fh is not None:
+            self._sink_fh.flush()
+            if self._owns_sink:
+                self._sink_fh.close()
+                self._sink_fh = None
+                self._owns_sink = False
 
     @staticmethod
     def _job_row(job: Job) -> dict:
@@ -159,6 +243,13 @@ class MetricsLog:
 
     def record_job(self, job: Job) -> None:
         self.job_rows.append(self._job_row(job))
+        if self._registry is not None and job.state is not JobState.REJECTED:
+            j = job.jct()
+            if j is not None:
+                self._reg_jct.observe(j)
+            q = job.queueing_delay()
+            if q is not None:
+                self._reg_queue.observe(q)
 
     def sample(self, t: float, cluster, num_running: int, num_pending: int) -> None:
         used, total = cluster.used_chips, cluster.total_chips
@@ -171,6 +262,12 @@ class MetricsLog:
         self._last_t = t
         self._last_frac = used / total if total > 0 else 0.0
 
+        if self._registry is not None:
+            self._reg_running.set(num_running)
+            self._reg_pending.set(num_pending)
+            self._reg_used.set(used)
+            self._reg_total.set(total)
+
         self._tail = (t, used, total, num_running, num_pending)
         if self._sample_calls % self._stride == 0:
             self.util_samples.append(self._tail)
@@ -182,7 +279,13 @@ class MetricsLog:
     def _flush_tail(self) -> None:
         """Ensure the final observed sample is stored: once decimation raises
         the stride, the last call is usually not a stride multiple, and the
-        persisted log would end before the simulation does."""
+        persisted log would end before the simulation does.
+
+        Idempotent by construction — the tail is only appended when it is not
+        already the stored last sample — so ``write()`` twice, or ``write()``
+        followed by ``result()``, never duplicates it even right after a
+        stride-doubling decimation dropped it (the regression pinned by
+        tests/test_events.py::test_write_idempotent_after_flush_tail)."""
         if self._tail is not None and (
             not self.util_samples or self.util_samples[-1] != self._tail
         ):
@@ -249,6 +352,15 @@ class MetricsLog:
         with open(out / f"{prefix}counters.json", "w") as f:
             json.dump(dict(self.counters), f, indent=2, sort_keys=True)
         if self.record_events:
-            with open(out / f"{prefix}events.jsonl", "w") as f:
-                for rec in self.events:
-                    f.write(json.dumps(rec) + "\n")
+            if self._sink_path is not None or self._sink_fh is not None:
+                # streamed as they happened; just make them durable.  A
+                # zero-event run never opened its lazy path sink — force the
+                # file into existence so the (possibly empty) JSONL is always
+                # there, exactly as the buffered branch below guarantees.
+                if self._sink_path is not None and not self._sink_opened:
+                    self._sink()
+                self.close_events()
+            else:
+                with open(out / f"{prefix}events.jsonl", "w") as f:
+                    for rec in self.events:
+                        f.write(json.dumps(rec) + "\n")

@@ -42,9 +42,9 @@ class _BridgedSim(Simulator):
         super().__init__(*args, **kwargs)
         self._bridge = bridge
 
-    def resize(self, job, *, chips, speed, overhead=0.0):
+    def resize(self, job, *, chips, speed, overhead=0.0, why=None):
         old = job.allocated_chips
-        ok = super().resize(job, chips=chips, speed=speed, overhead=overhead)
+        ok = super().resize(job, chips=chips, speed=speed, overhead=overhead, why=why)
         if ok and self._bridge is not None:
             self._bridge(job, old, chips)
         return ok

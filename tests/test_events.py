@@ -56,3 +56,112 @@ def test_cli_events_flag(tmp_path):
     ])
     assert rc == 0
     assert (tmp_path / "events.jsonl").exists()
+
+
+# --------------------------------------------------------------------- #
+# ISSUE 1 satellites: streaming event sink, write() idempotency, rationale
+
+
+def test_events_stream_to_sink_instead_of_buffering(tmp_path):
+    """With a JSONL sink the in-memory list stays empty (constant memory at
+    Philly scale) and the streamed file equals the buffered stream."""
+    jobs = generate_poisson_trace(60, seed=13, mean_duration=600.0)
+    buffered = MetricsLog(record_events=True)
+    Simulator(SimpleCluster(8), DlasPolicy(thresholds=(600.0,)), jobs,
+              metrics=buffered).run()
+
+    jobs = generate_poisson_trace(60, seed=13, mean_duration=600.0)
+    sink_path = tmp_path / "events.jsonl"
+    streamed = MetricsLog(events_sink=sink_path)
+    assert streamed.record_events  # a sink implies recording
+    Simulator(SimpleCluster(8), DlasPolicy(thresholds=(600.0,)), jobs,
+              metrics=streamed).run()
+    streamed.close_events()
+
+    assert streamed.events == []  # nothing buffered
+    lines = sink_path.read_text().splitlines()
+    assert [json.loads(line) for line in lines] == buffered.events
+    streamed.close_events()  # idempotent
+
+
+def test_sink_survives_write_without_truncation(tmp_path):
+    """write() flushes the sink it opened; a later event reopens in append
+    mode so nothing streamed earlier is lost."""
+    sink_path = tmp_path / "events.jsonl"
+    log = MetricsLog(events_sink=sink_path)
+    log.event("start", 1.0)
+    log.write(tmp_path)
+    log.event("finish", 2.0)
+    log.close_events()
+    kinds = [json.loads(line)["event"] for line in
+             sink_path.read_text().splitlines()]
+    assert kinds == ["start", "finish"]
+
+
+def test_zero_event_run_still_materializes_the_sink_file(tmp_path):
+    """A lazy path sink that never saw an event must still yield an (empty)
+    events.jsonl from write(), like the buffered branch always did."""
+    log = MetricsLog(events_sink=tmp_path / "out" / "events.jsonl")
+    log.write(tmp_path / "out")
+    assert (tmp_path / "out" / "events.jsonl").read_text() == ""
+
+
+def test_open_file_sink_is_not_closed_by_the_log(tmp_path):
+    with open(tmp_path / "ev.jsonl", "w") as fh:
+        log = MetricsLog(events_sink=fh)
+        log.event("start", 0.0)
+        log.close_events()  # flushes, but the caller owns the handle
+        assert not fh.closed
+        log.event("finish", 1.0)
+        log.close_events()
+    assert len((tmp_path / "ev.jsonl").read_text().splitlines()) == 2
+
+
+class _FakeCluster:
+    used_chips, total_chips = 4, 8
+
+
+def test_write_idempotent_after_flush_tail(tmp_path):
+    """Regression (ISSUE 1 satellite): write() twice — or write() then
+    result() — must not duplicate the decimation tail sample."""
+    log = MetricsLog(max_util_samples=4)  # stride doubles almost immediately
+    for i in range(10):
+        log.sample(float(i), _FakeCluster(), 1, 0)
+    assert log.util_samples[-1][0] != 9.0  # tail really was decimated away
+
+    log.write(tmp_path)
+    n = len(log.util_samples)
+    assert log.util_samples[-1][0] == 9.0  # _flush_tail appended it once
+
+    log.write(tmp_path)  # second write: no duplicate tail
+    assert len(log.util_samples) == n
+    log.result((), 9.0)  # result() also flushes; still no duplicate
+    assert len(log.util_samples) == n
+    lines = (tmp_path / "utilization.csv").read_text().splitlines()
+    assert len(lines) == n + 1  # header + one row per sample, tail included
+
+
+def test_start_and_preempt_events_carry_rationale_and_track():
+    """Policies' explain channel: every start/preempt in the stream names
+    the rule that fired, and timeline events carry their track label."""
+    res, metrics = _run(DlasPolicy(thresholds=(600.0,)), chips=8)
+    starts = [e for e in metrics.events if e["event"] == "start"]
+    assert starts
+    for e in starts:
+        assert e["track"]  # occupancy geometry for the perfetto exporter
+        why = e["why"]
+        assert why["policy"] == "dlas" and why["rule"] == "priority-prefix"
+        assert "rank" in why and "queue" in why
+    for e in (e for e in metrics.events if e["event"] == "preempt"):
+        assert e["why"]["rule"] == "displaced-by-priority-prefix"
+
+
+def test_rationale_skipped_when_events_off():
+    """The zero-overhead contract: with the stream off, schedule() must not
+    build rationale dicts (Policy.explaining gates them)."""
+    jobs = generate_poisson_trace(20, seed=5, mean_duration=300.0)
+    metrics = MetricsLog(record_events=False)
+    sim = Simulator(SimpleCluster(8), FifoPolicy(), jobs, metrics=metrics)
+    assert not FifoPolicy().explaining(sim)
+    sim.run()
+    assert metrics.events == []

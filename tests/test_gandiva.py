@@ -141,9 +141,9 @@ def test_suspended_state_used_for_timeslice_victims():
     seen = []
 
     class Spy(Simulator):
-        def preempt(self, job, *, suspend=True):
+        def preempt(self, job, *, suspend=True, why=None):
             seen.append((job.job_id, suspend))
-            super().preempt(job, suspend=suspend)
+            super().preempt(job, suspend=suspend, why=why)
 
     jobs = [
         Job("a", 0.0, num_chips=8, duration=300.0),

@@ -1,0 +1,256 @@
+"""Obs layer unit tests: span tracer and metrics registry (ISSUE 1)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from gpuschedule_tpu.obs import (
+    NULL_SPAN,
+    MetricsRegistry,
+    Tracer,
+    get_registry,
+    get_tracer,
+)
+from gpuschedule_tpu.obs.metrics import sanitize_name
+
+
+# --------------------------------------------------------------------- #
+# tracer
+
+
+def test_disabled_tracer_hands_out_the_null_singleton():
+    tr = Tracer()  # disabled by default
+    sp = tr.span("anything", cat="x", attr=1)
+    assert sp is NULL_SPAN
+    with sp as inner:
+        # full Span surface, all no-ops, no allocation per call site
+        assert inner.set(a=1) is NULL_SPAN
+        assert inner.end_sim(3.0) is NULL_SPAN
+    assert tr.spans == []
+    assert tr.record("x", wall_start=0.0, wall_dur=1.0) is None
+
+
+def test_spans_nest_and_carry_both_clocks():
+    tr = Tracer(enabled=True)
+    with tr.span("outer", cat="test", sim_now=10.0) as outer:
+        with tr.span("inner", cat="test", sim_now=10.0) as inner:
+            time.sleep(0.002)
+            inner.set(k=4)
+        outer.end_sim(12.5)
+    spans = tr.spans
+    assert [s.name for s in spans] == ["inner", "outer"]  # close order
+    inner_sp, outer_sp = spans
+    assert inner_sp.depth == 1 and outer_sp.depth == 0
+    assert inner_sp.attrs == {"k": 4}
+    assert outer_sp.sim_start == 10.0 and outer_sp.sim_end == 12.5
+    assert inner_sp.wall_dur >= 0.002
+    # inner is contained in outer on the wall clock
+    assert outer_sp.wall_start <= inner_sp.wall_start
+    assert (outer_sp.wall_start + outer_sp.wall_dur
+            >= inner_sp.wall_start + inner_sp.wall_dur)
+
+
+def test_record_rebases_external_wall_interval():
+    tr = Tracer(enabled=True)
+    t0 = time.perf_counter()
+    sp = tr.record("fenced.step", wall_start=t0, wall_dur=0.25, tokens=1024)
+    assert sp is not None and sp.wall_dur == 0.25
+    assert sp.wall_start >= 0.0  # re-based to the tracer origin
+    assert sp.attrs["tokens"] == 1024
+
+
+def test_summary_aggregates_per_name():
+    tr = Tracer(enabled=True)
+    for _ in range(3):
+        with tr.span("a"):
+            pass
+    with tr.span("b"):
+        pass
+    agg = tr.summary()
+    assert agg["a"]["count"] == 3 and agg["b"]["count"] == 1
+    assert agg["a"]["mean_s"] == pytest.approx(agg["a"]["total_s"] / 3)
+
+
+def test_tracer_thread_safety_and_per_thread_depth():
+    tr = Tracer(enabled=True)
+
+    def worker():
+        for _ in range(50):
+            with tr.span("w"):
+                with tr.span("w.inner"):
+                    pass
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    spans = tr.spans
+    assert len(spans) == 4 * 50 * 2
+    # depth never leaks across threads: inner always 1, outer always 0
+    assert {s.depth for s in spans if s.name == "w"} == {0}
+    assert {s.depth for s in spans if s.name == "w.inner"} == {1}
+
+
+def test_chrome_export_writes_loadable_document(tmp_path):
+    tr = Tracer(enabled=True)
+    with tr.span("step", cat="train", sim_now=1.0) as sp:
+        sp.end_sim(2.0)
+    path = tr.write_chrome(tmp_path / "spans.trace.json")
+    doc = json.loads((tmp_path / "spans.trace.json").read_text())
+    assert path.endswith("spans.trace.json")
+    evs = doc["traceEvents"]
+    complete = [e for e in evs if e["ph"] == "X"]
+    assert len(complete) == 1
+    (e,) = complete
+    assert e["name"] == "step" and e["dur"] >= 0
+    assert e["args"]["sim_start_s"] == 1.0 and e["args"]["sim_end_s"] == 2.0
+    # metadata names the process and the opening thread
+    assert any(m["ph"] == "M" and m["name"] == "process_name" for m in evs)
+    assert any(m["ph"] == "M" and m["name"] == "thread_name" for m in evs)
+
+
+def test_chrome_events_are_begin_ordered_and_self_validating():
+    """Spans close inner-first, but the export must be ts-ordered — the
+    package's own validator rejects it otherwise (regression)."""
+    from gpuschedule_tpu.obs import validate_chrome_trace
+
+    tr = Tracer(enabled=True)
+    with tr.span("outer"):
+        with tr.span("inner"):
+            pass
+        with tr.span("inner2"):
+            pass
+    doc = {"traceEvents": tr.chrome_events()}
+    assert validate_chrome_trace(doc) == []
+    names = [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert names == ["outer", "inner", "inner2"]  # begin order, not close
+
+
+def test_reset_drops_spans_and_reanchors_origin():
+    tr = Tracer(enabled=True)
+    with tr.span("x"):
+        pass
+    assert tr.spans
+    tr.reset()
+    assert tr.spans == []
+
+
+def test_get_tracer_is_a_disabled_singleton():
+    tr = get_tracer()
+    assert tr is get_tracer()
+    assert tr.enabled is False  # tests run with GSTPU_TRACE unset
+
+
+def test_gstpu_trace_env_parsing_honors_falsy_spellings():
+    import os
+    import subprocess
+    import sys
+
+    code = "from gpuschedule_tpu.obs import get_tracer; print(get_tracer().enabled)"
+    for value, expect in (("false", "False"), ("1", "True")):
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env={**os.environ, "GSTPU_TRACE": value},
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.stdout.strip() == expect, (value, out.stderr)
+
+
+# --------------------------------------------------------------------- #
+# metrics registry
+
+
+def test_counter_monotone_and_exposed():
+    reg = MetricsRegistry()
+    c = reg.counter("jobs_total", "jobs seen")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    text = reg.prometheus_text()
+    assert "# HELP jobs_total jobs seen" in text
+    assert "# TYPE jobs_total counter" in text
+    assert "\njobs_total 5\n" in text
+
+
+def test_gauge_set_inc_dec():
+    reg = MetricsRegistry()
+    g = reg.gauge("queue_depth")
+    g.set(7)
+    g.dec(2)
+    g.inc()
+    assert g.value == 6
+    assert "queue_depth 6" in reg.prometheus_text()
+
+
+def test_labeled_children_are_stable_and_rendered():
+    reg = MetricsRegistry()
+    c = reg.counter("events_total", "by kind", labelnames=("kind",))
+    c.labels("start").inc(2)
+    c.labels(kind="preempt").inc()
+    assert c.labels("start") is c.labels("start")
+    with pytest.raises(ValueError):
+        c.inc()  # labeled family requires .labels(...)
+    with pytest.raises(ValueError):
+        c.labels("a", "b")  # wrong arity
+    with pytest.raises(ValueError):
+        c.labels(nope="x")  # unknown label name
+    text = reg.prometheus_text()
+    assert 'events_total{kind="start"} 2' in text
+    assert 'events_total{kind="preempt"} 1' in text
+
+
+def test_histogram_buckets_cumulative_sum_count():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    assert h.count == 4 and h.sum == pytest.approx(55.55)
+    text = reg.prometheus_text()
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="1"} 2' in text
+    assert 'lat_bucket{le="10"} 3' in text
+    assert 'lat_bucket{le="+Inf"} 4' in text
+    assert "lat_count 4" in text
+    j = reg.to_json()["lat"]["value"]
+    assert j["count"] == 4 and j["buckets"]["+Inf"] == 1  # per-bucket, not cum
+
+
+def test_registry_idempotent_and_kind_conflicts_rejected():
+    reg = MetricsRegistry()
+    assert reg.counter("x") is reg.counter("x")
+    with pytest.raises(ValueError):
+        reg.gauge("x")
+    with pytest.raises(ValueError):
+        reg.counter("x", labelnames=("a",))  # schema change is also a conflict
+    h = reg.histogram("h", buckets=(1.0, 2.0))
+    assert reg.histogram("h", buckets=(1.0, 2.0)) is h  # +Inf is implied
+    with pytest.raises(ValueError):
+        reg.histogram("h", buckets=(1.0, 5.0))  # bucket layout is schema too
+
+
+def test_sanitize_name_coerces_to_legal_prometheus():
+    assert sanitize_name("sim.jobs-running") == "sim_jobs_running"
+    assert sanitize_name("0weird") == "_0weird"
+    assert sanitize_name("") == "_"
+
+
+def test_registry_write_and_json_roundtrip(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("a", "help a").inc(3)
+    reg.gauge("b").set(1.5)
+    reg.write(prom_path=tmp_path / "m.prom", json_path=tmp_path / "m.json")
+    assert "a 3" in (tmp_path / "m.prom").read_text()
+    doc = json.loads((tmp_path / "m.json").read_text())
+    assert doc["a"] == {"kind": "counter", "help": "help a", "value": 3}
+    assert doc["b"]["value"] == 1.5
+
+
+def test_get_registry_is_a_singleton():
+    assert get_registry() is get_registry()

@@ -1,0 +1,46 @@
+"""Telemetry zero-overhead guard as a pytest (ISSUE 1 satellite).
+
+The measurement itself lives in tools/check_overhead.py (runnable directly
+in CI); this wrapper runs the same guard under the ``slow`` marker so the
+default tier-1 run stays fast.  A quick structural check of the guard's
+plumbing (tiny job count, no timing assertion) stays in the fast tier so a
+broken guard is caught before the slow suite ever runs.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+)
+
+from check_overhead import run_guard  # noqa: E402
+
+
+def test_guard_plumbing_smoke():
+    """Fast tier: the guard measures all three configs on a tiny replay and
+    reports the fields the CI gate keys on (no timing gate at this size)."""
+    res = run_guard(num_jobs=40, repeats=1, tolerance=1e9, max_attempts=1)
+    assert res["ok"] is True
+    for key in ("baseline_s", "disabled_s", "enabled_s",
+                "disabled_over_baseline", "enabled_over_baseline"):
+        assert res[key] > 0
+    # the guard must leave the process-wide tracer off for later tests
+    from gpuschedule_tpu.obs import get_tracer
+
+    assert get_tracer().enabled is False
+
+
+@pytest.mark.slow
+def test_disabled_telemetry_has_no_measurable_overhead():
+    """Acceptance gate: a 1k-job replay with telemetry disabled stays within
+    2% of the uninstrumented loop body."""
+    res = run_guard()
+    assert res["ok"], (
+        f"telemetry-disabled path is {res['disabled_over_baseline']:.3f}x "
+        f"baseline (tolerance {res['tolerance']}): {res}"
+    )

@@ -1,0 +1,213 @@
+"""Perfetto/Chrome trace-event exporter round-trip tests (ISSUE 1).
+
+Covers the satellite checklist: a real run -> trace.json -> valid JSON,
+monotonic ``ts``, one complete event per occupancy interval, and
+preempt/migrate instants pinned to the track the job occupied — plus the
+acceptance path ``run --policy dlas --perfetto out.json`` end to end.
+"""
+
+from __future__ import annotations
+
+import json
+
+from gpuschedule_tpu.cluster.base import SimpleCluster
+from gpuschedule_tpu.cluster.tpu import TpuCluster
+from gpuschedule_tpu.obs import (
+    export_chrome_trace,
+    load_events_jsonl,
+    trace_events,
+    track_label,
+    validate_chrome_trace,
+)
+from gpuschedule_tpu.policies.dlas import DlasPolicy
+from gpuschedule_tpu.policies.fifo import FifoPolicy
+from gpuschedule_tpu.sim import Simulator
+from gpuschedule_tpu.sim.metrics import MetricsLog
+from gpuschedule_tpu.sim.trace import generate_poisson_trace
+
+
+def _run_events(policy, *, cluster=None, n=40, seed=7):
+    jobs = generate_poisson_trace(n, seed=seed, mean_duration=600.0)
+    metrics = MetricsLog(record_events=True)
+    Simulator(cluster or SimpleCluster(16), policy, jobs, metrics=metrics).run()
+    return metrics.events
+
+
+def _timed(evs):
+    return [e for e in evs if e["ph"] != "M"]
+
+
+def test_fifo_roundtrip_valid_one_slice_per_occupancy(tmp_path):
+    events = _run_events(FifoPolicy(), n=40)
+    doc = export_chrome_trace(events, tmp_path / "trace.json")
+    # file really is the returned document, and it is valid JSON
+    on_disk = json.loads((tmp_path / "trace.json").read_text())
+    assert on_disk == doc
+    assert validate_chrome_trace(doc) == []
+
+    evs = doc["traceEvents"]
+    timed = _timed(evs)
+    # monotonic ts over the timed stream
+    ts = [e["ts"] for e in timed]
+    assert ts == sorted(ts) and all(t >= 0 for t in ts)
+    # FIFO never preempts/migrates: every start pairs with exactly one
+    # complete occupancy slice, and the only instants are admission rejects
+    starts = sum(1 for e in events if e["event"] == "start")
+    slices = [e for e in timed if e["ph"] == "X"]
+    assert len(slices) == starts > 0
+    assert all(e["cat"] == "occupancy" and e["dur"] >= 0 for e in slices)
+    assert {e["name"] for e in timed if e["ph"] == "i"} <= {"reject"}
+
+
+def test_preempt_instants_land_on_the_occupied_track(tmp_path):
+    # DLAS on a small pool preempts; each preempt must close the job's
+    # occupancy slice and drop an instant on that same (pid, tid) track.
+    events = _run_events(DlasPolicy(thresholds=(300.0,)), cluster=SimpleCluster(8))
+    assert any(e["event"] == "preempt" for e in events)
+    evs = trace_events(events)
+    assert validate_chrome_trace({"traceEvents": evs}) == []
+    timed = _timed(evs)
+    instants = [e for e in timed if e["ph"] == "i"]
+    assert instants and all(e["s"] == "t" for e in instants)
+    for inst in [e for e in instants if e["name"] == "preempt"]:
+        owners = [
+            e for e in timed
+            if e["ph"] == "X" and e["name"] != inst["name"]
+            and (e["pid"], e["tid"]) == (inst["pid"], inst["tid"])
+            and e["ts"] <= inst["ts"] <= e["ts"] + e["dur"]
+        ]
+        assert owners, f"preempt instant at ts={inst['ts']} on an empty track"
+
+
+def test_migrate_closes_and_reopens_interval_on_new_track():
+    # Hand-built stream: j moves from pod0 to pod1 at t=10, finishes at 20.
+    events = [
+        {"t": 0.0, "event": "start", "job": "j", "track": "pod0/2x2@0,0"},
+        {"t": 10.0, "event": "migrate", "job": "j", "track": "pod1/2x2@0,0"},
+        {"t": 20.0, "event": "finish", "job": "j", "end_state": "finished"},
+    ]
+    evs = trace_events(events)
+    assert validate_chrome_trace({"traceEvents": evs}) == []
+    slices = [e for e in evs if e["ph"] == "X"]
+    assert len(slices) == 2  # migrate closes one interval, opens the next
+    first, second = sorted(slices, key=lambda e: e["ts"])
+    assert (first["ts"], first["dur"]) == (0.0, 10.0 * 1e6)
+    assert (second["ts"], second["dur"]) == (10.0 * 1e6, 10.0 * 1e6)
+    assert first["args"]["ended_by"] == "migrate"
+    # the two halves live on different tracks; the instant marks the source
+    assert (first["pid"], first["tid"]) != (second["pid"], second["tid"])
+    (inst,) = [e for e in evs if e["ph"] == "i"]
+    assert inst["name"] == "migrate"
+    assert (inst["pid"], inst["tid"]) == (first["pid"], first["tid"])
+    # track names survive as thread metadata
+    names = {m["args"]["name"] for m in evs if m["ph"] == "M"}
+    assert {"pod0/2x2@0,0", "pod1/2x2@0,0"} <= names
+
+
+def test_rejects_land_on_the_admission_track():
+    events = [
+        {"t": 5.0, "event": "reject", "job": "big", "chips": 4096},
+    ]
+    evs = trace_events(events)
+    (inst,) = [e for e in evs if e["ph"] == "i"]
+    admission = [
+        m for m in evs if m["ph"] == "M" and m["args"]["name"] == "admission"
+    ]
+    assert admission and inst["args"]["chips"] == 4096
+
+
+def test_unfinished_occupancy_extends_to_horizon():
+    events = [
+        {"t": 0.0, "event": "start", "job": "j", "track": "pool"},
+        {"t": 30.0, "event": "arrival", "job": "k"},
+    ]
+    (sl,) = [e for e in trace_events(events) if e["ph"] == "X"]
+    assert sl["dur"] == 30.0 * 1e6 and sl["args"]["ended_by"] == "horizon"
+
+
+def test_track_label_flavors():
+    assert track_label(None) == "pool"
+
+    class Slice:
+        pod, shape, origin = 2, (4, 4), (0, 4)
+
+    class Gpu:
+        nodes = (((0, 1), 8), ((1, 3), 8))
+
+    assert track_label(Slice()) == "pod2/4x4@0,4"
+    assert track_label(Gpu()) == "gpu/s0n1+s1n3"
+
+
+def test_tpu_run_tracks_carry_slice_geometry(tmp_path):
+    events = _run_events(
+        FifoPolicy(), cluster=TpuCluster("v5e", dims=(8, 8)), n=30
+    )
+    evs = trace_events(events)
+    names = {m["args"]["name"] for m in evs if m["ph"] == "M"}
+    assert any(n.startswith("pod0/") and "@" in n for n in names)
+
+
+def test_cli_run_perfetto_dlas_100_jobs(tmp_path):
+    """Acceptance: `run --policy dlas --perfetto out.json` on a synthetic
+    100-job trace yields a schema-valid Chrome trace."""
+    from gpuschedule_tpu.cli import main
+
+    out = tmp_path / "out.json"
+    rc = main([
+        "run", "--policy", "dlas", "--cluster", "simple", "--chips", "16",
+        "--synthetic", "100", "--seed", "3", "--perfetto", str(out),
+    ])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert validate_chrome_trace(doc) == []
+    # every admitted job occupied a track; every rejected one left an
+    # admission instant — together the 100 jobs are all on the timeline
+    slices = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    started = {e["name"] for e in slices}
+    rejects = [
+        e for e in doc["traceEvents"] if e["ph"] == "i" and e["name"] == "reject"
+    ]
+    assert len(started) + len(rejects) == 100 and slices
+
+
+def test_env_enabled_tracer_is_reported_by_run(tmp_path):
+    """GSTPU_TRACE=1 enables the singleton at import; `run` must then write
+    the span timeline under --out even without --spans (regression: spans
+    were collected but silently dropped)."""
+    from gpuschedule_tpu.cli import main
+    from gpuschedule_tpu.obs import get_tracer
+
+    get_tracer().enable().reset()
+    try:
+        rc = main([
+            "run", "--policy", "fifo", "--cluster", "simple", "--chips", "16",
+            "--synthetic", "10", "--seed", "1", "--out", str(tmp_path),
+        ])
+    finally:
+        get_tracer().disable()
+        get_tracer().reset()
+    assert rc == 0
+    doc = json.loads((tmp_path / "spans.trace.json").read_text())
+    assert any(
+        e.get("name") == "sim.run" for e in doc["traceEvents"]
+    ) and validate_chrome_trace(doc) == []
+
+
+def test_cli_obs_export_matches_inline_export(tmp_path):
+    from gpuschedule_tpu.cli import main
+
+    rc = main([
+        "run", "--policy", "fifo", "--cluster", "simple", "--chips", "16",
+        "--synthetic", "30", "--seed", "4", "--events", "--out", str(tmp_path),
+    ])
+    assert rc == 0
+    jsonl = tmp_path / "events.jsonl"
+    rc = main([
+        "obs", "export", "--events", str(jsonl), "--out",
+        str(tmp_path / "trace.json"),
+    ])
+    assert rc == 0
+    doc = json.loads((tmp_path / "trace.json").read_text())
+    assert validate_chrome_trace(doc) == []
+    # offline export of the persisted stream == inline export of the run
+    assert doc["traceEvents"] == trace_events(load_events_jsonl(jsonl))

@@ -1,0 +1,141 @@
+"""Telemetry zero-overhead guard (ISSUE 1 satellite / acceptance criterion).
+
+The obs layer's contract is *near-zero cost when disabled*: with the tracer
+off, no event recording, and no registry, the engine's event loop must run
+the uninstrumented path.  This guard measures that claim on a 1k-job replay
+and fails when the disabled path regresses more than ``TOLERANCE`` over the
+baseline:
+
+- **baseline**: the engine loop with the telemetry dispatch bypassed —
+  ``Simulator._run_plain`` invoked directly, which is the uninstrumented
+  loop body itself.  This is the closest runtime equivalent of "the code
+  before the telemetry layer existed".
+- **disabled**: the public ``Simulator.run()`` with every telemetry surface
+  at its default-off setting — what every existing caller gets.
+- **enabled** (reported, not gated): span tracer on, events streamed to a
+  null sink, registry attached.  Observability is allowed to cost something
+  when you ask for it; the number is printed so regressions are visible.
+
+Methodology for a noisy 1-core box: baseline/disabled runs are interleaved
+(A B A B ...) so drift hits both alike, each run replays an identical fresh
+trace, and the compared statistic is the per-config minimum — the standard
+"fastest observed run" estimator, robust to scheduling jitter.  On a miss
+the whole measurement retries with more repeats before declaring failure.
+
+Run directly (one JSON line, exit 1 on failure) or through the slow-marked
+pytest wrapper (tests/test_obs_overhead.py):
+
+    python tools/check_overhead.py
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gpuschedule_tpu.cluster.base import SimpleCluster
+from gpuschedule_tpu.obs import MetricsRegistry, get_tracer
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.sim import Simulator
+from gpuschedule_tpu.sim.metrics import MetricsLog
+from gpuschedule_tpu.sim.trace import generate_poisson_trace
+
+TOLERANCE = 1.02  # disabled path may cost at most 2% over baseline
+NUM_JOBS = 1000
+CHIPS = 64
+
+
+class _NullSink(io.TextIOBase):
+    def write(self, s: str) -> int:  # drop the stream, keep the formatting cost
+        return len(s)
+
+
+def _fresh_sim(num_jobs: int, *, metrics: MetricsLog | None = None) -> Simulator:
+    # fresh Job objects every run: the engine mutates them in place
+    jobs = generate_poisson_trace(num_jobs, seed=1234, mean_duration=900.0)
+    return Simulator(
+        SimpleCluster(CHIPS),
+        make_policy("dlas", thresholds=(600.0,)),
+        jobs,
+        metrics=metrics,
+    )
+
+
+def _time_baseline(num_jobs: int) -> float:
+    sim = _fresh_sim(num_jobs)
+    t0 = time.perf_counter()
+    sim._run_plain()
+    return time.perf_counter() - t0
+
+
+def _time_disabled(num_jobs: int) -> float:
+    sim = _fresh_sim(num_jobs)
+    t0 = time.perf_counter()
+    sim.run()
+    return time.perf_counter() - t0
+
+
+def _time_enabled(num_jobs: int) -> float:
+    tracer = get_tracer()
+    sim = _fresh_sim(
+        num_jobs,
+        metrics=MetricsLog(events_sink=_NullSink(), registry=MetricsRegistry()),
+    )
+    tracer.enable().reset()
+    try:
+        t0 = time.perf_counter()
+        sim.run()
+        return time.perf_counter() - t0
+    finally:
+        tracer.disable()
+        tracer.reset()
+
+
+def run_guard(
+    *, num_jobs: int = NUM_JOBS, repeats: int = 5, tolerance: float = TOLERANCE,
+    max_attempts: int = 3,
+) -> dict:
+    """Measure baseline/disabled/enabled and apply the gate; returns a
+    result dict with ``ok`` plus the numbers behind it."""
+    assert get_tracer().enabled is False, "guard must start with tracing off"
+    attempt_repeats = repeats
+    result: dict = {}
+    for attempt in range(1, max_attempts + 1):
+        base_times, dis_times = [], []
+        _time_baseline(num_jobs)  # warm allocator/caches off the record
+        _time_disabled(num_jobs)
+        for _ in range(attempt_repeats):  # interleaved: drift hits both alike
+            base_times.append(_time_baseline(num_jobs))
+            dis_times.append(_time_disabled(num_jobs))
+        t_base, t_dis = min(base_times), min(dis_times)
+        ratio = t_dis / t_base if t_base > 0 else float("inf")
+        result = {
+            "ok": ratio <= tolerance,
+            "attempt": attempt,
+            "repeats": attempt_repeats,
+            "num_jobs": num_jobs,
+            "baseline_s": round(t_base, 6),
+            "disabled_s": round(t_dis, 6),
+            "disabled_over_baseline": round(ratio, 4),
+            "tolerance": tolerance,
+        }
+        if result["ok"]:
+            break
+        attempt_repeats *= 2  # noisy box: demand more evidence before failing
+    # informational: what telemetry costs when you turn it all on
+    result["enabled_s"] = round(_time_enabled(num_jobs), 6)
+    result["enabled_over_baseline"] = round(
+        result["enabled_s"] / result["baseline_s"], 4
+    )
+    return result
+
+
+if __name__ == "__main__":
+    res = run_guard()
+    print(json.dumps(res, sort_keys=True))
+    sys.exit(0 if res["ok"] else 1)
