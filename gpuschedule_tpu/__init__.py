@@ -18,6 +18,10 @@ Layering (SURVEY.md §1):
                 for the topology-aware comparison config)
     policies/   FIFO, SRTF, Tiresias-DLAS, Gandiva, Optimus
     placement/  consolidated / random / greedy / topology-aware schemes
+    faults/     fault injection & recovery: seeded chip/slice failure
+                schedules, checkpoint-rollback recovery, MTBF robustness
+                sweeps (engine _FAULT/_REPAIR events + cluster health masks)
+    obs/        span tracer, metrics registry, Perfetto trace export
     profiler/   JAX step-time harness, ICI cost model, goodput curve fitting
     models/     flax benchmark models driven by the profiler
     parallel/   mesh construction + sharded train steps (dp/tp/sp)

@@ -129,6 +129,29 @@ def cmd_run(args) -> int:
         registry = MetricsRegistry()
     cluster = build_cluster(args)
     jobs = load_jobs(args)
+    # Fault injection (faults/): one --seed governs every stochastic stream
+    # in the run — trace synthesis keeps the bare seed (unchanged from
+    # before faults existed), while each fault process derives its own
+    # independent random.Random(f"{seed}:faults:<process>") stream, so the
+    # same seed reproduces byte-identical trace AND fault schedules, and
+    # changing the fault config never perturbs the trace (the seed-split
+    # rule, documented in faults/schedule.py).
+    fault_plan = None
+    if args.faults:
+        from gpuschedule_tpu.faults import (
+            fault_horizon,
+            make_fault_plan,
+            parse_fault_spec,
+        )
+
+        try:
+            fconfig, frecovery = parse_fault_spec(args.faults)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
+        horizon = args.max_time if args.max_time else fault_horizon(jobs)
+        fault_plan = make_fault_plan(
+            cluster, fconfig, frecovery, horizon=horizon, seed=args.seed
+        )
     # With --events + --out the stream goes straight to its JSONL sink
     # (constant memory at Philly scale); --perfetto alone buffers events in
     # RAM just long enough to convert them.
@@ -144,6 +167,7 @@ def cmd_run(args) -> int:
         cluster, build_policy(args), jobs,
         metrics=metrics,
         max_time=args.max_time or float("inf"),
+        faults=fault_plan,
     )
     res = sim.run()
     print(json.dumps(res.summary(), sort_keys=True))
@@ -185,6 +209,64 @@ def cmd_obs_export(args) -> int:
         "trace": str(args.out),
         "trace_events": len(doc["traceEvents"]),
     }, sort_keys=True))
+    return 0
+
+
+def cmd_faults(args) -> int:
+    """Fault-injection demo: one seeded chaos replay (Philly-like trace,
+    finite MTBF) per policy config, reporting the goodput decomposition —
+    which policies degrade gracefully as hardware gets flakier.
+
+    ``tools/fault_sweep.py`` is the full MTBF x policy grid; this
+    subcommand is its single-MTBF slice, small enough to eyeball.
+    """
+    from gpuschedule_tpu.faults.sweep import POLICY_CONFIGS, jsonable, run_cell
+
+    keys = args.policies.split(",") if args.policies else list(POLICY_CONFIGS)
+    unknown = [k for k in keys if k not in POLICY_CONFIGS]
+    if unknown:
+        raise SystemExit(
+            f"unknown policy configs {unknown}; known: {sorted(POLICY_CONFIGS)}"
+        )
+    if args.restore == "auto":
+        restore: object = "auto"
+    else:
+        try:
+            restore = float(args.restore)
+        except ValueError:
+            raise SystemExit(
+                f"--restore wants seconds or 'auto', got {args.restore!r}"
+            ) from None
+    cells = [
+        run_cell(
+            k,
+            mtbf=args.mtbf,
+            repair=args.repair,
+            ckpt=args.ckpt,
+            restore=restore,
+            num_jobs=args.num_jobs,
+            seed=args.seed,
+            dims=_parse_dims(args.dims),
+            num_pods=args.pods,
+            max_time=args.max_time,
+        )
+        for k in keys
+    ]
+    doc = jsonable({  # --mtbf inf must stay strict JSON ("inf", not Infinity)
+        "mtbf_s": args.mtbf,
+        "repair_s": args.repair,
+        "ckpt_s": args.ckpt,
+        "seed": args.seed,
+        "num_jobs": args.num_jobs,
+        "cells": cells,
+    })
+    print(json.dumps(doc, sort_keys=True))
+    if args.out:
+        from pathlib import Path
+
+        out = Path(args.out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(doc, indent=2, sort_keys=True))
     return 0
 
 
@@ -672,6 +754,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                      help="enable the obs span tracer (engine batches + "
                           "policy invocations); writes spans.trace.json "
                           "under --out and prints a span summary to stderr")
+    run.add_argument("--faults", metavar="SPEC",
+                     help="inject hardware faults: k=v pairs, e.g. "
+                          "mtbf=86400,repair=3600,ckpt=1800 (keys: mtbf, "
+                          "repair, maintenance, maintenance_duration, spot, "
+                          "spot_mtbf, spot_outage, ckpt, restore; seconds, "
+                          "inf ok, restore=auto derives cost from the model "
+                          "size).  The fault schedule derives from --seed "
+                          "via an independent RNG stream, so trace and "
+                          "faults reproduce together")
     run.add_argument("--prom", metavar="PATH",
                      help="write run counters/gauges/histograms in the "
                           "Prometheus text exposition format (with --out, "
@@ -690,6 +781,36 @@ def main(argv: Optional[List[str]] = None) -> int:
     gen.add_argument("--util-min", type=float, default=1.0)
     gen.add_argument("--out", required=True)
     gen.set_defaults(fn=cmd_gen_trace)
+
+    fl = sub.add_parser(
+        "faults",
+        help="fault-injection demo: seeded chaos replay across the policy "
+             "suite with goodput decomposition",
+    )
+    fl.add_argument("--policies",
+                    help="comma list of policy configs (default: the whole "
+                         "eight-policy suite; see tools/fault_sweep.py)")
+    fl.add_argument("--mtbf", type=float, default=6 * 3600.0,
+                    help="per-chip mean time between failures, seconds "
+                         "(inf = fault-free control arm)")
+    fl.add_argument("--repair", type=float, default=3600.0,
+                    help="mean repair duration, seconds")
+    fl.add_argument("--ckpt", type=float, default=1800.0,
+                    help="checkpoint interval in work-seconds (progress "
+                         "rolls back to the last multiple on a fault)")
+    fl.add_argument("--restore", default="auto",
+                    help="restart cost per revocation: seconds, or 'auto' "
+                         "to derive from model size and slice shape")
+    fl.add_argument("--num-jobs", type=int, default=200,
+                    help="Philly-like trace length")
+    fl.add_argument("--seed", type=int, default=0,
+                    help="governs trace AND fault streams (seed-split rule)")
+    fl.add_argument("--dims", default="8x8", help="TPU pod dims")
+    fl.add_argument("--pods", type=int, default=1)
+    fl.add_argument("--max-time", type=float,
+                    help="horizon cutoff (also bounds schedule generation)")
+    fl.add_argument("--out", help="also write the JSON document here")
+    fl.set_defaults(fn=cmd_faults)
 
     cmp_ = sub.add_parser("compare-topology",
                           help="config #5: GPU placement schemes vs TPU slices")

@@ -37,8 +37,21 @@ class ClusterBase:
         raise NotImplementedError
 
     @property
+    def unhealthy_chips(self) -> int:
+        """Chips currently offline under the fault health mask (faults/).
+
+        Flavors with a health mask override this; the default 0 keeps
+        fault-free clusters exactly as before.  Under the engine's fault
+        invariant (victims are revoked in the same event that marks their
+        chips unhealthy), unhealthy chips are never also occupied, so
+        subtracting both ``used`` and ``unhealthy`` from the total never
+        double-counts.
+        """
+        return 0
+
+    @property
     def free_chips(self) -> int:
-        return self.total_chips - self.used_chips
+        return self.total_chips - self.used_chips - self.unhealthy_chips
 
     def allocate(self, num_chips: int, *, job=None, hint: Optional[dict] = None):
         """Grant ``num_chips`` chips or return ``None`` (all-or-nothing)."""
@@ -46,6 +59,27 @@ class ClusterBase:
 
     def free(self, allocation: Allocation) -> None:
         raise NotImplementedError
+
+    # ---- fault health mask (faults/) ---------------------------------- #
+
+    def mark_unhealthy(self, scope) -> list:
+        """Take the chips named by a fault ``scope`` offline.
+
+        Returns the alloc_ids of live allocations (including overlays
+        sharing a victim base) that overlap the scope — the engine revokes
+        the jobs holding them.  Marking is a counter, not a flag: the same
+        chip can be inside several overlapping outages and only returns to
+        service once every one of them is repaired.
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} has no fault health mask"
+        )
+
+    def repair(self, scope) -> None:
+        """Undo one :meth:`mark_unhealthy` for the same ``scope``."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no fault health mask"
+        )
 
     def can_allocate(self, num_chips: int) -> bool:
         """Cheap feasibility probe (may be optimistic only for flavors where
@@ -154,6 +188,7 @@ class SimpleCluster(OverlayMixin, ClusterBase):
     def __init__(self, total_chips: int):
         self.total_chips = int(total_chips)
         self._used = 0
+        self._unhealthy = 0
         self._ids = itertools.count()
         self._live: dict[int, int] = {}
         self._init_overlays()
@@ -161,6 +196,46 @@ class SimpleCluster(OverlayMixin, ClusterBase):
     @property
     def used_chips(self) -> int:
         return self._used
+
+    @property
+    def unhealthy_chips(self) -> int:
+        # min() guards the window inside a fault event between marking and
+        # the engine revoking the overlapping victims: free_chips must not
+        # go negative while both "occupied" and "unhealthy" briefly overlap.
+        return min(self._unhealthy, self.total_chips - self._used)
+
+    def mark_unhealthy(self, scope) -> list:
+        """Flat-pool outage: ``("chips", n)`` takes n fungible chips down.
+
+        Chips are drawn from the free pool first; only the shortfall
+        revokes live allocations (whole gangs, oldest first — deterministic
+        and cheap to reason about), plus any overlays packed onto them.
+        """
+        if scope[0] != "chips":
+            raise ValueError(
+                f"SimpleCluster faults take ('chips', n) scopes, got {scope!r}"
+            )
+        n = int(scope[1])
+        shortfall = n - max(0, self.total_chips - self._used - self._unhealthy)
+        self._unhealthy += n
+        victims: list = []
+        if shortfall > 0:
+            for aid in sorted(self._live):
+                victims.append(aid)
+                shortfall -= self._live[aid]
+                if shortfall <= 0:
+                    break
+        if victims:
+            bases = set(victims)
+            victims += sorted(o for o, b in self._overlays.items() if b in bases)
+        return victims
+
+    def repair(self, scope) -> None:
+        if scope[0] != "chips":
+            raise ValueError(
+                f"SimpleCluster faults take ('chips', n) scopes, got {scope!r}"
+            )
+        self._unhealthy = max(0, self._unhealthy - int(scope[1]))
 
     def allocate(self, num_chips: int, *, job=None, hint: Optional[dict] = None):
         overlay = self._try_overlay(num_chips, hint, job)

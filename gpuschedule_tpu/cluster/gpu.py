@@ -78,6 +78,7 @@ class GpuCluster(ClusterBase):
         self._ids = itertools.count()
         self._live: Dict[int, GpuPlacement] = {}
         self._rng = random.Random(seed)
+        self._down: Dict[NodeId, int] = {}  # node -> overlapping outage count
         self.fragmentation_failures = 0  # topology-strict refusals
 
     # ------------------------------------------------------------------ #
@@ -85,6 +86,57 @@ class GpuCluster(ClusterBase):
     @property
     def used_chips(self) -> int:
         return self._used
+
+    @property
+    def unhealthy_chips(self) -> int:
+        # free GPUs on down nodes: occupied-and-down only exists transiently
+        # inside a fault event, before the engine revokes the victims, so
+        # counting the free side keeps free_chips consistent throughout
+        return sum(self._free[nd] for nd in self._down)
+
+    # ------------------------------------------------------------------ #
+    # fault health mask (faults/)
+
+    def _node_scope(self, scope) -> NodeId:
+        if scope[0] != "node":
+            raise ValueError(
+                f"GpuCluster faults take ('node', switch, node) scopes, got {scope!r}"
+            )
+        nd = (int(scope[1]), int(scope[2]))
+        if nd not in self._free:
+            raise ValueError(f"fault node {nd} not in {self!r}")
+        return nd
+
+    def mark_unhealthy(self, scope) -> list:
+        """Take a host node offline (the Philly failure domain); returns
+        the alloc_ids of gangs with any GPU on it."""
+        nd = self._node_scope(scope)
+        self._down[nd] = self._down.get(nd, 0) + 1
+        return sorted(
+            aid
+            for aid, placement in self._live.items()
+            if any(node == nd for node, _ in placement.nodes)
+        )
+
+    def repair(self, scope) -> None:
+        nd = self._node_scope(scope)
+        count = self._down.get(nd, 0)
+        if count <= 0:
+            raise ValueError(f"repair of healthy node {nd}")
+        if count == 1:
+            del self._down[nd]
+        else:
+            self._down[nd] = count - 1
+
+    def _avail(self) -> Dict[NodeId, int]:
+        """Per-node free GPUs the placement schemes may use: ``_free``
+        itself on a healthy fleet (zero-copy fault-free path), down nodes
+        masked to zero otherwise."""
+        if not self._down:
+            return self._free
+        return {
+            nd: (0 if nd in self._down else f) for nd, f in self._free.items()
+        }
 
     def is_satisfiable(self, num_chips: int) -> bool:
         if num_chips <= 0:
@@ -141,14 +193,15 @@ class GpuCluster(ClusterBase):
         )
 
     def _select(self, n: int, scheme: str) -> Optional[List[Tuple[NodeId, int]]]:
+        avail = self._avail()  # schemes never see GPUs on down nodes
         if scheme == "consolidated":
-            return self._select_consolidated(n)
+            return self._select_consolidated(n, avail)
         if scheme == "random":
-            return self._select_random(n)
+            return self._select_random(n, avail)
         if scheme == "greedy":
-            return self._select_greedy(n)
+            return self._select_greedy(n, avail)
         if scheme == "topology":
-            return self._select_topology(n)
+            return self._select_topology(n, avail)
         raise ValueError(f"unknown scheme {scheme!r}")
 
     def _fill_fullest_first(
@@ -165,17 +218,19 @@ class GpuCluster(ClusterBase):
                 return sel
         return None
 
-    def _select_consolidated(self, n: int) -> Optional[List[Tuple[NodeId, int]]]:
+    def _select_consolidated(
+        self, n: int, avail: Dict[NodeId, int]
+    ) -> Optional[List[Tuple[NodeId, int]]]:
         """Fewest nodes: best-fit a single node; else prefer a single-switch
         fill (the 0.9x tier) over an equally-compact cross-switch one."""
-        fits = [(f, node) for node, f in self._free.items() if f >= n]
+        fits = [(f, node) for node, f in avail.items() if f >= n]
         if fits:
             f, node = min(fits)  # tightest fit limits future fragmentation
             return [(node, n)]
         # same-switch candidates first: pick the switch needing fewest nodes
         best: Optional[List[Tuple[NodeId, int]]] = None
         for s in range(self.num_switches):
-            nodes = [((s, i), self._free[(s, i)]) for i in range(self.nodes_per_switch)]
+            nodes = [((s, i), avail[(s, i)]) for i in range(self.nodes_per_switch)]
             if sum(f for _, f in nodes) < n:
                 continue
             sel = self._fill_fullest_first(nodes, n)
@@ -183,24 +238,28 @@ class GpuCluster(ClusterBase):
                 best = sel
         if best is not None:
             return best
-        return self._fill_fullest_first(list(self._free.items()), n)
+        return self._fill_fullest_first(list(avail.items()), n)
 
-    def _select_random(self, n: int) -> Optional[List[Tuple[NodeId, int]]]:
-        nodes = [node for node, f in self._free.items() if f > 0]
+    def _select_random(
+        self, n: int, avail: Dict[NodeId, int]
+    ) -> Optional[List[Tuple[NodeId, int]]]:
+        nodes = [node for node, f in avail.items() if f > 0]
         self._rng.shuffle(nodes)
         sel, need = [], n
         for node in nodes:
-            take = min(self._free[node], need)
+            take = min(avail[node], need)
             sel.append((node, take))
             need -= take
             if need == 0:
                 return sel
         return None
 
-    def _select_greedy(self, n: int) -> Optional[List[Tuple[NodeId, int]]]:
+    def _select_greedy(
+        self, n: int, avail: Dict[NodeId, int]
+    ) -> Optional[List[Tuple[NodeId, int]]]:
         sel, need = [], n
-        for node in sorted(self._free):  # first-fit scan in tree order
-            f = self._free[node]
+        for node in sorted(avail):  # first-fit scan in tree order
+            f = avail[node]
             if f <= 0:
                 continue
             take = min(f, need)
@@ -210,17 +269,19 @@ class GpuCluster(ClusterBase):
                 return sel
         return None
 
-    def _select_topology(self, n: int) -> Optional[List[Tuple[NodeId, int]]]:
+    def _select_topology(
+        self, n: int, avail: Dict[NodeId, int]
+    ) -> Optional[List[Tuple[NodeId, int]]]:
         """Strict NVLink islands: a gang that fits one node must get one
         node; a bigger gang must stay on one switch; else refuse."""
         if n <= self.gpus_per_node:
-            fits = [(f, node) for node, f in self._free.items() if f >= n]
+            fits = [(f, node) for node, f in avail.items() if f >= n]
             if not fits:
                 return None
             f, node = min(fits)
             return [(node, n)]
         for s in range(self.num_switches):
-            nodes = [((s, i), self._free[(s, i)]) for i in range(self.nodes_per_switch)]
+            nodes = [((s, i), avail[(s, i)]) for i in range(self.nodes_per_switch)]
             if sum(f for _, f in nodes) >= n:
                 sel = self._fill_fullest_first(nodes, n)
                 if sel is not None:

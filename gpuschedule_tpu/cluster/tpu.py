@@ -218,6 +218,14 @@ class TpuCluster(OverlayMixin, ClusterBase):
         self._occ: List[np.ndarray] = [
             np.zeros(self.dims, dtype=np.int8) for _ in range(self.num_pods)
         ]
+        # health[pod] counts overlapping outages per chip (faults/): a chip
+        # is unhealthy while its count > 0.  _unhealthy_cells tracks how
+        # many cells are nonzero so the fault-free hot path stays a single
+        # int compare (no grid scan when nothing is broken).
+        self._health: List[np.ndarray] = [
+            np.zeros(self.dims, dtype=np.int16) for _ in range(self.num_pods)
+        ]
+        self._unhealthy_cells = 0
         self._used = 0
         self._ids = itertools.count()
         self._live: Dict[int, SliceGeometry] = {}
@@ -234,6 +242,96 @@ class TpuCluster(OverlayMixin, ClusterBase):
     @property
     def used_chips(self) -> int:
         return self._used
+
+    @property
+    def unhealthy_chips(self) -> int:
+        """Unoccupied chips currently inside an outage (free_chips subtracts
+        these; occupied-and-unhealthy only exists transiently inside a fault
+        event, before the engine revokes the victims)."""
+        if self._unhealthy_cells == 0:
+            return 0
+        return int(
+            sum(
+                ((h > 0) & (o == 0)).sum()
+                for h, o in zip(self._health, self._occ)
+            )
+        )
+
+    # ------------------------------------------------------------------ #
+    # fault health mask (faults/)
+
+    def _fault_boxes(
+        self, scope
+    ) -> List[Tuple[int, Tuple[int, ...], Tuple[int, ...]]]:
+        """Normalize a fault scope to (pod, origin, shape) boxes."""
+        kind = scope[0]
+        if kind == "chip":
+            coord = tuple(int(c) for c in scope[2])
+            return [(int(scope[1]), coord, tuple(1 for _ in coord))]
+        if kind == "box":
+            return [(int(scope[1]), tuple(scope[2]), tuple(scope[3]))]
+        if kind == "pod":
+            return [(int(scope[1]), tuple(0 for _ in self.dims), self.dims)]
+        raise ValueError(
+            f"TpuCluster faults take chip/box/pod scopes, got {scope!r}"
+        )
+
+    @staticmethod
+    def _boxes_overlap(o1, s1, o2, s2) -> bool:
+        return all(
+            a < b + t and b < a + s for a, s, b, t in zip(o1, s1, o2, s2)
+        )
+
+    def _geom_overlaps(self, geom, pod, origin, shape) -> bool:
+        if isinstance(geom, MultiSliceGeometry):
+            return any(
+                s.pod == pod
+                and self._boxes_overlap(s.origin, s.shape, origin, shape)
+                for s in geom.slices
+            )
+        return geom.pod == pod and self._boxes_overlap(
+            geom.origin, geom.shape, origin, shape
+        )
+
+    def mark_unhealthy(self, scope) -> List[int]:
+        """Take a chip/box/pod offline; returns overlapping live alloc_ids
+        (plus overlays packed onto them) for the engine to revoke."""
+        victims = set()
+        for pod, origin, shape in self._fault_boxes(scope):
+            if not 0 <= pod < self.num_pods:
+                raise ValueError(f"fault pod {pod} out of range for {self!r}")
+            h = self._box(self._health[pod], origin, shape)
+            self._unhealthy_cells += int((h == 0).sum())
+            h += 1
+            for aid, geom in self._live.items():
+                if self._geom_overlaps(geom, pod, origin, shape):
+                    victims.add(aid)
+        victims |= {o for o, b in self._overlays.items() if b in victims}
+        return sorted(victims)
+
+    def repair(self, scope) -> None:
+        for pod, origin, shape in self._fault_boxes(scope):
+            h = self._box(self._health[pod], origin, shape)
+            if (h <= 0).any():
+                raise ValueError(f"repair of healthy chips: {scope!r}")
+            h -= 1
+            self._unhealthy_cells -= int((h == 0).sum())
+
+    def _blocked(self, pod: int) -> np.ndarray:
+        """Grid the slice search scans: occupancy, plus the health mask
+        when any chip is down (the fault-free path returns ``_occ``
+        itself — zero copies, zero behavior change)."""
+        occ = self._occ[pod]
+        if self._unhealthy_cells == 0:
+            return occ
+        return occ + (self._health[pod] > 0)
+
+    def pod_free_chips(self, pod: int) -> int:
+        """Healthy free chips in one pod (fault-evacuation planning)."""
+        free = self._occ[pod] == 0
+        if self._unhealthy_cells:
+            free &= self._health[pod] == 0
+        return int(free.sum())
 
     def round_up(self, num_chips: int) -> int:
         """Smallest valid allocation size >= num_chips: a power-of-two
@@ -296,7 +394,7 @@ class TpuCluster(OverlayMixin, ClusterBase):
             return None
         for pod in pods:
             for shape in shapes:
-                origin = self._find_free_box(self._occ[pod], shape, origin_order)
+                origin = self._find_free_box(self._blocked(pod), shape, origin_order)
                 if origin is not None:
                     return self._grant(pod, origin, shape)
         if "pod" not in hint and "shape" not in hint:
@@ -307,8 +405,15 @@ class TpuCluster(OverlayMixin, ClusterBase):
 
     def _empty_pods(self) -> List[int]:
         """Indices of pods with no occupied cell — the only pods a
-        multislice may claim (single source of the emptiness invariant)."""
-        return [p for p, occ in enumerate(self._occ) if not occ.any()]
+        multislice may claim (single source of the emptiness invariant).
+        A pod with any unhealthy chip is not empty: a multislice per-pod
+        slice is the full torus, so one broken chip disqualifies it."""
+        return [
+            p
+            for p, occ in enumerate(self._occ)
+            if not occ.any()
+            and (self._unhealthy_cells == 0 or not self._health[p].any())
+        ]
 
     def _allocate_multislice(self, num_chips: int, *, job=None):
         """Grant a gang larger than one pod as whole empty pods joined
@@ -435,8 +540,8 @@ class TpuCluster(OverlayMixin, ClusterBase):
             return len(self._empty_pods()) >= m
         shapes = valid_slice_shapes(num_chips, self.dims)
         return any(
-            self._find_free_box(occ, shape, None) is not None
-            for occ in self._occ
+            self._find_free_box(self._blocked(pod), shape, None) is not None
+            for pod in range(self.num_pods)
             for shape in shapes
         )
 

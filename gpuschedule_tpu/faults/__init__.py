@@ -1,0 +1,42 @@
+"""Fault injection & recovery (ISSUE 2 tentpole).
+
+A new axis of the simulation: hardware breaks.  The package splits into
+
+- :mod:`gpuschedule_tpu.faults.schedule` — deterministic seeded fault-
+  schedule generators (per-chip MTBF exponential processes, planned
+  maintenance windows, spot/preemptible revocation) emitting
+  ``FaultRecord(time, scope, duration, kind)`` records, plus the CLI
+  ``--faults`` spec parser and the seed-split rule shared with trace
+  synthesis;
+- :mod:`gpuschedule_tpu.faults.recovery` — the victim recovery model
+  (checkpoint-interval rollback + restore cost) and the ``FaultPlan``
+  bundle the engine consumes;
+- :mod:`gpuschedule_tpu.faults.sweep` — the MTBF x policy robustness
+  grid behind ``tools/fault_sweep.py`` and the CLI ``faults`` demo.
+
+The engine side lives in :mod:`gpuschedule_tpu.sim.engine` (``_FAULT`` /
+``_REPAIR`` event kinds); the cluster side is the health mask each
+flavor implements (``mark_unhealthy`` / ``repair`` / ``unhealthy_chips``
+in :mod:`gpuschedule_tpu.cluster`).  Like the sim core, this package is
+deliberately JAX-free.
+"""
+
+from gpuschedule_tpu.faults.recovery import FaultPlan, RecoveryModel, make_fault_plan
+from gpuschedule_tpu.faults.schedule import (
+    FaultConfig,
+    FaultRecord,
+    fault_horizon,
+    generate_fault_schedule,
+    parse_fault_spec,
+)
+
+__all__ = [
+    "FaultConfig",
+    "FaultRecord",
+    "FaultPlan",
+    "RecoveryModel",
+    "fault_horizon",
+    "generate_fault_schedule",
+    "make_fault_plan",
+    "parse_fault_spec",
+]

@@ -1,0 +1,88 @@
+"""Fault recovery model: checkpoint rollback + restore cost.
+
+What a fault costs a victim job is decided here, not in the engine: the
+engine mechanically applies whatever this model says.  The model is the
+standard periodic-checkpoint one (the Philly clusters checkpointed
+long-running jobs; Gandiva's suspend/resume measurements are the cost
+anchor this repo already models in :mod:`gpuschedule_tpu.sim.overhead`):
+
+- **lost progress**: a job checkpoints every ``ckpt_interval``
+  reference-speed seconds of work (per-job ``Job.ckpt_interval`` wins over
+  the model default), so a revocation rolls ``executed_work`` back to the
+  last checkpoint multiple — ``executed_work % interval`` work-seconds are
+  forfeited.  ``interval=inf`` means "never checkpoints" (all progress
+  lost); ``interval<=0`` means continuous checkpointing (nothing lost).
+- **restore cost**: seconds of ``overhead_remaining`` charged at
+  revocation time and burned (at wall-clock rate, before any new work
+  accrues) once the job next runs — the existing suspend/resume overhead
+  path.  ``restore="auto"`` derives the cost from the job's model size and
+  gang via :func:`gpuschedule_tpu.sim.overhead.resolve_overhead`; a float
+  is a flat cost in seconds.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import List, Optional, Union
+
+from gpuschedule_tpu.faults.schedule import (
+    FaultConfig,
+    FaultRecord,
+    generate_fault_schedule,
+)
+from gpuschedule_tpu.sim.overhead import resolve_overhead
+
+
+@dataclass
+class RecoveryModel:
+    """How a victim job recovers from a revocation."""
+
+    ckpt_interval: float = 1800.0           # work-seconds between checkpoints
+    restore: Union[float, str] = "auto"     # seconds, or "auto" (sim/overhead.py)
+
+    def checkpoint_interval(self, job) -> float:
+        ji = getattr(job, "ckpt_interval", None)
+        return self.ckpt_interval if ji is None else float(ji)
+
+    def lost_progress(self, job) -> float:
+        """Reference-speed seconds of work rolled back by one revocation."""
+        interval = self.checkpoint_interval(job)
+        if interval <= 0.0:
+            return 0.0
+        if math.isinf(interval):
+            return job.executed_work
+        return math.fmod(job.executed_work, interval)
+
+    def restore_overhead(self, job, cluster) -> float:
+        """Seconds of modeled restart cost charged to one victim."""
+        return resolve_overhead(self.restore, job, cluster)
+
+
+@dataclass
+class FaultPlan:
+    """Everything the engine needs to run a faulty replay: the (already
+    generated, time-sorted) fault schedule plus the recovery model applied
+    to every victim.  An empty ``records`` list is a valid plan — the
+    fault path is armed but never fires (the ``mtbf=inf`` case)."""
+
+    records: List[FaultRecord] = field(default_factory=list)
+    recovery: RecoveryModel = field(default_factory=RecoveryModel)
+
+
+def make_fault_plan(
+    cluster,
+    config: Optional[FaultConfig] = None,
+    recovery: Optional[RecoveryModel] = None,
+    *,
+    horizon: float,
+    seed: int = 0,
+) -> FaultPlan:
+    """Convenience constructor: generate the schedule and bundle it with a
+    recovery model (both defaulted) into one plan."""
+    return FaultPlan(
+        records=generate_fault_schedule(
+            cluster, config or FaultConfig(), horizon=horizon, seed=seed
+        ),
+        recovery=recovery or RecoveryModel(),
+    )

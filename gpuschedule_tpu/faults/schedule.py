@@ -1,0 +1,284 @@
+"""Deterministic seeded fault-schedule generation.
+
+The Philly study this repo replays (ATC'19 [P]) is as much about failures
+as about queueing: roughly a third of jobs do not complete successfully,
+and the paper's failure analysis attributes a large share of lost goodput
+to hardware faults and restarts.  This module generates the *hardware*
+half of that story: a reproducible schedule of ``FaultRecord(time, scope,
+duration, kind)`` events a :class:`~gpuschedule_tpu.sim.engine.Simulator`
+injects as ``_FAULT``/``_REPAIR`` event pairs.
+
+Three fault processes, each with its own RNG stream:
+
+- **MTBF chip failures** (``kind="mtbf"``): every chip is an independent
+  exponential process with mean ``mtbf`` seconds, so the fleet fails as a
+  Poisson superposition at rate ``total_chips / mtbf``; each failure takes
+  one topology unit offline (a TPU chip, a GPU host node — Philly's
+  failure domain — or one flat-pool chip) for an exponentially distributed
+  repair time with mean ``repair``.
+- **Planned maintenance** (``kind="maintenance"``): deterministic windows
+  every ``maintenance_period`` seconds, rotating over pods (TPU), nodes
+  (GPU), or an eighth of the flat pool, each lasting
+  ``maintenance_duration`` seconds.
+- **Spot/preemptible revocation** (``kind="spot"``): the last
+  ``spot_fraction`` of capacity (whole pods / nodes / a chip block) is
+  preemptible; each spot unit is revoked at exponentially distributed
+  intervals with mean ``spot_mtbf`` for a fixed ``spot_outage``.
+
+Seed-split rule (the reproducibility contract, shared with ``cli.py``):
+one user-facing ``--seed`` governs every stochastic stream in a run.
+Trace synthesis keeps the bare seed (``random.Random(seed)``, unchanged
+from before faults existed), while each fault process derives its own
+independent stream as ``random.Random(f"{seed}:faults:<process>")`` with
+``<process>`` in ``{"mtbf", "spot"}`` (maintenance is deterministic).
+String seeding hashes stably across runs and platforms, so the same seed
+always yields byte-identical trace *and* fault schedules, and changing
+the fault config never perturbs the trace stream (or vice versa).
+
+Scope tuples are cluster-flavor specific (the injector hands them back to
+``cluster.mark_unhealthy`` / ``cluster.repair`` verbatim):
+
+- ``("chips", n)`` — n fungible chips of a flat pool;
+- ``("chip", pod, coord)`` — one chip of a TPU torus;
+- ``("box", pod, origin, shape)`` — an axis-aligned TPU sub-box;
+- ``("pod", pod)`` — a whole TPU pod;
+- ``("node", switch, node)`` — a whole GPU host node.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class FaultRecord:
+    """One hardware outage: ``scope`` goes down at ``time`` for
+    ``duration`` seconds (``inf`` = never repaired)."""
+
+    time: float
+    scope: Tuple
+    duration: float
+    kind: str = "mtbf"  # mtbf | maintenance | spot
+
+    @property
+    def label(self) -> str:
+        """Stable human-readable scope name (Perfetto health tracks, event
+        stream records); pure function of the scope tuple."""
+        s = self.scope
+        if s[0] == "chips":
+            return f"chips[{s[1]}]"
+        if s[0] == "chip":
+            return f"pod{s[1]}/chip@" + ",".join(str(c) for c in s[2])
+        if s[0] == "box":
+            shape = "x".join(str(c) for c in s[3])
+            origin = ",".join(str(c) for c in s[2])
+            return f"pod{s[1]}/{shape}@{origin}"
+        if s[0] == "pod":
+            return f"pod{s[1]}"
+        if s[0] == "node":
+            return f"gpu/s{s[1]}n{s[2]}"
+        return str(s)
+
+
+@dataclass
+class FaultConfig:
+    """Knobs for the three fault processes.  Defaults are all-off
+    (``mtbf=inf``, no maintenance, no spot capacity): constructing a plan
+    from the default config exercises the fault path with zero faults."""
+
+    mtbf: float = math.inf              # per-chip mean time between failures (s)
+    repair: float = 3600.0              # mean repair duration (s)
+    maintenance_period: float = 0.0     # seconds between planned windows (0 = off)
+    maintenance_duration: float = 7200.0
+    spot_fraction: float = 0.0          # trailing fraction of capacity that is spot
+    spot_mtbf: float = 4 * 3600.0       # mean time between revocations per unit
+    spot_outage: float = 1800.0         # fixed outage per revocation
+
+
+def fault_horizon(jobs: Sequence, *, slack: float = 2.0) -> float:
+    """Replay-length bound for schedule generation: the last submission
+    plus ``slack`` times the total serial work.
+
+    The serial-work term alone is NOT an upper bound under faults — every
+    revocation adds rework (back to the last checkpoint) plus restore cost,
+    and repair downtime idles capacity — so ``slack`` pads it (2x covers
+    any fault mix where less than half of all chip-time is rework, far
+    beyond the realistic MTBF grid).  A pathological run that still outruns
+    the horizon simply sees no faults past it.  Overshoot in the other
+    direction (parallel clusters finish well before serial time) only costs
+    schedule entries: the engine discards records once every job has
+    reached an end state.  Callers with a ``max_time`` cutoff should pass
+    that instead — it is exact."""
+    if not jobs:
+        return 0.0
+    return max(j.submit_time for j in jobs) + slack * sum(
+        j.duration for j in jobs
+    )
+
+
+def _flavor(cluster) -> Tuple[str, object]:
+    """(flavor, unwrapped cluster): 'tpu' | 'gpu' | 'flat'.  Placement
+    wrappers (``PlacedTpuCluster``) delegate by ``__getattr__``, so the
+    inner cluster is what carries the topology attributes."""
+    inner = getattr(cluster, "inner", cluster)
+    if hasattr(inner, "pod_chips") and hasattr(inner, "dims"):
+        return "tpu", inner
+    if hasattr(inner, "nodes_per_switch"):
+        return "gpu", inner
+    return "flat", inner
+
+
+def generate_fault_schedule(
+    cluster,
+    config: FaultConfig,
+    *,
+    horizon: float,
+    seed: int = 0,
+) -> List[FaultRecord]:
+    """Generate the full, time-sorted fault schedule for one replay.
+
+    Deterministic per (cluster shape, config, horizon, seed): the MTBF and
+    spot processes draw from independent ``random.Random(f"{seed}:faults:
+    <process>")`` streams (module docstring seed-split rule), so two calls
+    with the same arguments return byte-identical schedules.
+    """
+    flavor, inner = _flavor(cluster)
+    records: List[FaultRecord] = []
+
+    # -- MTBF chip failures -------------------------------------------- #
+    if config.mtbf > 0 and math.isfinite(config.mtbf) and horizon > 0:
+        rng = random.Random(f"{seed}:faults:mtbf")
+        rate = inner.total_chips / config.mtbf
+        # repair=inf means failures are permanent (duration=inf, the
+        # engine's never-repaired case); repair<=0 is an instant blip
+        # that still revokes overlapping gangs
+        def repair_duration() -> float:
+            if math.isinf(config.repair):
+                return math.inf
+            if config.repair > 0:
+                return rng.expovariate(1.0 / config.repair)
+            return 0.0
+
+        t = rng.expovariate(rate)
+        while t <= horizon:
+            if flavor == "tpu":
+                pod = rng.randrange(inner.num_pods)
+                coord = tuple(rng.randrange(d) for d in inner.dims)
+                scope: Tuple = ("chip", pod, coord)
+            elif flavor == "gpu":
+                # a GPU failure takes its host node offline (the Philly
+                # failure domain is the machine, not the device)
+                scope = (
+                    "node",
+                    rng.randrange(inner.num_switches),
+                    rng.randrange(inner.nodes_per_switch),
+                )
+            else:
+                scope = ("chips", 1)
+            records.append(FaultRecord(t, scope, repair_duration(), "mtbf"))
+            t += rng.expovariate(rate)
+
+    # -- planned maintenance windows (deterministic) ------------------- #
+    if config.maintenance_period > 0 and horizon > 0:
+        k = 1
+        t = config.maintenance_period
+        while t <= horizon:
+            if flavor == "tpu":
+                scope = ("pod", (k - 1) % inner.num_pods)
+            elif flavor == "gpu":
+                n_nodes = inner.num_switches * inner.nodes_per_switch
+                idx = (k - 1) % n_nodes
+                scope = ("node", idx // inner.nodes_per_switch,
+                         idx % inner.nodes_per_switch)
+            else:
+                scope = ("chips", max(1, inner.total_chips // 8))
+            records.append(
+                FaultRecord(t, scope, config.maintenance_duration, "maintenance")
+            )
+            k += 1
+            t = k * config.maintenance_period
+
+    # -- spot/preemptible revocation ----------------------------------- #
+    # spot_mtbf=inf (or <=0) means the spot capacity is never revoked:
+    # no records, rather than a ZeroDivisionError out of expovariate
+    if (
+        config.spot_fraction > 0
+        and horizon > 0
+        and config.spot_mtbf > 0
+        and math.isfinite(config.spot_mtbf)
+    ):
+        rng = random.Random(f"{seed}:faults:spot")
+        units: List[Tuple] = []
+        if flavor == "tpu":
+            n = max(1, math.ceil(config.spot_fraction * inner.num_pods))
+            units = [("pod", p) for p in range(inner.num_pods - n, inner.num_pods)]
+        elif flavor == "gpu":
+            nodes = [
+                (s, n)
+                for s in range(inner.num_switches)
+                for n in range(inner.nodes_per_switch)
+            ]
+            k = max(1, math.ceil(config.spot_fraction * len(nodes)))
+            units = [("node", s, n) for s, n in nodes[-k:]]
+        else:
+            units = [("chips", max(1, math.ceil(config.spot_fraction * inner.total_chips)))]
+        for scope in units:
+            t = rng.expovariate(1.0 / config.spot_mtbf)
+            while t <= horizon:
+                records.append(FaultRecord(t, scope, config.spot_outage, "spot"))
+                # a unit cannot be revoked again while already revoked
+                t += config.spot_outage + rng.expovariate(1.0 / config.spot_mtbf)
+
+    records.sort(key=lambda r: (r.time, r.kind, repr(r.scope)))
+    return records
+
+
+# --------------------------------------------------------------------- #
+# CLI spec parsing:  run --faults mtbf=86400,repair=3600,ckpt=1800
+
+_SPEC_KEYS = {
+    "mtbf": ("config", "mtbf"),
+    "repair": ("config", "repair"),
+    "maintenance": ("config", "maintenance_period"),
+    "maintenance_duration": ("config", "maintenance_duration"),
+    "spot": ("config", "spot_fraction"),
+    "spot_mtbf": ("config", "spot_mtbf"),
+    "spot_outage": ("config", "spot_outage"),
+    "ckpt": ("recovery", "ckpt_interval"),
+    "restore": ("recovery", "restore"),
+}
+
+
+def parse_fault_spec(spec: str):
+    """Parse the CLI's ``--faults k=v,...`` spec into a
+    ``(FaultConfig, RecoveryModel)`` pair.
+
+    Keys: ``mtbf``, ``repair``, ``maintenance`` (period),
+    ``maintenance_duration``, ``spot`` (fraction), ``spot_mtbf``,
+    ``spot_outage``, ``ckpt`` (checkpoint interval), ``restore``
+    (seconds or ``auto``).  Values are seconds; ``inf`` is accepted.
+    """
+    from gpuschedule_tpu.faults.recovery import RecoveryModel
+
+    config = FaultConfig()
+    recovery = RecoveryModel()
+    for pair in spec.split(","):
+        pair = pair.strip()
+        if not pair:
+            continue
+        key, sep, raw = pair.partition("=")
+        key = key.strip().replace("-", "_")
+        if not sep or key not in _SPEC_KEYS:
+            raise ValueError(
+                f"bad --faults entry {pair!r}; known keys: {sorted(_SPEC_KEYS)}"
+            )
+        target, attr = _SPEC_KEYS[key]
+        if key == "restore" and raw.strip() == "auto":
+            value: object = "auto"
+        else:
+            value = float(raw)
+        setattr(config if target == "config" else recovery, attr, value)
+    return config, recovery

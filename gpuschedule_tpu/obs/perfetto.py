@@ -14,8 +14,13 @@ timeline" half of the observability layer (ISSUE 1 tentpole):
   occupies its track from ``start`` until the next ``preempt`` / ``migrate``
   / ``resize`` / ``finish`` boundary (migrate and resize close one interval
   and open the next, since the slice — or its size — changed);
-- **instant events ("ph":"i")** for preempt / migrate / reject, pinned to
-  the track the job occupied (rejects land on a dedicated admission track);
+- **instant events ("ph":"i")** for preempt / migrate / reject / revoke,
+  pinned to the track the job occupied (rejects land on a dedicated
+  admission track);
+- **health tracks** (faults/): each fault scope gets a thread under the
+  "health" process with a fault/repair instant pair and an "unhealthy"
+  interval spanning the outage (overlapping outages on one scope nest
+  FIFO; unrepaired ones extend to the horizon);
 - scheduling-rationale payloads (the policies' ``why`` records) ride along
   in each slice's ``args``, so clicking an interval answers *which rule put
   this job here*.
@@ -38,10 +43,10 @@ from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
 _ADMISSION_TRACK = "admission"
 _US = 1e6  # sim seconds -> trace microseconds
 
-# Event kinds that end the job's current occupancy interval; migrate/resize
-# also begin a new one (carrying the post-move track/size).
-_CLOSERS = ("preempt", "finish", "migrate", "resize")
-_INSTANTS = ("preempt", "migrate", "reject")
+# Occupancy intervals close on preempt/finish/migrate/resize/revoke
+# (migrate/resize also open the next one, carrying the post-move track/
+# size); preempt/migrate/reject/revoke/fault/repair additionally emit
+# instants.  The dispatch lives in the trace_events elif chain below.
 
 
 def track_label(detail: Any) -> str:
@@ -114,6 +119,11 @@ def trace_events(events: Iterable[dict]) -> List[dict]:
     timed: List[dict] = []
     # job -> (track, start_ts_us, args) for the open occupancy interval
     open_iv: Dict[str, Tuple[str, float, dict]] = {}
+    # fault scope label -> open outages as (start_ts_us, args) entries.
+    # Engine-emitted events carry a per-record "fid" so a repair closes ITS
+    # outage even when outages of different durations overlap on one scope;
+    # fid-less streams (hand-edited) fall back to oldest-first pairing.
+    open_health: Dict[str, List[Tuple[float, dict]]] = {}
     t_last = 0.0
 
     def close(job: str, t_us: float, note: Optional[str] = None) -> None:
@@ -159,20 +169,54 @@ def trace_events(events: Iterable[dict]) -> List[dict]:
             args = dict(iv[2]) if iv else {}
             args.update(extra)
             open_iv[job] = (new_track, t_us, args)
-        elif kind == "preempt":
+        elif kind in ("preempt", "revoke"):
             iv = open_iv.get(job)
             track = iv[0] if iv else f"job/{job}"
-            close(job, t_us, "preempt")
-            instant("preempt", track, t_us, extra)
+            close(job, t_us, kind)
+            instant(kind, track, t_us, extra)
         elif kind == "finish":
             close(job, t_us, ev.get("end_state", "finish"))
         elif kind == "reject":
             instant("reject", _ADMISSION_TRACK, t_us, extra)
+        elif kind in ("fault", "repair"):
+            # unhealthy-interval tracks: one thread per fault scope under
+            # the "health" process, an X slice per outage
+            label = str(ev.get("scope", "?"))
+            track = f"health/{label}"
+            instant(kind, track, t_us, extra)
+            if kind == "fault":
+                open_health.setdefault(label, []).append((t_us, extra))
+            else:
+                stack = open_health.get(label)
+                if stack:
+                    fid = extra.get("fid")
+                    at = next(
+                        (i for i, (_, a) in enumerate(stack)
+                         if fid is not None and a.get("fid") == fid),
+                        0,
+                    )
+                    h0, args = stack.pop(at)
+                    pid, tid = ids.ids(track)
+                    timed.append({
+                        "name": "unhealthy", "cat": "health", "ph": "X",
+                        "ts": h0, "dur": max(0.0, t_us - h0),
+                        "pid": pid, "tid": tid, "args": args,
+                    })
         # arrival / speed / rationale-only events carry no timeline geometry
 
-    # horizon cutoff: unfinished occupancies extend to the last seen time
+    # horizon cutoff: unfinished occupancies and unrepaired outages extend
+    # to the last seen time
     for job in list(open_iv):
         close(job, t_last, "horizon")
+    for label, stack in open_health.items():
+        pid, tid = ids.ids(f"health/{label}")
+        for h0, args in stack:
+            timed.append({
+                "name": "unhealthy", "cat": "health", "ph": "X",
+                "ts": h0, "dur": max(0.0, t_last - h0),
+                "pid": pid, "tid": tid,
+                "args": {**args, "ended_by": "horizon"},
+            })
 
     timed.sort(key=lambda e: (e["ts"], 0 if e["ph"] == "X" else 1))
     return ids.meta + timed

@@ -42,6 +42,23 @@ class Policy:
         d.update(detail)
         return d
 
+    def on_fault(self, sim, fault, victims) -> None:
+        """React to a hardware fault (faults/) at ``sim.now``.
+
+        The engine has already done the mechanical recovery before this is
+        called: ``fault.scope`` is marked unhealthy on the cluster and every
+        running gang overlapping it has been revoked — progress rolled back
+        to its last checkpoint, restore cost charged, job requeued as
+        PENDING (``victims`` lists them).  The default is exactly that
+        requeue: victims wait in the queue like any other pending job and
+        the next ``schedule()`` pass (the engine runs one after every fault
+        batch) places them when capacity allows.
+
+        Override to react beyond requeueing — e.g. Gandiva migrates running
+        jobs away from a degraded pod.  Implementations may use the full
+        engine mutation API; ``sim.cluster`` already reflects the outage.
+        """
+
     def schedule(self, sim) -> Optional[float]:
         """Make scheduling decisions at ``sim.now``.
 

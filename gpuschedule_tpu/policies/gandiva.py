@@ -79,6 +79,68 @@ class GandivaPolicy(Policy):
         self.growth_curve = growth_curve or DEFAULT_GROWTH_CURVE
 
     # ------------------------------------------------------------------ #
+    # fault reaction (faults/): evacuate degraded pods
+
+    def on_fault(self, sim, fault, victims) -> None:
+        """Migrate running jobs off a degraded pod.
+
+        A chip failure inside a pod both fragments it and signals elevated
+        risk (maintenance windows and spot revocations take whole pods at
+        once), so Gandiva — the one policy with a migration mechanism —
+        proactively moves unpacked survivors on the faulted pod to the
+        healthiest other pod that can hold their slice, paying the usual
+        migration overhead.  Victims re-enter the wait queue stamped with
+        the fault time, same as rotation victims (longest-waiting order
+        stays meaningful under churn).  Single-pod fleets and non-TPU
+        scopes have nowhere to evacuate to; the default requeue stands.
+        """
+        for v in victims:
+            v.sched["g_wait_since"] = sim.now
+        if fault.scope[0] not in ("chip", "box", "pod"):
+            return
+        cluster = sim.cluster
+        if getattr(cluster, "num_pods", 1) <= 1 or not hasattr(
+            cluster, "pod_free_chips"
+        ):
+            return
+        pod = fault.scope[1]
+        budget = self.max_migrations_per_event
+        groups = self._overlay_groups(sim)
+        ex = self.explaining(sim)
+        for job in list(sim.running):
+            if budget == 0:
+                break
+            geom = job.allocation.detail if job.allocation is not None else None
+            if getattr(geom, "pod", None) != pod:
+                continue  # multislice gangs (no .pod) stay put too
+            if self._is_packed(sim, job, groups):
+                continue
+            targets = sorted(
+                (p for p in range(cluster.num_pods) if p != pod),
+                key=lambda p: -cluster.pod_free_chips(p),
+            )
+            for target in targets:
+                if cluster.pod_free_chips(target) < job.allocated_chips:
+                    break  # healthiest pod first: smaller ones won't fit either
+                overhead = resolve_overhead(
+                    self.migration_overhead, job, cluster, migration=True
+                )
+                why = (
+                    self.explain(
+                        "evacuate-degraded-pod",
+                        pod=pod, target=target, fault=fault.kind,
+                    )
+                    if ex else None
+                )
+                if sim.migrate(
+                    job, overhead=overhead, placement_hint={"pod": target},
+                    why=why,
+                ):
+                    sim.metrics.count("fault_evacuations")
+                    budget -= 1
+                    break
+
+    # ------------------------------------------------------------------ #
 
     def schedule(self, sim) -> Optional[float]:
         now = sim.now

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import math
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
@@ -29,9 +30,12 @@ from gpuschedule_tpu.sim.jobset import JobSet
 from gpuschedule_tpu.sim.metrics import MetricsLog, SimResult
 
 # Event kinds, in processing-priority order at equal timestamps: completions
-# free resources before arrivals are considered, and the policy runs once
-# after the whole batch.
-_COMPLETION, _ARRIVAL, _TICK = 0, 1, 2
+# free resources before arrivals are considered, faults land after both (a
+# job finishing exactly when its chips fail completed first — nothing to
+# revoke), repairs land after the fault that scheduled them (a zero-length
+# outage still revokes, then heals, within one batch), and the policy runs
+# once after the whole batch.
+_COMPLETION, _ARRIVAL, _TICK, _FAULT, _REPAIR = 0, 1, 2, 3, 4
 
 
 class Simulator:
@@ -52,9 +56,16 @@ class Simulator:
         metrics: Optional[MetricsLog] = None,
         max_time: float = float("inf"),
         eps: float = 1e-6,
+        faults=None,
     ):
         self.cluster = cluster
         self.policy = policy
+        # Fault injection (faults/): a FaultPlan whose records become
+        # _FAULT events and whose RecoveryModel prices each revocation.
+        # None (the default) is the fault-free path, bit-identical to the
+        # pre-faults engine; an empty-record plan (mtbf=inf) arms the path
+        # without firing it.
+        self.faults = faults
         # Stable sort: ties on submit_time keep trace order, and each job gets
         # a numeric arrival sequence so policies can tie-break without relying
         # on string job_id ordering (which misorders 'j2' vs 'j10').
@@ -80,15 +91,34 @@ class Simulator:
         self.finished: List[Job] = []
         self._heap: list = []
         self._seq = itertools.count()
+        self._nonticks = 0  # heap entries that are not policy ticks
 
         for job in self.jobs:
             self._push(job.submit_time, _ARRIVAL, job)
+        # _drain_faults: records remain in the heap after every job has
+        # reached an end state (the schedule is generated to a conservative
+        # horizon); the run loops discard them by stopping early.  False
+        # for an empty plan so mtbf=inf replays stay event-for-event
+        # identical to faults=None.
+        self._drain_faults = False
+        # record identity -> stable index: fault and repair events carry it
+        # as "fid" so the Perfetto exporter pairs each repair with ITS
+        # outage even when outages of different durations overlap on one
+        # scope (FIFO pairing would mis-attribute the intervals)
+        self._fault_ids: Dict[int, int] = {}
+        if faults is not None and faults.records:
+            self._drain_faults = True
+            for i, rec in enumerate(faults.records):
+                self._fault_ids[id(rec)] = i
+                self._push(rec.time, _FAULT, rec)
         policy.attach(self)
 
     # ------------------------------------------------------------------ #
     # event plumbing
 
     def _push(self, time: float, kind: int, payload=None, epoch: int = 0) -> None:
+        if kind != _TICK:
+            self._nonticks += 1
         heapq.heappush(self._heap, (time, kind, next(self._seq), payload, epoch))
 
     def request_wakeup(self, time: float) -> None:
@@ -322,12 +352,92 @@ class Simulator:
                 "finish", self.now, job, end_state=job.state.value, track=track
             )
 
+    # ------------------------------------------------------------------ #
+    # fault injection (faults/)
+
+    def _apply_fault(self, rec) -> None:
+        """One hardware outage: mark the scope unhealthy, revoke every
+        running gang on it, schedule the repair, and let the policy react."""
+        victim_ids = self.cluster.mark_unhealthy(rec.scope)
+        self.metrics.count("faults")
+        self.metrics.count(f"faults_{rec.kind}")
+        if self.metrics.record_events:
+            self.metrics.event(
+                "fault", self.now, None,
+                scope=rec.label, fault=rec.kind, fid=self._fault_ids[id(rec)],
+                # "inf" (string) keeps events.jsonl strict JSON for
+                # never-repaired outages
+                duration=rec.duration if math.isfinite(rec.duration) else "inf",
+            )
+        if math.isfinite(rec.duration):
+            # duration <= 0 lands in this same batch (kind order puts the
+            # repair after the fault), modeling a blip that still revokes
+            self._push(self.now + max(0.0, rec.duration), _REPAIR, rec)
+        if victim_ids:
+            ids = set(victim_ids)
+            victims = [
+                j for j in self.running
+                if j.allocation is not None and j.allocation.alloc_id in ids
+            ]
+        else:
+            victims = []
+        for job in victims:
+            self._revoke(job, rec)
+        self.policy.on_fault(self, rec, victims)
+
+    def _revoke(self, job: Job, rec) -> None:
+        """Fault-revoke one running job: progress rolls back to its last
+        checkpoint, a restore cost is charged for the next run, and the job
+        requeues (the recovery model in faults/recovery.py decides both
+        amounts; this method only applies them)."""
+        record = self.metrics.record_events
+        track = track_label(job.allocation.detail) if record else None
+        job.advance(self.now)
+        recovery = self.faults.recovery
+        # priced while the gang still holds its chips (restore cost scales
+        # with the slice's host count in "auto" mode)
+        restore = recovery.restore_overhead(job, self.cluster)
+        lost = recovery.lost_progress(job)
+        if lost > 0.0 and job.executed_work > 0.0:
+            # prorate the rolled-back share of this job's useful chip-time
+            # into the lost leg of the goodput decomposition: surviving
+            # work keeps (1 - frac) of the previously-useful service
+            frac = min(1.0, lost / job.executed_work)
+            job.lost_service += frac * max(
+                0.0, job.attained_service - job.lost_service
+            )
+            job.executed_work -= lost
+            job.lost_work += lost
+        self.cluster.free(job.allocation)
+        job.allocation = None
+        job.allocated_chips = 0
+        job.speed = 0.0
+        job.locality_factor = 1.0
+        job.epoch += 1
+        job.fault_count += 1
+        # the checkpoint restore supersedes any partially burned setup cost
+        # (a job faulted mid-resume starts its recovery over)
+        job.overhead_remaining = restore
+        job.state = JobState.PENDING
+        self.running.remove(job)
+        self.pending.append(job)
+        self.metrics.count("fault_revocations")
+        if record:
+            self.metrics.event(
+                "revoke", self.now, job,
+                scope=rec.label, fault=rec.kind,
+                lost_work=round(lost, 6), restore=round(restore, 6),
+                track=track,
+            )
+
     def _drain_batch(self, t: float) -> bool:
         """Pop and apply every event at or before ``t``; True if any event
         changed scheduler-visible state (the policy must then run)."""
         dirty = False
         while self._heap and self._heap[0][0] <= t:
             _, kind, _, payload, epoch = heapq.heappop(self._heap)
+            if kind != _TICK:
+                self._nonticks -= 1
             if kind == _ARRIVAL:
                 job: Job = payload
                 job.last_update_time = t
@@ -362,6 +472,18 @@ class Simulator:
                     continue
                 self._finish(job)
                 dirty = True
+            elif kind == _FAULT:
+                self._apply_fault(payload)
+                dirty = True
+            elif kind == _REPAIR:
+                self.cluster.repair(payload.scope)
+                self.metrics.count("repairs")
+                if self.metrics.record_events:
+                    self.metrics.event(
+                        "repair", t, None, scope=payload.label,
+                        fault=payload.kind, fid=self._fault_ids[id(payload)],
+                    )
+                dirty = True  # restored capacity: waiters may now place
             else:  # _TICK
                 dirty = True
         return dirty
@@ -388,8 +510,29 @@ class Simulator:
             self.now, self.cluster, len(self.running), len(self.pending)
         )
 
+    def _quiesced(self) -> bool:
+        """Fault runs can strand jobs: a permanent outage (repair=inf) may
+        leave a once-satisfiable gang unplaceable forever.  Once nothing is
+        running and no arrival/completion/fault/repair remains — only
+        policy-requested ticks — no tick can change anything (every policy
+        already ran after the last real event and placed what fits; time
+        alone cannot un-strand a gang), so spinning through the tick chain
+        would loop forever for policies that always re-request a wakeup
+        while jobs wait (Gandiva rounds).  Gated on _drain_faults: the
+        fault-free path cannot strand jobs (unsatisfiable gangs are
+        rejected at admission) and keeps its exact pre-faults behavior."""
+        return (
+            self._drain_faults
+            and (
+                len(self.finished) == len(self.jobs)
+                or (self._nonticks == 0 and not self.running)
+            )
+        )
+
     def _run_plain(self) -> SimResult:
         while self._heap:
+            if self._quiesced():
+                break  # only fault/repair/tick residue past the last job
             t = self._heap[0][0]
             if t > self.max_time:
                 self._cutoff_at_horizon()
@@ -411,6 +554,8 @@ class Simulator:
         ) as run_sp:
             n_batches = 0
             while self._heap:
+                if self._quiesced():
+                    break  # only fault/repair/tick residue past the last job
                 t = self._heap[0][0]
                 if t > self.max_time:
                     self._cutoff_at_horizon()

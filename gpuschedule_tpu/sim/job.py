@@ -75,6 +75,11 @@ class Job:
                                         # parallelism-aware curves get a policy
                                         # consumer; pp mirrors the profiler's
                                         # pipeline-mesh keys)
+    ckpt_interval: Optional[float] = None
+                                        # work-seconds between checkpoints; a
+                                        # fault rolls progress back to the last
+                                        # multiple (None -> the fault plan's
+                                        # RecoveryModel default, faults/)
 
     # ---- runtime accounting (engine-owned) ----
     state: JobState = JobState.PENDING
@@ -95,6 +100,17 @@ class Job:
     last_update_time: float = 0.0       # progress integrated up to this sim time
     preempt_count: int = 0
     migration_count: int = 0
+    fault_count: int = 0                # revocations by hardware faults (faults/)
+    lost_work: float = 0.0              # reference-speed seconds rolled back to
+                                        # the last checkpoint by fault revocations
+    lost_service: float = 0.0           # chip-seconds attributed to rolled-back
+                                        # work (goodput decomposition: the share
+                                        # of attained_service that produced work
+                                        # a fault later erased)
+    overhead_service: float = 0.0       # chip-seconds spent burning
+                                        # overhead_remaining (modeled restart /
+                                        # migration / restore cost) while holding
+                                        # chips — the decomposition's third leg
     epoch: int = 0                      # invalidates stale scheduled completions
     arrival_seq: int = 0                # submit-order index assigned by the engine
                                         # (numeric FIFO tie-break; 'j2' < 'j10')
@@ -147,6 +163,9 @@ class Job:
         if self.overhead_remaining > 0.0:
             burned = min(self.overhead_remaining, dt)
             self.overhead_remaining -= burned
+            # chips are occupied but produce no work while overhead burns:
+            # the restart-overhead leg of the goodput decomposition
+            self.overhead_service += self.allocated_chips * burned
             dt -= burned
         if dt > 0.0:
             self.executed_work += self.effective_speed * dt

@@ -38,6 +38,8 @@ JOB_CSV_FIELDS = [
     "attained_service",
     "preempt_count",
     "migration_count",
+    "fault_count",
+    "lost_work",
     "status",
     "end_state",
     "model_name",
@@ -73,6 +75,21 @@ class SimResult:
     # objective is minimizing exactly this tail).
     p95_slowdown: float = 0.0
     max_slowdown: float = 0.0
+    # Trace-declared end states among the finished jobs (a faithful Philly
+    # replay surfaces Failed/Killed terminals, not just a finished count).
+    num_done: int = 0
+    num_failed: int = 0
+    num_killed: int = 0
+    # Goodput decomposition in chip-seconds (faults/): every chip-second of
+    # service went to exactly one leg — work that survived to the end
+    # ("useful"), work a later fault rolled back ("lost"), or modeled
+    # restart/migration/restore overhead.  useful + lost + overhead ==
+    # total by construction.  "total" is per-job service time (each job's
+    # allocated_chips x held seconds); under Gandiva overlay packing two
+    # jobs sharing one slice each accrue their own service, so the total
+    # can exceed physical occupancy — it equals it exactly when nothing
+    # is packed.
+    goodput: Dict[str, float] = field(default_factory=dict)
     jobs: List[Job] = field(repr=False, default_factory=list)
 
     def summary(self) -> Dict[str, float]:
@@ -86,6 +103,10 @@ class SimResult:
             "num_finished": self.num_finished,
             "num_unfinished": self.num_unfinished,
             "num_rejected": self.num_rejected,
+            "num_done": self.num_done,
+            "num_failed": self.num_failed,
+            "num_killed": self.num_killed,
+            **{f"goodput_{k}": v for k, v in self.goodput.items()},
             **{k: float(v) for k, v in self.counters.items()},
         }
 
@@ -150,6 +171,11 @@ class MetricsLog:
             self._reg_queue = registry.histogram(
                 "sim_queueing_delay_seconds", "submit-to-first-start delay",
                 buckets=_DELAY_BUCKETS)
+            self._reg_end_state = registry.counter(
+                "sim_jobs_end_state_total",
+                "terminal job states (trace-declared Pass/Failed/Killed "
+                "plus admission rejections)",
+                labelnames=("state",))
         self.util_samples: List[tuple] = []  # (t, used, total, running, pending)
         self.counters: Counter = Counter()
         self._all_jobs: Sequence[Job] = ()   # set by attach_jobs(); lets write()
@@ -236,6 +262,8 @@ class MetricsLog:
             "attained_service": round(job.attained_service, 6),
             "preempt_count": job.preempt_count,
             "migration_count": job.migration_count,
+            "fault_count": job.fault_count,
+            "lost_work": round(job.lost_work, 6),
             "status": job.status,
             "end_state": job.state.value,
             "model_name": job.model_name,
@@ -243,13 +271,15 @@ class MetricsLog:
 
     def record_job(self, job: Job) -> None:
         self.job_rows.append(self._job_row(job))
-        if self._registry is not None and job.state is not JobState.REJECTED:
-            j = job.jct()
-            if j is not None:
-                self._reg_jct.observe(j)
-            q = job.queueing_delay()
-            if q is not None:
-                self._reg_queue.observe(q)
+        if self._registry is not None:
+            self._reg_end_state.labels(job.state.value).inc()
+            if job.state is not JobState.REJECTED:
+                j = job.jct()
+                if j is not None:
+                    self._reg_jct.observe(j)
+                q = job.queueing_delay()
+                if q is not None:
+                    self._reg_queue.observe(q)
 
     def sample(self, t: float, cluster, num_running: int, num_pending: int) -> None:
         used, total = cluster.used_chips, cluster.total_chips
@@ -313,6 +343,19 @@ class MetricsLog:
         # (exact even when the stored sample list has been decimated).
         util = self._util_area / self._util_horizon if self._util_horizon > 0 else 0.0
         rejected = sum(1 for j in jobs if j.state is JobState.REJECTED)
+        states = Counter(j.state for j in finished)
+        # Goodput decomposition over ALL jobs (unfinished ones occupied
+        # chips too): attained_service splits into the surviving and the
+        # fault-rolled-back share, overhead_service is the third leg.
+        attained = sum(j.attained_service for j in jobs)
+        lost = sum(j.lost_service for j in jobs)
+        overhead = sum(j.overhead_service for j in jobs)
+        goodput = {
+            "useful_chip_s": attained - lost,
+            "lost_chip_s": lost,
+            "restart_overhead_chip_s": overhead,
+            "total_chip_s": attained + overhead,
+        }
         return SimResult(
             avg_jct=sum(jcts) / len(jcts) if jcts else 0.0,
             makespan=makespan,
@@ -325,6 +368,10 @@ class MetricsLog:
             counters=dict(self.counters),
             end_time=end_time,
             num_rejected=rejected,
+            num_done=states[JobState.DONE],
+            num_failed=states[JobState.FAILED],
+            num_killed=states[JobState.KILLED],
+            goodput=goodput,
             jobs=list(jobs),
         )
 

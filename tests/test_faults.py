@@ -1,0 +1,571 @@
+"""Fault injection & recovery (ISSUE 2): schedule generators, cluster
+health masks, engine revocation semantics, goodput decomposition, policy
+reactions, and the reproducibility contract (same seed -> byte-identical
+fault schedule and identical SimResult).
+"""
+
+import json
+import math
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from gpuschedule_tpu.cluster import GpuCluster, SimpleCluster, TpuCluster
+from gpuschedule_tpu.faults import (
+    FaultConfig,
+    FaultPlan,
+    FaultRecord,
+    RecoveryModel,
+    fault_horizon,
+    generate_fault_schedule,
+    make_fault_plan,
+    parse_fault_spec,
+)
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.sim import Job, Simulator
+from gpuschedule_tpu.sim.metrics import MetricsLog
+from gpuschedule_tpu.sim.trace import generate_poisson_trace
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def goodput_closes(res, tol=1e-6):
+    """The decomposition invariant: useful + lost + overhead == total
+    occupied chip-time (every occupied chip-second lands in one leg)."""
+    g = res.goodput
+    total = g["useful_chip_s"] + g["lost_chip_s"] + g["restart_overhead_chip_s"]
+    assert total == pytest.approx(g["total_chip_s"], abs=tol, rel=1e-9)
+
+
+# --------------------------------------------------------------------- #
+# schedule generation
+
+
+def test_schedule_deterministic_byte_identical():
+    """Same (cluster shape, config, horizon, seed) -> byte-identical fault
+    schedule across two independent generations."""
+    cfg = FaultConfig(mtbf=5000.0, repair=600.0, maintenance_period=40000.0,
+                      spot_fraction=0.25, spot_mtbf=20000.0)
+    a = generate_fault_schedule(TpuCluster("v5e", dims=(4, 4), num_pods=2),
+                                cfg, horizon=100000.0, seed=7)
+    b = generate_fault_schedule(TpuCluster("v5e", dims=(4, 4), num_pods=2),
+                                cfg, horizon=100000.0, seed=7)
+    assert a and a == b
+    assert json.dumps([repr(r) for r in a]) == json.dumps([repr(r) for r in b])
+    # a different seed perturbs the stochastic processes
+    c = generate_fault_schedule(TpuCluster("v5e", dims=(4, 4), num_pods=2),
+                                cfg, horizon=100000.0, seed=8)
+    assert [r for r in c if r.kind != "maintenance"] != [
+        r for r in a if r.kind != "maintenance"
+    ]
+
+
+def test_schedule_streams_are_independent():
+    """The seed-split rule: turning the spot process on must not perturb
+    the MTBF stream (each process has its own RNG)."""
+    base = FaultConfig(mtbf=5000.0, repair=600.0)
+    both = FaultConfig(mtbf=5000.0, repair=600.0, spot_fraction=0.5)
+    cluster = TpuCluster("v5e", dims=(4, 4), num_pods=2)
+    a = generate_fault_schedule(cluster, base, horizon=50000.0, seed=3)
+    b = generate_fault_schedule(cluster, both, horizon=50000.0, seed=3)
+    assert [r for r in b if r.kind == "mtbf"] == a
+
+
+def test_schedule_flavors_and_kinds():
+    cfg = FaultConfig(mtbf=3000.0, repair=600.0, maintenance_period=30000.0,
+                      maintenance_duration=1200.0, spot_fraction=0.25,
+                      spot_mtbf=10000.0, spot_outage=900.0)
+    horizon = 90000.0
+    tpu = generate_fault_schedule(
+        TpuCluster("v5e", dims=(4, 4), num_pods=4), cfg, horizon=horizon, seed=0)
+    gpu = generate_fault_schedule(
+        GpuCluster(num_switches=2, nodes_per_switch=2, gpus_per_node=4),
+        cfg, horizon=horizon, seed=0)
+    flat = generate_fault_schedule(SimpleCluster(16), cfg, horizon=horizon, seed=0)
+    for records, scopes in ((tpu, {"chip", "pod"}), (gpu, {"node"}),
+                            (flat, {"chips"})):
+        assert {r.kind for r in records} == {"mtbf", "maintenance", "spot"}
+        assert {r.scope[0] for r in records} <= scopes
+        assert records == sorted(records, key=lambda r: r.time)
+        assert all(r.label for r in records)
+    # maintenance windows are deterministic multiples of the period
+    maint = [r for r in tpu if r.kind == "maintenance"]
+    assert [r.time for r in maint] == [30000.0, 60000.0, 90000.0]
+    assert [r.scope for r in maint] == [("pod", 0), ("pod", 1), ("pod", 2)]
+    # a spot unit is never revoked again while already revoked
+    for unit in {r.scope for r in flat if r.kind == "spot"}:
+        times = [r.time for r in flat if r.kind == "spot" and r.scope == unit]
+        assert all(b - a >= cfg.spot_outage for a, b in zip(times, times[1:]))
+
+
+def test_repair_inf_means_permanent_failures():
+    """repair=inf must generate duration=inf records (never repaired), not
+    crash expovariate; spot_mtbf=inf means spot capacity is never revoked."""
+    cluster = TpuCluster("v5e", dims=(4, 4))
+    records = generate_fault_schedule(
+        cluster, FaultConfig(mtbf=3000.0, repair=math.inf),
+        horizon=30000.0, seed=0)
+    assert records and all(math.isinf(r.duration) for r in records)
+    assert generate_fault_schedule(
+        cluster, FaultConfig(spot_fraction=0.5, spot_mtbf=math.inf),
+        horizon=30000.0, seed=0) == []
+    # the engine runs permanent failures to completion: capacity only shrinks
+    job = Job("perm", 0.0, num_chips=4, duration=100.0)
+    plan = FaultPlan(records=[FaultRecord(10.0, ("chips", 2), math.inf)])
+    res = Simulator(SimpleCluster(8), make_policy("fifo"), [job],
+                    faults=plan).run()
+    assert job.end_time == 100.0 and res.counters.get("repairs", 0) == 0
+
+
+def test_mtbf_inf_produces_zero_faults_but_arms_the_path():
+    cluster = TpuCluster("v5e", dims=(4, 4))
+    plan = make_fault_plan(cluster, FaultConfig(), horizon=1e9, seed=0)
+    assert plan.records == []
+    res = Simulator(cluster, make_policy("fifo"),
+                    generate_poisson_trace(20, seed=5), faults=plan).run()
+    assert res.counters.get("faults", 0) == 0
+    goodput_closes(res)
+
+
+def test_parse_fault_spec():
+    cfg, rec = parse_fault_spec("mtbf=86400,repair=3600,ckpt=1800,restore=12")
+    assert cfg.mtbf == 86400.0 and cfg.repair == 3600.0
+    assert rec.ckpt_interval == 1800.0 and rec.restore == 12.0
+    cfg, rec = parse_fault_spec("mtbf=inf,restore=auto,spot=0.25")
+    assert math.isinf(cfg.mtbf) and rec.restore == "auto"
+    assert cfg.spot_fraction == 0.25
+    with pytest.raises(ValueError, match="known keys"):
+        parse_fault_spec("mtbf=1,bogus=2")
+    with pytest.raises(ValueError):
+        parse_fault_spec("mtbf")
+
+
+# --------------------------------------------------------------------- #
+# cluster health masks
+
+
+def test_tpu_health_mask_blocks_and_repairs():
+    c = TpuCluster("v5e", dims=(4, 4))
+    a = c.allocate(4)
+    assert c.mark_unhealthy(("pod", 0)) == [a.alloc_id]
+    c.free(a)  # the engine revokes victims right after marking
+    assert c.free_chips == 0 and not c.can_allocate(1)
+    c.repair(("pod", 0))
+    assert c.free_chips == 16 and c.can_allocate(16)
+
+
+def test_tpu_chip_fault_steers_slices_around_it():
+    c = TpuCluster("v5e", dims=(4, 4))
+    assert c.mark_unhealthy(("chip", 0, (0, 0))) == []  # nothing running
+    assert c.allocate(16) is None          # full pod needs the broken chip
+    a = c.allocate(4)
+    assert (0, 0) not in set(a.detail.chips())
+    assert c.unhealthy_chips == 1 and c.free_chips == 16 - 4 - 1
+
+
+def test_tpu_overlapping_outages_count_not_flag():
+    c = TpuCluster("v5e", dims=(4, 4))
+    c.mark_unhealthy(("pod", 0))
+    c.mark_unhealthy(("chip", 0, (1, 1)))  # nested outage on the same chips
+    c.repair(("pod", 0))
+    assert c.unhealthy_chips == 1          # chip (1,1) still down
+    c.repair(("chip", 0, (1, 1)))
+    assert c.unhealthy_chips == 0
+    with pytest.raises(ValueError, match="repair of healthy"):
+        c.repair(("chip", 0, (1, 1)))
+
+
+def test_tpu_multislice_requires_healthy_pods():
+    c = TpuCluster("v5e", dims=(4, 4), num_pods=2)
+    c.mark_unhealthy(("chip", 1, (0, 0)))
+    assert c.allocate(32) is None          # pod 1 is degraded
+    assert c.can_allocate(32) is False
+    c.repair(("chip", 1, (0, 0)))
+    assert c.allocate(32) is not None
+
+
+def test_gpu_node_fault_and_relocation():
+    g = GpuCluster(num_switches=2, nodes_per_switch=2, gpus_per_node=4)
+    a = g.allocate(4)
+    node = a.detail.nodes[0][0]
+    assert g.mark_unhealthy(("node",) + node) == [a.alloc_id]
+    g.free(a)
+    assert g.unhealthy_chips == 4 and g.free_chips == 12
+    b = g.allocate(4)
+    assert b.detail.nodes[0][0] != node
+    g.repair(("node",) + node)
+    assert g.unhealthy_chips == 0
+    with pytest.raises(ValueError, match="healthy node"):
+        g.repair(("node",) + node)
+
+
+def test_simple_cluster_draws_free_chips_first():
+    s = SimpleCluster(8)
+    a, b = s.allocate(4), s.allocate(2)
+    # 2 free chips absorb part of the outage; one gang (oldest) covers the rest
+    assert s.mark_unhealthy(("chips", 4)) == [a.alloc_id]
+    s.free(a)
+    assert s.free_chips == 2 and s.unhealthy_chips == 4
+    s.repair(("chips", 4))
+    assert s.free_chips == 6
+    s.free(b)
+
+
+# --------------------------------------------------------------------- #
+# engine revocation semantics
+
+
+def test_revocation_rolls_back_to_checkpoint_and_burns_restore():
+    """The hand-computable anchor: one 4-chip job, fault at t=500 with a
+    300s per-job checkpoint interval and a 7s flat restore.  Work rolls
+    back 500 -> 300, repair at 600, restart burns 7s of overhead, so the
+    job finishes at 600 + 7 + 700 = 1307 — and every leg of the goodput
+    decomposition is exact."""
+    job = Job("j0", 0.0, num_chips=4, duration=1000.0, ckpt_interval=300.0)
+    plan = FaultPlan(
+        records=[FaultRecord(500.0, ("chips", 4), 100.0)],
+        recovery=RecoveryModel(ckpt_interval=1800.0, restore=7.0),
+    )
+    res = Simulator(SimpleCluster(4), make_policy("fifo"), [job],
+                    faults=plan).run()
+    assert job.end_time == pytest.approx(1307.0)
+    assert job.fault_count == 1
+    assert job.lost_work == pytest.approx(200.0)  # per-job interval wins
+    assert res.counters["faults"] == 1
+    assert res.counters["fault_revocations"] == 1
+    assert res.counters["repairs"] == 1
+    g = res.goodput
+    assert g["useful_chip_s"] == pytest.approx(4000.0)   # 4 chips x 1000s
+    assert g["lost_chip_s"] == pytest.approx(800.0)      # 4 chips x 200s
+    assert g["restart_overhead_chip_s"] == pytest.approx(28.0)  # 4 x 7s
+    assert g["total_chip_s"] == pytest.approx(4828.0)    # 4 x (500 + 707)
+    goodput_closes(res)
+
+
+def test_fault_on_pending_job_is_noop():
+    """A fault landing while a job is pending (holding no chips) must not
+    touch it: no revocation, no rollback, identical completion."""
+    def run(records):
+        job = Job("p", 100.0, num_chips=4, duration=50.0)
+        plan = FaultPlan(records=records) if records else None
+        Simulator(TpuCluster("v5e", dims=(4, 4)), make_policy("fifo"),
+                  [job], faults=plan).run()
+        return job
+
+    faulted = run([FaultRecord(10.0, ("pod", 0), 40.0)])  # repaired by t=50
+    clean = run(None)
+    assert faulted.fault_count == 0 and faulted.lost_work == 0.0
+    assert faulted.end_time == clean.end_time == 150.0
+
+
+def test_fault_keeps_queued_job_waiting_until_repair():
+    """An unrepaired outage of the whole cluster parks the queue; the
+    repair event wakes the policy and the job runs to completion."""
+    job = Job("w", 0.0, num_chips=4, duration=100.0)
+    plan = FaultPlan(records=[FaultRecord(50.0, ("chips", 4), 200.0)],
+                     recovery=RecoveryModel(ckpt_interval=math.inf, restore=5.0))
+    res = Simulator(SimpleCluster(4), make_policy("fifo"), [job],
+                    faults=plan).run()
+    # revoked at 50 with ALL progress lost (interval=inf), resumes at
+    # repair (250) + 5s restore + full 100s rerun
+    assert job.fault_count == 1 and job.lost_work == pytest.approx(50.0)
+    assert job.end_time == pytest.approx(355.0)
+    goodput_closes(res)
+
+
+def test_permanent_cluster_death_terminates_tick_policies():
+    """repair=inf killing the whole cluster strands pending jobs forever;
+    a tick-driven policy (Gandiva re-requests a wakeup whenever jobs wait)
+    must not spin through an endless tick chain — the engine detects
+    quiescence (nothing running, only ticks left) and stops (regression:
+    this hung before the _quiesced() guard)."""
+    jobs = [Job("a", 0.0, num_chips=4, duration=5000.0),
+            Job("b", 10.0, num_chips=4, duration=5000.0)]
+    plan = FaultPlan(records=[FaultRecord(50.0, ("pod", 0), math.inf)])
+    res = Simulator(TpuCluster("v5e", dims=(4, 4)), make_policy("gandiva"),
+                    jobs, faults=plan).run()
+    assert res.num_finished == 0 and res.num_unfinished == 2
+    assert all(j.fault_count <= 1 for j in jobs)
+    goodput_closes(res)
+
+
+def test_completion_at_fault_instant_wins():
+    job = Job("c", 0.0, num_chips=4, duration=500.0)
+    plan = FaultPlan(records=[FaultRecord(500.0, ("pod", 0), 100.0)])
+    Simulator(TpuCluster("v5e", dims=(4, 4)), make_policy("fifo"), [job],
+              faults=plan).run()
+    assert job.fault_count == 0 and job.end_time == 500.0
+
+
+def test_fault_free_replay_unchanged_by_armed_empty_plan():
+    """mtbf=inf arms the fault path with zero records; the replay must be
+    event-for-event identical to faults=None (acceptance criterion)."""
+    def run(faults):
+        m = MetricsLog(record_events=True)
+        res = Simulator(TpuCluster("v5e", dims=(4, 4)), make_policy("gandiva"),
+                        generate_poisson_trace(30, seed=3), faults=faults,
+                        metrics=m).run()
+        return res.summary(), m.events
+
+    empty = make_fault_plan(TpuCluster("v5e", dims=(4, 4)), FaultConfig(),
+                            horizon=1e9, seed=0)
+    (sum_a, ev_a), (sum_b, ev_b) = run(None), run(empty)
+    assert sum_a == sum_b
+    assert ev_a == ev_b
+
+
+def test_chaos_replay_is_deterministic():
+    """Same seed + same config -> identical SimResult across two runs,
+    down to per-job timings (the reproducibility contract)."""
+    def run():
+        cluster = TpuCluster("v5e", dims=(4, 4))
+        jobs = generate_poisson_trace(30, seed=11)
+        plan = make_fault_plan(cluster, FaultConfig(mtbf=15000.0, repair=600.0),
+                               horizon=fault_horizon(jobs), seed=11)
+        return Simulator(cluster, make_policy("srtf"), jobs, faults=plan).run()
+
+    a, b = run(), run()
+    assert a.summary() == b.summary()
+    assert [(j.job_id, j.end_time, j.executed_work, j.fault_count)
+            for j in a.jobs] == \
+           [(j.job_id, j.end_time, j.executed_work, j.fault_count)
+            for j in b.jobs]
+    assert a.counters["faults"] > 0  # the chaos actually happened
+
+
+def test_gandiva_evacuates_degraded_pod():
+    """A chip fault on a multi-pod fleet makes Gandiva migrate unpacked
+    survivors off the degraded pod (Policy.on_fault override)."""
+    job = Job("g", 0.0, num_chips=4, duration=10000.0)
+    plan = FaultPlan(records=[FaultRecord(100.0, ("chip", 0, (3, 3)), math.inf)])
+    res = Simulator(
+        TpuCluster("v5e", dims=(4, 4), num_pods=2),
+        make_policy("gandiva", grow_shrink=False, packing=False),
+        [job], faults=plan, max_time=200.0,
+    ).run()
+    assert job.fault_count == 0              # the fault missed its slice
+    assert job.allocation.detail.pod == 1    # but it moved away anyway
+    assert job.migration_count == 1
+    assert res.counters["fault_evacuations"] == 1
+
+
+def test_perfetto_pairs_overlapping_outages_by_fid():
+    """Two overlapping outages on one scope with different durations: each
+    repair must close ITS outage (fid pairing), not the oldest open one."""
+    from gpuschedule_tpu.obs.perfetto import trace_events
+
+    events = [
+        {"t": 0.0, "event": "fault", "scope": "pod0", "fault": "maintenance",
+         "fid": 0, "duration": 1000.0},
+        {"t": 100.0, "event": "fault", "scope": "pod0", "fault": "spot",
+         "fid": 1, "duration": 10.0},
+        {"t": 110.0, "event": "repair", "scope": "pod0", "fault": "spot",
+         "fid": 1},
+        {"t": 1000.0, "event": "repair", "scope": "pod0",
+         "fault": "maintenance", "fid": 0},
+    ]
+    health = [e for e in trace_events(events)
+              if e.get("cat") == "health" and e["ph"] == "X"]
+    spans = {e["args"]["fault"]: (e["ts"], e["dur"]) for e in health}
+    assert spans["spot"] == (100.0 * 1e6, 10.0 * 1e6)
+    assert spans["maintenance"] == (0.0, 1000.0 * 1e6)
+
+
+def test_demo_and_sweep_json_is_strict_for_inf(tmp_path, capsys):
+    """The inf control arm must serialize as the string "inf", never the
+    non-standard Infinity token (jq/JSON.parse reject it)."""
+    from gpuschedule_tpu.cli import main
+
+    rc = main(["faults", "--policies", "fifo", "--num-jobs", "5",
+               "--dims", "4x4", "--mtbf", "inf", "--max-time", "10000"])
+    assert rc == 0
+    raw = capsys.readouterr().out.strip().splitlines()[-1]
+
+    def no_constants(s):
+        raise ValueError(f"non-strict JSON constant {s!r}")
+
+    doc = json.loads(raw, parse_constant=no_constants)
+    assert doc["mtbf_s"] == "inf"
+    assert doc["cells"][0]["mtbf_s"] == "inf" and doc["cells"][0]["faults"] == 0
+
+
+def test_fault_events_and_perfetto_health_tracks():
+    cluster = TpuCluster("v5e", dims=(4, 4))
+    jobs = generate_poisson_trace(20, seed=3)
+    plan = make_fault_plan(cluster, FaultConfig(mtbf=20000.0, repair=600.0),
+                           horizon=fault_horizon(jobs), seed=0)
+    m = MetricsLog(record_events=True)
+    Simulator(cluster, make_policy("srtf"), jobs, faults=plan, metrics=m).run()
+    kinds = {e["event"] for e in m.events}
+    assert {"fault", "repair", "revoke"} <= kinds
+    revokes = [e for e in m.events if e["event"] == "revoke"]
+    assert all("lost_work" in e and "scope" in e for e in revokes)
+
+    from gpuschedule_tpu.obs.perfetto import trace_events, validate_chrome_trace
+
+    doc = {"traceEvents": trace_events(m.events)}
+    assert validate_chrome_trace(doc) == []
+    health = [e for e in doc["traceEvents"]
+              if e.get("cat") == "health" and e["ph"] == "X"]
+    assert health and all(e["dur"] >= 0 for e in health)
+    instants = {e["name"] for e in doc["traceEvents"] if e["ph"] == "i"}
+    assert {"fault", "repair"} <= instants
+
+
+def test_end_states_surface_in_summary_and_registry():
+    """Satellite: trace-declared Failed/Killed terminals are reported in
+    SimResult.summary() and counted in the obs metrics registry."""
+    from gpuschedule_tpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry()
+    jobs = generate_poisson_trace(40, seed=9, failure_rate=0.4)
+    res = Simulator(SimpleCluster(64), make_policy("fifo"), jobs,
+                    metrics=MetricsLog(registry=reg)).run()
+    s = res.summary()
+    assert s["num_failed"] + s["num_killed"] > 0
+    assert s["num_done"] + s["num_failed"] + s["num_killed"] == s["num_finished"]
+    states = reg.to_json()["sim_jobs_end_state_total"]["value"]
+    by_label = {k: v for k, v in states.items()}
+    assert by_label.get('{state="failed"}', 0) == s["num_failed"]
+    assert by_label.get('{state="killed"}', 0) == s["num_killed"]
+    assert by_label.get('{state="done"}', 0) == s["num_done"]
+
+
+def test_goodput_decomposition_closes_under_churn_all_policies():
+    """Small chaos replay under every registered policy: the decomposition
+    must close (useful + lost + overhead == occupied chip-time) whatever
+    mix of preempt/migrate/resize/revoke the policy produces."""
+    from gpuschedule_tpu.policies import available
+
+    for name in available():
+        kwargs = {}
+        if name == "optimus":
+            from gpuschedule_tpu.profiler import CurveCache, GoodputCurve
+            from gpuschedule_tpu.sim.trace import DEFAULT_MODELS
+
+            class MemCache(CurveCache):
+                def __init__(self):
+                    self._curves = {}
+                    self._meta = {}
+
+                def save(self):
+                    pass
+
+            cache = MemCache()
+            for mname in DEFAULT_MODELS:
+                cache.put(mname, GoodputCurve((1.0, 0.01, 1e-4)))
+            kwargs["curve_cache"] = cache
+        cluster = TpuCluster("v5e", dims=(4, 4))
+        jobs = generate_poisson_trace(25, seed=13, util_range=(0.3, 1.0))
+        plan = make_fault_plan(cluster,
+                               FaultConfig(mtbf=15000.0, repair=900.0),
+                               horizon=fault_horizon(jobs), seed=13)
+        res = Simulator(cluster, make_policy(name, **kwargs), jobs,
+                        faults=plan).run()
+        goodput_closes(res, tol=1e-4)
+        assert res.counters.get("faults", 0) > 0, name
+
+
+# --------------------------------------------------------------------- #
+# CLI + sweep harness
+
+
+def test_cli_run_faults_flag_reproducible(capsys):
+    from gpuschedule_tpu.cli import main
+
+    argv = ["run", "--policy", "srtf", "--cluster", "tpu-v5e", "--dims",
+            "4x4", "--synthetic", "20", "--seed", "4",
+            "--faults", "mtbf=20000,repair=600,ckpt=900"]
+    assert main(list(argv)) == 0
+    out_a = capsys.readouterr().out.strip().splitlines()[-1]
+    assert main(list(argv)) == 0
+    out_b = capsys.readouterr().out.strip().splitlines()[-1]
+    assert out_a == out_b  # one --seed governs trace AND fault streams
+    summary = json.loads(out_a)
+    assert summary["faults"] > 0 and summary["fault_revocations"] > 0
+    goodput = {k: v for k, v in summary.items() if k.startswith("goodput_")}
+    assert goodput["goodput_useful_chip_s"] + goodput["goodput_lost_chip_s"] \
+        + goodput["goodput_restart_overhead_chip_s"] == pytest.approx(
+            goodput["goodput_total_chip_s"], rel=1e-9)
+
+
+def test_cli_run_bad_faults_spec_exits_cleanly():
+    from gpuschedule_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="known keys"):
+        main(["run", "--synthetic", "5", "--faults", "nope=1"])
+
+
+def test_cli_faults_demo_subcommand(tmp_path, capsys):
+    from gpuschedule_tpu.cli import main
+
+    out = tmp_path / "demo.json"
+    rc = main(["faults", "--policies", "fifo,srtf", "--num-jobs", "10",
+               "--dims", "4x4", "--mtbf", "5000", "--max-time", "30000",
+               "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert [c["policy"] for c in doc["cells"]] == ["fifo", "srtf"]
+    for cell in doc["cells"]:
+        g = cell["goodput"]
+        assert g["useful_chip_s"] + g["lost_chip_s"] \
+            + g["restart_overhead_chip_s"] == pytest.approx(
+                g["total_chip_s"], abs=1e-4)
+    assert json.loads(out.read_text())["cells"] == doc["cells"]
+
+
+def test_sweep_cell_covers_the_policy_suite():
+    from gpuschedule_tpu.faults.sweep import POLICY_CONFIGS
+
+    assert len(POLICY_CONFIGS) == 8
+    assert set(POLICY_CONFIGS) == {
+        "fifo", "fifo-backfill", "srtf", "srtf-ckpt", "dlas", "gandiva",
+        "optimus", "themis",
+    }
+
+
+@pytest.mark.slow  # one tiny sweep cell end-to-end through the tool
+def test_fault_sweep_tool_smoke(tmp_path):
+    out = tmp_path / "sweep.json"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "fault_sweep.py"),
+         "--mtbfs", "inf,5000", "--policies", "fifo,gandiva",
+         "--num-jobs", "10", "--dims", "4x4", "--max-time", "30000",
+         "--out", str(out)],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr
+
+    def no_constants(s):
+        raise ValueError(f"non-strict JSON constant {s!r}")
+
+    doc = json.loads(out.read_text(), parse_constant=no_constants)
+    grid = doc["grid"]
+    assert grid["mtbf_s"] == ["inf", 5000.0]  # strict-JSON control arm
+    assert set(grid["policies"]) == {"fifo", "gandiva"}
+    for cells in grid["policies"].values():
+        assert [c["mtbf_s"] for c in cells] == grid["mtbf_s"]
+        # the inf arm is fault-free; the finite arm actually faulted
+        assert cells[0]["faults"] == 0 and cells[1]["faults"] > 0
+        for c in cells:
+            g = c["goodput"]
+            assert g["useful_chip_s"] + g["lost_chip_s"] \
+                + g["restart_overhead_chip_s"] == pytest.approx(
+                    g["total_chip_s"], abs=1e-4)
+
+
+@pytest.mark.slow  # the ISSUE acceptance chaos run: Philly-like 200 jobs,
+# finite MTBF, all eight policy configs complete with a closed decomposition
+def test_acceptance_chaos_run_eight_policies():
+    from gpuschedule_tpu.faults.sweep import POLICY_CONFIGS, run_cell
+
+    for key in POLICY_CONFIGS:
+        cell = run_cell(key, mtbf=6 * 3600.0, num_jobs=200, seed=0,
+                        dims=(8, 8), max_time=500000.0)
+        g = cell["goodput"]
+        assert g["useful_chip_s"] + g["lost_chip_s"] \
+            + g["restart_overhead_chip_s"] == pytest.approx(
+                g["total_chip_s"], rel=1e-9, abs=1e-3), key
+        assert cell["faults"] > 0, key

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""MTBF x policy robustness sweep: goodput-vs-failure-rate JSON artifact.
+
+Replays the same seeded Philly-like trace under every policy config in the
+eight-point suite (gpuschedule_tpu/faults/sweep.py POLICY_CONFIGS), once per
+MTBF grid point, and writes one JSON document::
+
+    {"grid": {"mtbf_s": [...], "policies": {...}}, "params": {...}}
+
+Each cell carries the headline avg-JCT/makespan numbers next to the goodput
+decomposition (useful / lost-to-failure / restart-overhead chip-seconds), so
+plotting useful_chip_s against mtbf_s answers "which policy degrades most
+gracefully as hardware gets flakier".
+
+Determinism: every cell regenerates trace, cluster, and fault schedule from
+--seed (the seed-split rule in faults/schedule.py), so re-running the sweep
+reproduces the artifact byte for byte.
+
+    python tools/fault_sweep.py --out results/fault_sweep.json
+    python tools/fault_sweep.py --mtbfs inf,86400,3600 --policies fifo,srtf \
+        --num-jobs 50 --max-time 200000 --out /tmp/sweep.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+# runnable directly (`python tools/fault_sweep.py`) without installing
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gpuschedule_tpu.faults.sweep import (  # noqa: E402
+    DEFAULT_MTBFS,
+    POLICY_CONFIGS,
+    jsonable,
+    sweep,
+)
+
+
+def _parse_dims(raw: str) -> tuple:
+    return tuple(int(x) for x in raw.lower().split("x"))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--mtbfs",
+                   help="comma list of per-chip MTBFs in seconds ('inf' is "
+                        "the fault-free control arm); default: inf, monthly, "
+                        "weekly, daily, 6h, hourly")
+    p.add_argument("--policies",
+                   help=f"comma list from {sorted(POLICY_CONFIGS)} "
+                        "(default: all eight)")
+    p.add_argument("--num-jobs", type=int, default=200,
+                   help="Philly-like trace length per cell")
+    p.add_argument("--seed", type=int, default=0,
+                   help="governs trace AND fault streams (seed-split rule)")
+    p.add_argument("--repair", type=float, default=3600.0)
+    p.add_argument("--ckpt", type=float, default=1800.0)
+    p.add_argument("--restore", default="auto",
+                   help="seconds per revocation, or 'auto'")
+    p.add_argument("--dims", default="8x8", help="TPU pod dims per cell")
+    p.add_argument("--pods", type=int, default=1)
+    p.add_argument("--max-time", type=float,
+                   help="horizon cutoff per cell (bounds schedule size)")
+    p.add_argument("--out", required=True, help="JSON artifact path")
+    args = p.parse_args(argv)
+
+    mtbfs = (
+        tuple(float(m) for m in args.mtbfs.split(","))
+        if args.mtbfs else DEFAULT_MTBFS
+    )
+    policies = args.policies.split(",") if args.policies else None
+    if args.restore == "auto":
+        restore = "auto"
+    else:
+        try:
+            restore = float(args.restore)
+        except ValueError:
+            p.error(f"--restore wants seconds or 'auto', got {args.restore!r}")
+    grid = sweep(
+        mtbfs,
+        policies,
+        repair=args.repair,
+        ckpt=args.ckpt,
+        restore=restore,
+        num_jobs=args.num_jobs,
+        seed=args.seed,
+        dims=_parse_dims(args.dims),
+        num_pods=args.pods,
+        max_time=args.max_time,
+    )
+    # jsonable over the WHOLE document: inf can appear in the grid (control
+    # arm) and in params (--repair inf etc.); strict JSON throughout
+    doc = jsonable({
+        "grid": grid,
+        "params": {
+            "num_jobs": args.num_jobs,
+            "seed": args.seed,
+            "repair_s": args.repair,
+            "ckpt_s": args.ckpt,
+            "restore": restore,
+            "dims": list(_parse_dims(args.dims)),
+            "pods": args.pods,
+            "max_time": args.max_time,
+        },
+    })
+    out = Path(args.out)
+    if out.parent and not out.parent.exists():
+        out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(doc, indent=2, sort_keys=True))
+    cells = sum(len(v) for v in grid["policies"].values())
+    print(json.dumps(jsonable({"out": str(out), "cells": cells,
+                               "mtbf_s": grid["mtbf_s"],
+                               "policies": sorted(grid["policies"])})))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
