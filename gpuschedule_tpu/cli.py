@@ -107,13 +107,34 @@ def build_policy(args):
     return make_policy(args.policy, **kwargs)
 
 
+def _run_config_hash(args) -> str:
+    """Digest of the *experiment* config — cluster + trace + fault spec,
+    deliberately not the policy — so `compare` accepts policy-A-vs-B runs
+    of the same seeded world and refuses cross-world diffs."""
+    from gpuschedule_tpu.obs import config_hash
+
+    return config_hash({
+        "cluster": args.cluster, "chips": args.chips, "dims": args.dims,
+        "pods": args.pods, "gpu_shape": args.gpu_shape,
+        "placement": args.placement, "placement_seed": args.placement_seed,
+        "philly": args.philly, "trace": args.trace,
+        "synthetic": args.synthetic, "seed": args.seed,
+        "arrival_rate": args.arrival_rate, "mean_duration": args.mean_duration,
+        "failure_rate": args.failure_rate, "util_min": args.util_min,
+        "max_job_chips": args.max_job_chips, "max_time": args.max_time,
+        "faults": args.faults,
+    })
+
+
 def cmd_run(args) -> int:
     from pathlib import Path
 
     from gpuschedule_tpu.sim.metrics import MetricsLog
 
-    if args.events and not args.out:
-        raise SystemExit("--events requires --out (the stream is only persisted)")
+    # --events PATH captures anywhere; bare --events keeps the historical
+    # behavior (events.jsonl under --out)
+    if args.events is True and not args.out:
+        raise SystemExit("--events without a PATH requires --out")
     from gpuschedule_tpu.obs import get_tracer
 
     # --spans enables the tracer; GSTPU_TRACE=1 enables it at import.  Either
@@ -152,16 +173,30 @@ def cmd_run(args) -> int:
         fault_plan = make_fault_plan(
             cluster, fconfig, frecovery, horizon=horizon, seed=args.seed
         )
-    # With --events + --out the stream goes straight to its JSONL sink
-    # (constant memory at Philly scale); --perfetto alone buffers events in
-    # RAM just long enough to convert them.
-    events_sink = (
-        Path(args.out) / f"{args.prefix}events.jsonl" if args.events else None
-    )
+    # With --events the stream goes straight to its JSONL sink (constant
+    # memory at Philly scale): to the given PATH, or events.jsonl under
+    # --out for the bare flag; --perfetto alone buffers events in RAM just
+    # long enough to convert them.
+    if isinstance(args.events, str):
+        events_sink = Path(args.events)
+    elif args.events:
+        events_sink = Path(args.out) / f"{args.prefix}events.jsonl"
+    else:
+        events_sink = None
+    # Stream identity header (obs/analyze.py): stamped whenever events are
+    # recorded so `report`/`compare` can verify what they are reading.
+    run_meta = None
+    if events_sink is not None or args.perfetto:
+        chash = _run_config_hash(args)
+        run_meta = {
+            "run_id": f"{args.policy}-s{args.seed}-{chash}",
+            "seed": args.seed, "policy": args.policy, "config_hash": chash,
+        }
     metrics = MetricsLog(
-        record_events=args.events or bool(args.perfetto),
+        record_events=bool(args.events) or bool(args.perfetto),
         events_sink=events_sink,
         registry=registry,
+        run_meta=run_meta,
     )
     sim = Simulator(
         cluster, build_policy(args), jobs,
@@ -169,7 +204,10 @@ def cmd_run(args) -> int:
         max_time=args.max_time or float("inf"),
         faults=fault_plan,
     )
-    res = sim.run()
+    # context-manager path: an engine exception still flushes/closes the
+    # JSONL sink, leaving an analyzable stream behind (ISSUE 3 satellite)
+    with metrics:
+        res = sim.run()
     print(json.dumps(res.summary(), sort_keys=True))
     if args.out:
         sim.metrics.write(args.out, prefix=args.prefix)
@@ -212,6 +250,67 @@ def cmd_obs_export(args) -> int:
     return 0
 
 
+def cmd_report(args) -> int:
+    """Render one run's events.jsonl as a self-contained HTML report
+    (inline CSS/SVG, zero network fetches) — the human half of the
+    analytics layer; `compare` is the CI half."""
+    from gpuschedule_tpu.obs import SchemaError, StreamError, analyze_file, write_report
+
+    try:
+        analysis = analyze_file(args.events, require_header=not args.no_header)
+    except (SchemaError, StreamError) as e:
+        raise SystemExit(str(e)) from None
+    out = write_report(analysis, args.out, title=args.title)
+    if args.json:
+        from pathlib import Path
+
+        Path(args.json).write_text(
+            json.dumps(analysis.to_json(), indent=2, sort_keys=True)
+        )
+    print(json.dumps({
+        "report": str(out),
+        "events": analysis.num_events,
+        "jobs": len(analysis.jobs),
+        "max_progress_drift": analysis.max_progress_drift,
+    }, sort_keys=True))
+    return 0
+
+
+def cmd_compare(args) -> int:
+    """Regression-diff two event streams, metric by metric, for CI gating:
+    exit 0 when B stays within threshold of A on every gated metric, 1
+    past any threshold, 2 when the runs are not comparable (missing or
+    mismatched headers)."""
+    from gpuschedule_tpu.obs import (
+        SchemaError,
+        StreamError,
+        analyze_file,
+        compare_runs,
+        parse_thresholds,
+        write_compare_json,
+    )
+
+    try:
+        default, per_metric = parse_thresholds(args.threshold)
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+    try:
+        a = analyze_file(args.a)
+        b = analyze_file(args.b)
+        result = compare_runs(
+            a, b,
+            threshold=default, per_metric=per_metric,
+            allow_mismatch=args.allow_mismatch,
+        )
+    except (SchemaError, StreamError) as e:
+        print(f"refusing to compare: {e}", file=sys.stderr)
+        return 2
+    print(result.format_table())
+    if args.json:
+        write_compare_json(result, args.json)
+    return result.exit_code
+
+
 def cmd_faults(args) -> int:
     """Fault-injection demo: one seeded chaos replay (Philly-like trace,
     finite MTBF) per policy config, reporting the goodput decomposition —
@@ -237,6 +336,12 @@ def cmd_faults(args) -> int:
             raise SystemExit(
                 f"--restore wants seconds or 'auto', got {args.restore!r}"
             ) from None
+    events_dir = None
+    if args.events:
+        from pathlib import Path
+
+        events_dir = Path(args.events)
+        events_dir.mkdir(parents=True, exist_ok=True)
     cells = [
         run_cell(
             k,
@@ -249,6 +354,9 @@ def cmd_faults(args) -> int:
             dims=_parse_dims(args.dims),
             num_pods=args.pods,
             max_time=args.max_time,
+            events_path=(
+                events_dir / f"{k}.events.jsonl" if events_dir else None
+            ),
         )
         for k in keys
     ]
@@ -742,10 +850,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                      help="profile unseen models live (optimus)")
     run.add_argument("--out", help="directory for jobs/utilization CSVs")
     run.add_argument("--prefix", default="")
-    run.add_argument("--events", action="store_true",
+    run.add_argument("--events", nargs="?", const=True, default=None,
+                     metavar="PATH",
                      help="record a structured events.jsonl stream (opt-in: "
                           "~1 record per state transition; streamed "
-                          "incrementally, constant memory)")
+                          "incrementally, constant memory).  With PATH the "
+                          "stream goes there directly; the bare flag writes "
+                          "events.jsonl under --out.  The stream opens with "
+                          "a schema header (run_id/seed/policy/config_hash) "
+                          "for `report` and `compare`")
     run.add_argument("--perfetto", metavar="PATH",
                      help="export the replay as a Chrome/Perfetto trace "
                           "(one track per pod/slice, one slice per job "
@@ -810,7 +923,49 @@ def main(argv: Optional[List[str]] = None) -> int:
     fl.add_argument("--max-time", type=float,
                     help="horizon cutoff (also bounds schedule generation)")
     fl.add_argument("--out", help="also write the JSON document here")
+    fl.add_argument("--events", metavar="DIR",
+                    help="capture one <policy>.events.jsonl per cell into "
+                         "DIR (each with its own schema header), ready for "
+                         "`report` / `compare`")
     fl.set_defaults(fn=cmd_faults)
+
+    rep = sub.add_parser(
+        "report",
+        help="render an events.jsonl stream as one self-contained HTML "
+             "report (inline CSS/SVG, zero network fetches)",
+    )
+    rep.add_argument("--events", required=True, metavar="EVENTS_JSONL",
+                     help="stream captured by `run --events` / `faults "
+                          "--events`")
+    rep.add_argument("--out", required=True, metavar="REPORT_HTML")
+    rep.add_argument("--title", help="report heading (default: from header)")
+    rep.add_argument("--json", metavar="PATH",
+                     help="also dump the full analysis document as JSON")
+    rep.add_argument("--no-header", action="store_true",
+                     help="admit bare streams captured without run identity "
+                          "(Python API without run_meta)")
+    rep.set_defaults(fn=cmd_report)
+
+    cmpr = sub.add_parser(
+        "compare",
+        help="regression-diff two event streams for CI gating (exit 0 "
+             "within thresholds, 1 regressed, 2 not comparable)",
+    )
+    cmpr.add_argument("a", metavar="BASELINE_EVENTS")
+    cmpr.add_argument("b", metavar="CANDIDATE_EVENTS")
+    cmpr.add_argument("--threshold", action="append",
+                      metavar="FLOAT|METRIC=FLOAT",
+                      help="relative worsening gate: a bare float sets the "
+                           "default (0.05), METRIC=FLOAT overrides one "
+                           "metric; repeatable.  Negative values demand "
+                           "improvement")
+    cmpr.add_argument("--allow-mismatch", action="store_true",
+                      help="compare runs of different seeds/configs anyway "
+                           "(the deltas then measure the worlds, not the "
+                           "scheduler)")
+    cmpr.add_argument("--json", metavar="PATH",
+                      help="write the machine-readable diff here")
+    cmpr.set_defaults(fn=cmd_compare)
 
     cmp_ = sub.add_parser("compare-topology",
                           help="config #5: GPU placement schemes vs TPU slices")

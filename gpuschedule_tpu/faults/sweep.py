@@ -30,6 +30,7 @@ from gpuschedule_tpu.faults.schedule import (
 )
 from gpuschedule_tpu.policies import make_policy
 from gpuschedule_tpu.sim import Simulator
+from gpuschedule_tpu.sim.metrics import MetricsLog
 from gpuschedule_tpu.sim.philly import generate_philly_like_trace
 
 # name -> (registry policy, constructor kwargs): the eight-policy suite.
@@ -77,6 +78,7 @@ def run_cell(
     dims: Sequence[int] = (8, 8),
     num_pods: int = 1,
     max_time: Optional[float] = None,
+    events_path=None,
 ) -> dict:
     """Run one (policy, MTBF) cell on a fresh cluster + trace + schedule.
 
@@ -84,6 +86,11 @@ def run_cell(
     schedule is regenerated from the same seed (seed-split rule in
     :mod:`gpuschedule_tpu.faults.schedule`), so any two calls with the
     same arguments produce identical results.
+
+    ``events_path`` streams the cell's transition log there as JSONL,
+    opened with a schema header (the cell's identity; the config hash
+    covers everything but the policy, so two cells at the same seed are
+    `compare`-compatible) — the CLI ``faults --events DIR`` path.
     """
     name, kwargs = POLICY_CONFIGS[policy_key]
     cluster = TpuCluster("v5e", dims=tuple(dims), num_pods=num_pods)
@@ -96,12 +103,28 @@ def run_cell(
         ),
         recovery=RecoveryModel(ckpt_interval=ckpt, restore=restore),
     )
-    res = Simulator(
-        cluster, make_policy(name, **kwargs), jobs,
-        faults=plan,
-        max_time=max_time if max_time is not None else math.inf,
-    ).run()
-    return {
+    metrics = MetricsLog()
+    if events_path is not None:
+        from gpuschedule_tpu.obs import config_hash
+
+        chash = config_hash({
+            "cluster": "tpu-v5e", "dims": list(dims), "num_pods": num_pods,
+            "trace": f"philly-like:{num_jobs}", "seed": seed,
+            "mtbf": mtbf, "repair": repair, "ckpt": ckpt,
+            "restore": restore, "max_time": max_time,
+        })
+        metrics = MetricsLog(events_sink=events_path, run_meta={
+            "run_id": f"{policy_key}-s{seed}-{chash}",
+            "seed": seed, "policy": policy_key, "config_hash": chash,
+        })
+    with metrics:  # engine exceptions still flush the stream
+        res = Simulator(
+            cluster, make_policy(name, **kwargs), jobs,
+            metrics=metrics,
+            faults=plan,
+            max_time=max_time if max_time is not None else math.inf,
+        ).run()
+    cell = {
         "policy": policy_key,
         "mtbf_s": mtbf,
         "avg_jct": res.avg_jct,
@@ -112,6 +135,9 @@ def run_cell(
         "revocations": int(res.counters.get("fault_revocations", 0)),
         "goodput": dict(res.goodput),
     }
+    if events_path is not None:
+        cell["events"] = str(events_path)
+    return cell
 
 
 def sweep(

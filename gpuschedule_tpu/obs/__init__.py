@@ -11,7 +11,16 @@ ROADMAP's production-scale north star):
   with Prometheus text + JSON exposition, absorbed by ``MetricsLog``;
 - :mod:`gpuschedule_tpu.obs.perfetto` — Chrome trace-event export of a
   replay's event stream (one track per pod/slice, one slice per occupancy
-  interval), loadable in ui.perfetto.dev.
+  interval), loadable in ui.perfetto.dev;
+- :mod:`gpuschedule_tpu.obs.analyze` — streaming per-job lifecycle
+  reconstruction from the JSONL event log: distributions with exact
+  quantiles, utilization/fragmentation series, and a fault-attribution
+  table that closes bit-exactly against ``SimResult.goodput`` (ISSUE 3
+  tentpole);
+- :mod:`gpuschedule_tpu.obs.compare` — cross-run regression diff with
+  polarity-aware thresholds and CI exit codes;
+- :mod:`gpuschedule_tpu.obs.report` — one self-contained HTML report
+  (inline CSS/SVG, zero network fetches).
 
 Like the sim core, this package must stay jax-free: replay observability
 cannot pull an accelerator stack into the loop (tests/test_overhead.py
@@ -24,8 +33,26 @@ from gpuschedule_tpu.obs.metrics import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    exact_quantile,
     get_registry,
+    quantile_sorted,
 )
+from gpuschedule_tpu.obs.analyze import (
+    RunAnalysis,
+    RunHeader,
+    SchemaError,
+    StreamError,
+    analyze_events,
+    analyze_file,
+    config_hash,
+)
+from gpuschedule_tpu.obs.compare import (
+    CompareResult,
+    compare_runs,
+    parse_thresholds,
+    write_compare_json,
+)
+from gpuschedule_tpu.obs.report import render_report, write_report
 from gpuschedule_tpu.obs.perfetto import (
     export_chrome_trace,
     load_events_jsonl,
@@ -43,7 +70,22 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "exact_quantile",
     "get_registry",
+    "quantile_sorted",
+    "RunAnalysis",
+    "RunHeader",
+    "SchemaError",
+    "StreamError",
+    "analyze_events",
+    "analyze_file",
+    "config_hash",
+    "CompareResult",
+    "compare_runs",
+    "parse_thresholds",
+    "write_compare_json",
+    "render_report",
+    "write_report",
     "export_chrome_trace",
     "load_events_jsonl",
     "trace_events",

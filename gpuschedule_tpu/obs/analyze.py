@@ -1,0 +1,688 @@
+"""Run analytics: streaming per-job lifecycle reconstruction from the
+JSONL event log (ISSUE 3 tentpole).
+
+The Philly study draws its conclusions from *derived* analytics —
+queueing-delay distributions, utilization over time, failure attribution —
+not raw traces.  This module is that layer for our event streams: a
+single-pass analyzer that replays the ``MetricsLog`` transition log
+through per-job state machines
+
+    submit -> queued -> running -> (preempt | migrate | resize | rebind |
+    fault-revoke)* -> done / failed / killed   (or rejected / cut off)
+
+in O(active jobs) working state, validating every transition, and derives
+
+- wait / run / JCT / slowdown / preemption-count distributions with exact
+  p50/p95/p99 (``obs.metrics.exact_quantile``, numpy-equivalent);
+- demand-occupancy and fragmentation time series (time-weighted means are
+  integrated incrementally, exact under sample decimation);
+- a fault-attribution table (per fault kind: outages, revocations, lost
+  work, lost chip-seconds, restore cost charged) whose goodput
+  decomposition **closes bit-exactly against SimResult.goodput**: every
+  per-job lifecycle event carries the engine's cumulative progress
+  snapshot (``"prog"``, exact floats, sim/engine.py), and
+  :meth:`RunAnalysis.goodput` sums the per-job legs in arrival order —
+  the same order and the same arithmetic ``SimResult`` uses.
+
+Streams are versioned: the first record must be a schema header
+(``{"schema": 1, "run_id", "seed", "policy", "config_hash", ...}``,
+written by ``MetricsLog(run_meta=...)``).  A missing or mismatched header
+raises :class:`SchemaError`; a second header mid-stream means two runs
+were concatenated and raises :class:`StreamError` — both instead of
+silently producing garbage (ISSUE 3 satellite).
+
+Pure stdlib, jax-free, streaming: a Philly-scale events.jsonl never needs
+to be held in memory (per-*finished*-job output records are kept — the
+same footprint as jobs.csv — but full event payloads are not).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from gpuschedule_tpu.obs.metrics import quantile_sorted
+
+# The event-stream schema version this analyzer understands.  Kept as the
+# reader's own constant (the writer's is sim/metrics.py:EVENT_SCHEMA;
+# tests pin the two equal) so the obs layer never imports the sim package
+# at module load.
+SCHEMA_VERSION = 1
+
+# Analyzer lifecycle states (strings, not the sim's JobState enum: the
+# analyzer must work on a bare JSONL file with no sim objects in sight).
+QUEUED, RUNNING, SUSPENDED = "queued", "running", "suspended"
+TERMINAL_STATES = ("done", "failed", "killed", "rejected")
+
+_QUANTS = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
+
+
+class SchemaError(ValueError):
+    """The stream's header is missing, unversioned, or from a schema this
+    analyzer does not understand."""
+
+
+class StreamError(ValueError):
+    """The stream is structurally invalid: an impossible lifecycle
+    transition, non-monotonic time, or two concatenated runs."""
+
+
+def config_hash(config: dict) -> str:
+    """Stable 12-hex-digit digest of a run configuration (sorted-key JSON
+    over the given mapping).  The CLI hashes the *experiment* config —
+    cluster + trace + fault spec, deliberately **not** the policy — so two
+    runs are header-compatible for ``compare`` exactly when they replayed
+    the same world, whichever policy scheduled it."""
+    blob = json.dumps(config, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+@dataclass
+class RunHeader:
+    """The stream's identity record (first line of events.jsonl)."""
+
+    schema: int
+    run_id: str = ""
+    seed: Optional[int] = None
+    policy: str = ""
+    config_hash: str = ""
+    total_chips: Optional[int] = None
+    extra: dict = field(default_factory=dict)
+
+    _KNOWN = ("schema", "run_id", "seed", "policy", "config_hash", "total_chips")
+
+    @classmethod
+    def from_record(cls, rec: dict) -> "RunHeader":
+        schema = rec.get("schema")
+        if not isinstance(schema, int):
+            raise SchemaError(f"header schema must be an int, got {schema!r}")
+        if schema != SCHEMA_VERSION:
+            raise SchemaError(
+                f"event stream is schema {schema}; this analyzer understands "
+                f"schema {SCHEMA_VERSION} — re-capture the stream or use a "
+                f"matching version"
+            )
+        return cls(
+            schema=schema,
+            run_id=str(rec.get("run_id", "")),
+            seed=rec.get("seed"),
+            policy=str(rec.get("policy", "")),
+            config_hash=str(rec.get("config_hash", "")),
+            total_chips=rec.get("total_chips"),
+            extra={k: v for k, v in rec.items() if k not in cls._KNOWN},
+        )
+
+    def to_json(self) -> dict:
+        out = {
+            "schema": self.schema, "run_id": self.run_id, "seed": self.seed,
+            "policy": self.policy, "config_hash": self.config_hash,
+            "total_chips": self.total_chips,
+        }
+        out.update(self.extra)
+        return out
+
+
+@dataclass
+class JobRecord:
+    """One job's reconstructed lifecycle (the analyzer's jobs.csv row)."""
+
+    job_id: str
+    order: int                    # arrival order == trace submit order
+    submit_t: float
+    chips: int = 0                # requested gang size
+    duration: Optional[float] = None
+    status: Optional[str] = None
+    first_start_t: Optional[float] = None
+    end_t: Optional[float] = None
+    end_state: Optional[str] = None   # done/failed/killed/rejected; None = unfinished
+    starts: int = 0
+    preempts: int = 0
+    migrations: int = 0
+    rebinds: int = 0
+    faults: int = 0
+    run_time: float = 0.0         # seconds spent RUNNING
+    queue_time: float = 0.0       # seconds QUEUED after submit (incl. requeues)
+    suspended_time: float = 0.0   # seconds SUSPENDED (preempted with resume intent)
+    # exact cumulative legs from the engine's last "prog" snapshot
+    work: float = 0.0
+    service: float = 0.0
+    lost_service: float = 0.0
+    overhead_service: float = 0.0
+    lost_work: float = 0.0
+
+    def wait(self) -> Optional[float]:
+        if self.first_start_t is None:
+            return None
+        return self.first_start_t - self.submit_t
+
+    def jct(self) -> Optional[float]:
+        if self.end_t is None:
+            return None
+        return self.end_t - self.submit_t
+
+    def slowdown(self) -> Optional[float]:
+        j = self.jct()
+        if j is None or not self.duration:
+            return None
+        return j / max(self.duration, 1e-9)
+
+    @property
+    def finished(self) -> bool:
+        return self.end_state in ("done", "failed", "killed")
+
+    def to_json(self) -> dict:
+        return {
+            "job_id": self.job_id, "submit_t": self.submit_t,
+            "chips": self.chips, "wait": self.wait(), "jct": self.jct(),
+            "run_time": self.run_time, "queue_time": self.queue_time,
+            "suspended_time": self.suspended_time,
+            "slowdown": self.slowdown(), "end_state": self.end_state,
+            "starts": self.starts, "preempts": self.preempts,
+            "migrations": self.migrations, "faults": self.faults,
+            "work": self.work, "service": self.service,
+            "lost_service": self.lost_service,
+            "overhead_service": self.overhead_service,
+            "lost_work": self.lost_work,
+        }
+
+
+@dataclass
+class _Active:
+    """Per-job in-flight reconstruction state (the O(active jobs) part)."""
+
+    rec: JobRecord
+    state: str = QUEUED
+    t_state: float = 0.0       # when the current state was entered
+    chips_alloc: int = 0
+    speed: float = 0.0
+    locality: float = 1.0
+    overhead_left: float = 0.0
+    t_prog: float = 0.0        # time of the last adopted snapshot
+
+
+def _stat_block(values: Sequence[float]) -> dict:
+    """Exact distribution summary for one metric: n/mean/max + p50/p95/p99.
+    One sort serves every quantile (Philly-scale lists are large)."""
+    if not values:
+        return {"n": 0, "mean": None, "max": None,
+                **{name: None for name, _ in _QUANTS}}
+    s = sorted(float(v) for v in values)
+    return {
+        "n": len(s),
+        "mean": sum(s) / len(s),
+        "max": s[-1],
+        **{name: quantile_sorted(s, q) for name, q in _QUANTS},
+    }
+
+
+@dataclass
+class RunAnalysis:
+    """Everything :func:`analyze_events` derives from one stream."""
+
+    header: Optional[RunHeader]
+    jobs: List[JobRecord]                       # arrival order
+    num_events: int = 0
+    end_t: float = 0.0
+    counts: Dict[str, int] = field(default_factory=dict)
+    util_series: List[Tuple[float, int, int, int]] = field(default_factory=list)
+    fault_kinds: Dict[str, dict] = field(default_factory=dict)
+    fault_timeline: List[dict] = field(default_factory=list)
+    mean_occupancy: Optional[float] = None      # time-weighted used/total
+    mean_fragmentation: Optional[float] = None  # time-weighted free/total while demand waits
+    mean_pending: float = 0.0                   # time-weighted queue length
+    max_progress_drift: float = 0.0             # analyzer-vs-engine integration check
+    # memoized derived views (report/compare each read them several times;
+    # at Philly scale recomputing means redundant full scans and sorts)
+    _goodput_cache: Optional[Dict[str, float]] = field(
+        default=None, repr=False, compare=False)
+    _dist_cache: Optional[Dict[str, dict]] = field(
+        default=None, repr=False, compare=False)
+
+    # ------------------------------------------------------------------ #
+    def goodput(self) -> Dict[str, float]:
+        """The fault-attribution closure: per-job service legs (engine
+        snapshots, exact floats) summed in arrival order with the same
+        arithmetic ``SimResult`` uses — equal to ``SimResult.goodput`` to
+        the last float (the golden tests pin this for all eight policies)."""
+        if self._goodput_cache is not None:
+            return dict(self._goodput_cache)
+        attained = sum(r.service for r in self.jobs)
+        lost = sum(r.lost_service for r in self.jobs)
+        overhead = sum(r.overhead_service for r in self.jobs)
+        self._goodput_cache = {
+            "useful_chip_s": attained - lost,
+            "lost_chip_s": lost,
+            "restart_overhead_chip_s": overhead,
+            "total_chip_s": attained + overhead,
+        }
+        return dict(self._goodput_cache)
+
+    def distributions(self) -> Dict[str, dict]:
+        """Wait/run/JCT/slowdown/preempt-count distributions over finished
+        jobs, with exact p50/p95/p99 (numpy-equivalent linear quantiles)."""
+        if self._dist_cache is not None:
+            return self._dist_cache
+        fin = [r for r in self.jobs if r.finished]
+        waits = [w for w in (r.wait() for r in fin) if w is not None]
+        slow = [s for s in (r.slowdown() for r in fin) if s is not None]
+        self._dist_cache = {
+            "wait": _stat_block(waits),
+            "run": _stat_block([r.run_time for r in fin]),
+            "jct": _stat_block([j for j in (r.jct() for r in fin) if j is not None]),
+            "slowdown": _stat_block(slow),
+            "preempt_count": _stat_block([float(r.preempts) for r in fin]),
+            "fault_count": _stat_block([float(r.faults) for r in fin]),
+        }
+        return self._dist_cache
+
+    def fault_attribution(self) -> dict:
+        """Per-fault-kind attribution plus the exact goodput closure.
+
+        ``kinds[kind].lost_chip_s`` sums per-revocation snapshot deltas, so
+        the per-kind split telescopes to the per-job totals only up to
+        float re-association; ``closure_residual`` reports that gap (zero
+        or ~1e-9-relative), while ``goodput`` itself is exact."""
+        gp = self.goodput()
+        kinds_lost = sum(k["lost_chip_s"] for k in self.fault_kinds.values())
+        return {
+            "kinds": {k: dict(v) for k, v in sorted(self.fault_kinds.items())},
+            "goodput": gp,
+            "kinds_lost_chip_s": kinds_lost,
+            "closure_residual": kinds_lost - gp["lost_chip_s"],
+        }
+
+    def summary(self) -> Dict[str, object]:
+        """Headline scalars (the compare surface).  avg_jct and makespan
+        use SimResult's exact formulas so the two cross-check bit-for-bit."""
+        fin = [r for r in self.jobs if r.finished]
+        jcts = [j for j in (r.jct() for r in fin) if j is not None]
+        makespan = (
+            max(r.end_t for r in fin) - min(r.submit_t for r in fin)
+            if fin else 0.0
+        )
+        states = {s: 0 for s in TERMINAL_STATES}
+        for r in self.jobs:
+            if r.end_state is not None:
+                states[r.end_state] = states.get(r.end_state, 0) + 1
+        gp = self.goodput()
+        useful_frac = (
+            gp["useful_chip_s"] / gp["total_chip_s"]
+            if gp["total_chip_s"] > 0 else None
+        )
+        return {
+            "num_jobs": len(self.jobs),
+            "num_finished": len(fin),
+            "num_unfinished": sum(
+                1 for r in self.jobs if r.end_state is None
+            ),
+            "num_rejected": states["rejected"],
+            "num_done": states["done"],
+            "num_failed": states["failed"],
+            "num_killed": states["killed"],
+            "avg_jct": sum(jcts) / len(jcts) if jcts else 0.0,
+            "makespan": makespan,
+            "mean_occupancy": self.mean_occupancy,
+            "mean_fragmentation": self.mean_fragmentation,
+            "mean_pending": self.mean_pending,
+            "preemptions": self.counts.get("preempt", 0),
+            "migrations": self.counts.get("migrate", 0),
+            "faults": self.counts.get("fault", 0),
+            "revocations": self.counts.get("revoke", 0),
+            "repairs": self.counts.get("repair", 0),
+            "useful_frac": useful_frac,
+            **{f"goodput_{k}": v for k, v in gp.items()},
+        }
+
+    def to_json(self) -> dict:
+        return {
+            "header": self.header.to_json() if self.header else None,
+            "num_events": self.num_events,
+            "end_t": self.end_t,
+            "summary": self.summary(),
+            "distributions": self.distributions(),
+            "faults": self.fault_attribution(),
+            "fault_timeline": list(self.fault_timeline),
+            "max_progress_drift": self.max_progress_drift,
+            "jobs": [r.to_json() for r in self.jobs],
+        }
+
+
+# --------------------------------------------------------------------- #
+
+# event kind -> states it is legal to arrive from (None key: job may not
+# exist yet).  Kinds touching a job not in this table are stream errors.
+_LEGAL_FROM = {
+    "start": (QUEUED, SUSPENDED),
+    "preempt": (RUNNING,),
+    "speed": (RUNNING,),
+    "migrate": (RUNNING,),
+    "resize": (RUNNING,),
+    "rebind": (RUNNING,),
+    "revoke": (RUNNING,),
+    "finish": (RUNNING,),
+    "cutoff": (RUNNING,),
+}
+
+
+def analyze_events(
+    events: Iterable[dict],
+    *,
+    require_header: bool = True,
+    strict: bool = True,
+    drift_tol: float = 1e-5,
+    max_util_samples: int = 200_000,
+) -> RunAnalysis:
+    """Single-pass lifecycle reconstruction of one event stream.
+
+    ``require_header=False`` admits bare pre-header streams (Python-API
+    captures without ``run_meta``) — ``compare`` never does, ``report``
+    only with an explicit flag.  ``strict`` turns impossible transitions,
+    time going backwards, and analyzer-vs-engine progress drift beyond
+    ``drift_tol`` (relative) into :class:`StreamError`; non-strict mode
+    tallies them in ``counts["anomalies"]`` instead.
+    """
+    header: Optional[RunHeader] = None
+    jobs: List[JobRecord] = []
+    active: Dict[str, _Active] = {}
+    counts: Dict[str, int] = {}
+    fault_kinds: Dict[str, dict] = {}
+    fault_timeline: List[dict] = []
+    util_series: List[Tuple[float, int, int, int]] = []
+    stride, sample_i = 1, 0
+
+    used = running_n = pending_n = 0
+    last_t: Optional[float] = None
+    last_used = last_pending = 0
+    occ_area = frag_area = pend_area = horizon = 0.0
+    max_drift = 0.0
+    n_events = 0
+    end_t = 0.0
+
+    def bad(msg: str) -> None:
+        if strict:
+            raise StreamError(msg)
+        counts["anomalies"] = counts.get("anomalies", 0) + 1
+
+    def kind_row(kind: str) -> dict:
+        row = fault_kinds.get(kind)
+        if row is None:
+            row = fault_kinds[kind] = {
+                "faults": 0, "revocations": 0, "lost_work_s": 0.0,
+                "lost_chip_s": 0.0, "restore_charged_s": 0.0,
+            }
+        return row
+
+    def adopt_snapshot(a: _Active, ev: dict, t: float, rollback: float = 0.0) -> None:
+        """Take the engine's exact cumulative legs; first cross-check them
+        against this analyzer's own integration of the interval since the
+        previous snapshot (payload-sufficiency guard: if the stream lacked
+        a transition, the drift shows it).  ``rollback`` is the work a
+        revoke rolled back before its snapshot was taken."""
+        nonlocal max_drift
+        prog = ev.get("prog")
+        if prog is None:
+            return
+        r = a.rec
+        if a.state == RUNNING:
+            dt = t - a.t_prog
+            burn = min(a.overhead_left, dt)
+            expect = r.work + a.speed * a.locality * (dt - burn) - rollback
+            drift = abs(expect - prog["work"]) / (1.0 + abs(expect))
+            if drift > max_drift:
+                max_drift = drift
+            if drift > drift_tol:
+                bad(
+                    f"progress drift {drift:.3e} for {r.job_id} at t={t} "
+                    f"(expected work {expect}, snapshot {prog['work']}): "
+                    "the stream is missing a transition"
+                )
+        r.work = prog["work"]
+        r.service = prog["service"]
+        r.lost_service = prog["lost_service"]
+        r.overhead_service = prog["overhead_service"]
+        r.lost_work = prog["lost_work"]
+        a.overhead_left = prog.get("overhead_left", 0.0)
+        a.t_prog = t
+
+    def leave_state(a: _Active, t: float) -> None:
+        """Charge the time spent in the state being left to its bucket."""
+        dt = t - a.t_state
+        if a.state == RUNNING:
+            a.rec.run_time += dt
+        elif a.state == QUEUED:
+            a.rec.queue_time += dt
+        else:
+            a.rec.suspended_time += dt
+
+    def sample(t: float) -> None:
+        """Integrate occupancy/fragmentation/pending exactly (piecewise-
+        constant), store a decimation-capped series for the report."""
+        nonlocal last_t, last_used, last_pending, occ_area, frag_area
+        nonlocal pend_area, horizon, stride, sample_i
+        total = header.total_chips if header else None
+        if last_t is not None and t > last_t:
+            dt = t - last_t
+            horizon += dt
+            pend_area += last_pending * dt
+            if total:
+                occ_area += (last_used / total) * dt
+                if last_pending > 0:
+                    frag_area += (max(0, total - last_used) / total) * dt
+        last_t, last_used, last_pending = t, used, pending_n
+        if sample_i % stride == 0:
+            util_series.append((t, used, running_n, pending_n))
+            if len(util_series) > max_util_samples:
+                del util_series[::2]
+                stride *= 2
+        sample_i += 1
+
+    for rec_i, ev in enumerate(events):
+        if "schema" in ev:
+            if rec_i == 0:
+                header = RunHeader.from_record(ev)
+                continue
+            raise StreamError(
+                "second header record mid-stream: this file concatenates "
+                "two runs — analyze them separately"
+            )
+        if rec_i == 0 and require_header:
+            raise SchemaError(
+                "event stream has no schema header; re-capture with "
+                "run identity (CLI --events does) or pass "
+                "require_header=False for bare streams"
+            )
+        kind = ev.get("event")
+        if kind is None:
+            bad(f"record {rec_i} has no 'event' field")
+            continue
+        t = float(ev.get("t", 0.0))
+        if t < end_t:
+            bad(f"time went backwards at record {rec_i}: {end_t} -> {t}")
+        end_t = max(end_t, t)
+        n_events += 1
+        counts[kind] = counts.get(kind, 0) + 1
+
+        if kind == "arrival":
+            if ev.get("job") is None or ev.get("job") in active:
+                bad(f"bad/duplicate arrival for {ev.get('job')!r}")
+                continue
+            rec = JobRecord(
+                job_id=ev["job"], order=len(jobs), submit_t=t,
+                chips=int(ev.get("chips", 0)),
+                duration=ev.get("duration"), status=ev.get("status"),
+            )
+            jobs.append(rec)
+            active[rec.job_id] = _Active(rec=rec, state=QUEUED, t_state=t, t_prog=t)
+            pending_n += 1
+            sample(t)
+            continue
+        if kind == "reject":
+            if ev.get("job") is None:
+                bad("reject without a job id")
+                continue
+            rec = JobRecord(
+                job_id=ev["job"], order=len(jobs), submit_t=t,
+                chips=int(ev.get("chips", 0)), end_t=t, end_state="rejected",
+            )
+            jobs.append(rec)
+            continue
+        if kind == "fault":
+            row = kind_row(str(ev.get("fault", "?")))
+            row["faults"] += 1
+            fault_timeline.append({
+                "t": t, "scope": ev.get("scope"), "kind": ev.get("fault"),
+                "duration": ev.get("duration"), "fid": ev.get("fid"),
+            })
+            continue
+        if kind == "repair":
+            continue
+
+        # ---- per-job transitions ------------------------------------- #
+        a = active.get(ev.get("job"))
+        if a is None:
+            bad(f"{kind} for unknown/finished job {ev.get('job')!r}")
+            continue
+        legal = _LEGAL_FROM.get(kind)
+        if legal is None:
+            bad(f"unknown event kind {kind!r}")
+            continue
+        if a.state not in legal:
+            bad(
+                f"illegal transition: {kind} while {a.rec.job_id} is "
+                f"{a.state} at t={t}"
+            )
+            continue
+
+        if kind == "start":
+            leave_state(a, t)
+            adopt_snapshot(a, ev, t)
+            a.rec.starts += 1
+            if a.rec.first_start_t is None:
+                a.rec.first_start_t = t
+            a.state, a.t_state = RUNNING, t
+            a.chips_alloc = int(ev.get("chips", a.rec.chips))
+            a.speed = float(ev.get("speed", 1.0))
+            a.locality = float(ev.get("locality", 1.0))
+            used += a.chips_alloc
+            running_n += 1
+            # queued AND suspended jobs both sit in the engine's pending
+            # set (demand waiting for chips), so any start drains one
+            pending_n -= 1
+            sample(t)
+        elif kind == "preempt":
+            leave_state(a, t)
+            adopt_snapshot(a, ev, t)
+            a.rec.preempts += 1
+            used -= a.chips_alloc
+            running_n -= 1
+            a.chips_alloc = 0
+            a.speed = 0.0
+            # engine semantics: suspend=True keeps resume intent (Gandiva),
+            # suspend=False demotes back to the pending queue — but both
+            # land in the engine's pending set, so both count as demand
+            a.state = SUSPENDED if ev.get("suspend", True) else QUEUED
+            a.t_state = t
+            pending_n += 1
+            sample(t)
+        elif kind == "speed":
+            adopt_snapshot(a, ev, t)
+            a.speed = float(ev.get("speed", a.speed))
+        elif kind in ("migrate", "resize", "rebind"):
+            adopt_snapshot(a, ev, t)
+            if kind == "migrate":
+                a.rec.migrations += 1
+            elif kind == "rebind":
+                a.rec.rebinds += 1
+            new_chips = int(ev.get("chips", a.chips_alloc))
+            used += new_chips - a.chips_alloc
+            a.chips_alloc = new_chips
+            a.speed = float(ev.get("speed", a.speed))
+            a.locality = float(ev.get("locality", a.locality))
+            sample(t)
+        elif kind == "revoke":
+            prev_lost = a.rec.lost_service
+            leave_state(a, t)
+            adopt_snapshot(a, ev, t, rollback=float(ev.get("lost_work", 0.0)))
+            a.rec.faults += 1
+            row = kind_row(str(ev.get("fault", "?")))
+            row["revocations"] += 1
+            row["lost_work_s"] += float(ev.get("lost_work", 0.0))
+            row["lost_chip_s"] += a.rec.lost_service - prev_lost
+            row["restore_charged_s"] += float(ev.get("restore", 0.0))
+            used -= a.chips_alloc
+            running_n -= 1
+            a.chips_alloc = 0
+            a.speed = 0.0
+            a.state, a.t_state = QUEUED, t
+            pending_n += 1
+            sample(t)
+        elif kind == "finish":
+            leave_state(a, t)
+            adopt_snapshot(a, ev, t)
+            a.rec.end_t = t
+            a.rec.end_state = str(ev.get("end_state", "done"))
+            used -= a.chips_alloc
+            running_n -= 1
+            del active[a.rec.job_id]
+            sample(t)
+        elif kind == "cutoff":
+            # horizon cutoff: final snapshot for a still-running job; the
+            # job stays unfinished (end_state None) like its jobs.csv row
+            leave_state(a, t)
+            adopt_snapshot(a, ev, t)
+            a.t_state = t
+
+    if header is None and require_header:
+        # zero-record stream: the in-loop guard never saw a first record
+        raise SchemaError(
+            "event stream is empty and has no schema header; nothing to "
+            "analyze (pass require_header=False to accept bare streams)"
+        )
+    sample(end_t)  # close the last integration interval
+
+    analysis = RunAnalysis(
+        header=header,
+        jobs=jobs,
+        num_events=n_events,
+        end_t=end_t,
+        counts=counts,
+        util_series=util_series,
+        fault_kinds=fault_kinds,
+        fault_timeline=fault_timeline,
+        mean_occupancy=occ_area / horizon if horizon > 0 and header and header.total_chips else None,
+        mean_fragmentation=frag_area / horizon if horizon > 0 and header and header.total_chips else None,
+        mean_pending=pend_area / horizon if horizon > 0 else 0.0,
+        max_progress_drift=max_drift,
+    )
+    return analysis
+
+
+def analyze_file(path, **kwargs) -> RunAnalysis:
+    """Analyze an events.jsonl file (streaming — constant memory in the
+    stream length).  Unreadable files and truncated/corrupt records raise
+    :class:`StreamError` — so the CLI's "not comparable" refusal path
+    (exit 2) covers them, instead of a raw traceback masquerading as a
+    scheduler regression (exit 1)."""
+
+    def records():
+        try:
+            with open(path) as f:
+                for lineno, line in enumerate(f, 1):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except json.JSONDecodeError as e:
+                        raise StreamError(
+                            f"{path}:{lineno}: truncated or corrupt JSONL "
+                            f"record ({e}) — was the writer killed mid-"
+                            f"record?"
+                        ) from None
+        except OSError as e:
+            raise StreamError(f"cannot read event stream {path}: {e}") from None
+
+    return analyze_events(records(), **kwargs)

@@ -39,6 +39,39 @@ _VALID_FIRST = set("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ_:")
 _VALID_REST = _VALID_FIRST | set("0123456789")
 
 
+def quantile_sorted(s: Sequence[float], q: float) -> float:
+    """:func:`exact_quantile` on an ALREADY-SORTED sequence — the one-sort-
+    many-quantiles path (the analyzer pulls p50/p95/p99 from each metric's
+    single sorted copy instead of re-sorting per quantile)."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile wants q in [0, 1], got {q}")
+    if not s:
+        raise ValueError("quantile of empty data")
+    h = (len(s) - 1) * q
+    i = int(math.floor(h))
+    g = h - i
+    if g == 0.0 or i + 1 >= len(s):
+        return float(s[i])
+    a, b = float(s[i]), float(s[i + 1])
+    # numpy _lerp: anchor at b for g >= 0.5 (same rounding, hence bit-equal)
+    if g >= 0.5:
+        return b - (b - a) * (1.0 - g)
+    return a + (b - a) * g
+
+
+def exact_quantile(values: Sequence[float], q: float) -> float:
+    """Exact quantile of raw observations, matching ``numpy.quantile``'s
+    default "linear" method bit-for-bit: with ``n`` sorted values the target
+    rank is ``h = (n-1)q``; the result interpolates between the two
+    straddling order statistics using numpy's own lerp formulation (which
+    switches anchor at ``g >= 0.5`` to keep the interpolation monotone), so
+    the analyzer's p50/p95/p99 agree with a pandas/numpy cross-check to the
+    last float (ISSUE 3 satellite)."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile wants q in [0, 1], got {q}")
+    return quantile_sorted(sorted(float(v) for v in values), q)
+
+
 def sanitize_name(name: str) -> str:
     """Coerce an arbitrary key into a legal Prometheus metric name."""
     out = "".join(c if c in _VALID_REST else "_" for c in name)
@@ -235,6 +268,35 @@ class Histogram(_Metric):
     @property
     def sum(self) -> float:
         return self._sum
+
+    def quantile(self, q: float) -> float:
+        """Bucket-interpolated quantile (Prometheus ``histogram_quantile``
+        semantics): find the bucket holding rank ``q * count`` and assume
+        observations are uniform within it.  The first bucket's lower edge
+        is 0 (non-negative observations assumed — durations and delays,
+        which is what these histograms hold); ranks landing in the +Inf
+        bucket return the last finite edge, the same saturation Prometheus
+        applies.  NaN on an empty histogram."""
+        self._check_unlabeled()
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile wants q in [0, 1], got {q}")
+        with self._lock:
+            n = self._count
+            counts = list(self._counts)
+        if n == 0:
+            return math.nan
+        rank = q * n
+        cum = 0
+        lo = 0.0
+        for b, c in zip(self.buckets, counts):
+            if c > 0 and cum + c >= rank:
+                if math.isinf(b):
+                    return lo
+                return lo + (b - lo) * ((rank - cum) / c)
+            cum += c
+            if not math.isinf(b):
+                lo = b
+        return lo
 
     def samples(self):
         out = []

@@ -43,10 +43,11 @@ from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
 _ADMISSION_TRACK = "admission"
 _US = 1e6  # sim seconds -> trace microseconds
 
-# Occupancy intervals close on preempt/finish/migrate/resize/revoke
-# (migrate/resize also open the next one, carrying the post-move track/
-# size); preempt/migrate/reject/revoke/fault/repair additionally emit
-# instants.  The dispatch lives in the trace_events elif chain below.
+# Occupancy intervals close on preempt/finish/migrate/resize/rebind/revoke
+# (migrate/resize/rebind also open the next one, carrying the post-move
+# track/size); preempt/migrate/reject/revoke/fault/repair additionally emit
+# instants.  Header records (no "event" key) and unknown kinds fall through
+# harmlessly.  The dispatch lives in the trace_events elif chain below.
 
 
 def track_label(detail: Any) -> str:
@@ -159,7 +160,7 @@ def trace_events(events: Iterable[dict]) -> List[dict]:
             close(job, t_us, "restart")  # defensive: stream said start twice
             track = ev.get("track") or f"job/{job}"
             open_iv[job] = (track, t_us, extra)
-        elif kind in ("migrate", "resize"):
+        elif kind in ("migrate", "resize", "rebind"):
             iv = open_iv.get(job)
             old_track = iv[0] if iv else ev.get("track") or f"job/{job}"
             close(job, t_us, kind)

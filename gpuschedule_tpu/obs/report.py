@@ -1,0 +1,586 @@
+"""Self-contained HTML run report (ISSUE 3 tentpole).
+
+Renders one :class:`~gpuschedule_tpu.obs.analyze.RunAnalysis` as a single
+HTML file with **inline CSS/SVG only — zero network fetches, zero
+dependencies**: open it from disk on an air-gapped box and everything is
+there.  Panels:
+
+- a KPI row (finished jobs, avg JCT, p99 wait, mean occupancy, useful
+  goodput share);
+- chip-occupancy and pending-queue time series (two stacked single-series
+  charts sharing a time axis — never a dual-axis chart);
+- wait/JCT CDFs with exact quantiles;
+- the fault panel: goodput decomposition as a part-to-whole stacked bar
+  plus the per-kind attribution table (hidden for fault-free runs);
+- table views of every chart's data (distributions, slowest jobs), so no
+  value is reachable only through color.
+
+Charts follow the dataviz reference palette (validated ordering; series
+identity always has a non-color channel: direct labels, legends, and the
+table views).  Light and dark mode are both selected via CSS custom
+properties — the dark values are their own steps, not an automatic flip.
+Per-mark hover carries exact values via native SVG ``<title>`` tooltips.
+"""
+
+from __future__ import annotations
+
+import html
+import math
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+from gpuschedule_tpu.obs.analyze import RunAnalysis
+
+# Plot geometry (CSS pixels inside the SVG viewBox).
+_W, _H = 860, 220
+_ML, _MR, _MT, _MB = 56, 16, 14, 30
+_MAX_PTS = 400  # series are decimated to this many points before drawing
+
+
+# --------------------------------------------------------------------- #
+# formatting
+
+def _fmt_dur(s: Optional[float]) -> str:
+    if s is None:
+        return "–"
+    if s != s:
+        return "nan"
+    if s < 120:
+        return f"{s:.0f} s"
+    if s < 2 * 3600:
+        return f"{s / 60:.1f} min"
+    if s < 48 * 3600:
+        return f"{s / 3600:.1f} h"
+    return f"{s / 86400:.1f} d"
+
+
+def _fmt_num(v: Optional[float]) -> str:
+    if v is None:
+        return "–"
+    if v != v:
+        return "nan"
+    a = abs(v)
+    if a >= 1e9:
+        return f"{v / 1e9:.1f}B"
+    if a >= 1e6:
+        return f"{v / 1e6:.1f}M"
+    if a >= 1e4:
+        return f"{v / 1e3:.1f}K"
+    if a >= 100 or v == int(v):
+        return f"{v:,.0f}"
+    return f"{v:.2f}"
+
+
+def _fmt_pct(v: Optional[float]) -> str:
+    return "–" if v is None else f"{100.0 * v:.1f}%"
+
+
+def _esc(s: object) -> str:
+    return html.escape(str(s), quote=True)
+
+
+def _nice_ticks(lo: float, hi: float, n: int = 5) -> List[float]:
+    """Round tick positions covering [lo, hi] (1/2/5 ladder)."""
+    if hi <= lo:
+        return [lo]
+    raw = (hi - lo) / max(1, n)
+    mag = 10 ** math.floor(math.log10(raw))
+    step = next(
+        (m * mag for m in (1, 2, 5, 10) if m * mag >= raw), 10 * mag
+    )
+    t = math.ceil(lo / step) * step
+    out = []
+    while t <= hi + 1e-9 * step:
+        out.append(t)
+        t += step
+    return out or [lo]
+
+
+def _decimate(pts: Sequence[Tuple[float, float]], cap: int = _MAX_PTS):
+    if len(pts) <= cap:
+        return list(pts)
+    stride = max(1, len(pts) // cap)
+    out = list(pts[::stride])
+    if out[-1] != pts[-1]:
+        out.append(pts[-1])
+    return out
+
+
+# --------------------------------------------------------------------- #
+# SVG builders
+
+def _time_axis(t_max: float) -> Tuple[float, str]:
+    """Pick a time unit for the x axis; returns (divisor, unit label)."""
+    if t_max >= 2 * 86400:
+        return 86400.0, "days"
+    if t_max >= 2 * 3600:
+        return 3600.0, "hours"
+    if t_max >= 120:
+        return 60.0, "minutes"
+    return 1.0, "seconds"
+
+
+def _xy(t, v, t_max, v_max):
+    x = _ML + (t / t_max if t_max > 0 else 0.0) * (_W - _ML - _MR)
+    y = _MT + (1.0 - (v / v_max if v_max > 0 else 0.0)) * (_H - _MT - _MB)
+    return x, y
+
+
+def _grid_and_axes(t_max: float, v_max: float, unit_div: float,
+                   unit: str, y_fmt=_fmt_num) -> List[str]:
+    parts = []
+    for yt in _nice_ticks(0.0, v_max, 4):
+        _, y = _xy(0.0, yt, t_max, v_max)
+        parts.append(
+            f'<line class="grid" x1="{_ML}" y1="{y:.1f}" '
+            f'x2="{_W - _MR}" y2="{y:.1f}"/>'
+        )
+        parts.append(
+            f'<text class="tick" x="{_ML - 6}" y="{y + 3:.1f}" '
+            f'text-anchor="end">{_esc(y_fmt(yt))}</text>'
+        )
+    for xt in _nice_ticks(0.0, t_max / unit_div, 6):
+        x, _ = _xy(xt * unit_div, 0.0, t_max, v_max)
+        parts.append(
+            f'<text class="tick" x="{x:.1f}" y="{_H - _MB + 16}" '
+            f'text-anchor="middle">{_esc(_fmt_num(xt))}</text>'
+        )
+    parts.append(
+        f'<line class="axis" x1="{_ML}" y1="{_H - _MB}" '
+        f'x2="{_W - _MR}" y2="{_H - _MB}"/>'
+    )
+    parts.append(
+        f'<text class="tick" x="{_W - _MR}" y="{_H - 4}" '
+        f'text-anchor="end">sim time ({unit})</text>'
+    )
+    return parts
+
+
+def _step_series_chart(
+    pts: Sequence[Tuple[float, float]],
+    *,
+    series_var: str,
+    label: str,
+    t_max: float,
+    v_max: Optional[float] = None,
+    cap_line: Optional[float] = None,
+    area: bool = True,
+    hover_fmt=_fmt_num,
+) -> str:
+    """One single-series step-after chart (line + optional 10% wash).
+    Single series: the panel title names it, so no legend box."""
+    pts = _decimate(pts)
+    if not pts:
+        return '<p class="empty">no samples</p>'
+    vmax = v_max if v_max is not None else max(v for _, v in pts)
+    if cap_line is not None:
+        vmax = max(vmax, cap_line)
+    vmax = vmax or 1.0
+    unit_div, unit = _time_axis(t_max)
+    parts = ['<svg viewBox="0 0 %d %d" role="img" aria-label="%s">'
+             % (_W, _H, _esc(label))]
+    parts += _grid_and_axes(t_max, vmax, unit_div, unit)
+    # step-after path
+    path = []
+    for i, (t, v) in enumerate(pts):
+        x, y = _xy(t, v, t_max, vmax)
+        if i == 0:
+            path.append(f"M{x:.1f},{y:.1f}")
+        else:
+            _, py = _xy(pts[i - 1][0], pts[i - 1][1], t_max, vmax)
+            path.append(f"L{x:.1f},{py:.1f} L{x:.1f},{y:.1f}")
+    d = " ".join(path)
+    if area:
+        x0, y0 = _xy(pts[0][0], 0.0, t_max, vmax)
+        xn, _ = _xy(pts[-1][0], 0.0, t_max, vmax)
+        parts.append(
+            f'<path d="{d} L{xn:.1f},{y0:.1f} L{x0:.1f},{y0:.1f} Z" '
+            f'fill="var({series_var})" opacity="0.1" stroke="none"/>'
+        )
+    if cap_line is not None:
+        _, cy = _xy(0.0, cap_line, t_max, vmax)
+        parts.append(
+            f'<line class="cap" x1="{_ML}" y1="{cy:.1f}" '
+            f'x2="{_W - _MR}" y2="{cy:.1f}"/>'
+            f'<text class="tick" x="{_ML + 4}" y="{cy - 4:.1f}">'
+            f"capacity {_esc(_fmt_num(cap_line))}</text>"
+        )
+    parts.append(
+        f'<path d="{d}" fill="none" stroke="var({series_var})" '
+        f'stroke-width="2" stroke-linejoin="round" stroke-linecap="round"/>'
+    )
+    # hover layer: one invisible hit band per decimated sample with a
+    # native tooltip (self-contained; no script needed)
+    band = (_W - _ML - _MR) / max(1, len(pts))
+    for t, v in pts:
+        x, _ = _xy(t, v, t_max, vmax)
+        parts.append(
+            f'<rect class="hit" x="{x - band / 2:.1f}" y="{_MT}" '
+            f'width="{band:.1f}" height="{_H - _MT - _MB}">'
+            f"<title>t = {_esc(_fmt_dur(t))}\n{_esc(label)}: "
+            f"{_esc(hover_fmt(v))}</title></rect>"
+        )
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def _cdf_points(values: Sequence[float]) -> List[Tuple[float, float]]:
+    s = sorted(values)
+    n = len(s)
+    return [(v, (i + 1) / n) for i, v in enumerate(s)]
+
+
+def _cdf_chart(series: List[Tuple[str, str, List[float]]], label: str) -> str:
+    """Multi-series CDF: x = seconds (log-ish linear), y = fraction.
+    ``series`` rows are (name, css-var, values).  Legend + direct end
+    labels carry identity alongside color."""
+    series = [(n, c, v) for n, c, v in series if v]
+    if not series:
+        return '<p class="empty">no finished jobs</p>'
+    x_max = max(max(v) for _, _, v in series) or 1.0
+    unit_div, unit = _time_axis(x_max)
+    parts = ['<svg viewBox="0 0 %d %d" role="img" aria-label="%s">'
+             % (_W, _H, _esc(label))]
+    for frac in (0.25, 0.5, 0.75, 1.0):
+        _, y = _xy(0.0, frac, x_max, 1.0)
+        parts.append(
+            f'<line class="grid" x1="{_ML}" y1="{y:.1f}" '
+            f'x2="{_W - _MR}" y2="{y:.1f}"/>'
+            f'<text class="tick" x="{_ML - 6}" y="{y + 3:.1f}" '
+            f'text-anchor="end">{int(frac * 100)}%</text>'
+        )
+    for xt in _nice_ticks(0.0, x_max / unit_div, 6):
+        x, _ = _xy(xt * unit_div, 0.0, x_max, 1.0)
+        parts.append(
+            f'<text class="tick" x="{x:.1f}" y="{_H - _MB + 16}" '
+            f'text-anchor="middle">{_esc(_fmt_num(xt))}</text>'
+        )
+    parts.append(
+        f'<line class="axis" x1="{_ML}" y1="{_H - _MB}" '
+        f'x2="{_W - _MR}" y2="{_H - _MB}"/>'
+        f'<text class="tick" x="{_W - _MR}" y="{_H - 4}" '
+        f'text-anchor="end">{_esc(unit)}</text>'
+    )
+    for name, var, values in series:
+        pts = _decimate(_cdf_points(values))
+        d = " ".join(
+            ("M" if i == 0 else "L") + f"{_xy(v, f, x_max, 1.0)[0]:.1f},"
+            f"{_xy(v, f, x_max, 1.0)[1]:.1f}"
+            for i, (v, f) in enumerate(pts)
+        )
+        parts.append(
+            f'<path d="{d}" fill="none" stroke="var({var})" '
+            f'stroke-width="2" stroke-linejoin="round" stroke-linecap="round">'
+            f"<title>{_esc(name)}</title></path>"
+        )
+        ex, ey = _xy(pts[-1][0], pts[-1][1], x_max, 1.0)
+        parts.append(
+            f'<circle cx="{ex:.1f}" cy="{ey:.1f}" r="4" fill="var({var})" '
+            f'stroke="var(--surface-1)" stroke-width="2"/>'
+            f'<text class="dlabel" x="{min(ex + 6, _W - 60):.1f}" '
+            f'y="{ey - 6:.1f}">{_esc(name)}</text>'
+        )
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def _stacked_goodput_bar(gp: dict) -> str:
+    """Part-to-whole: one horizontal stacked bar of the goodput legs,
+    2px surface gaps between segments, labels inside where they fit."""
+    legs = [
+        ("useful", gp["useful_chip_s"], "--series-1"),
+        ("lost", gp["lost_chip_s"], "--series-2"),
+        ("restart overhead", gp["restart_overhead_chip_s"], "--series-3"),
+    ]
+    total = gp["total_chip_s"]
+    if total <= 0:
+        return '<p class="empty">no service accrued</p>'
+    w, h, y0, bh = 860, 64, 8, 24
+    parts = [f'<svg viewBox="0 0 {w} {h}" role="img" aria-label="goodput">']
+    x = 0.0
+    for name, v, var in legs:
+        seg = (v / total) * (w - 4)
+        if seg <= 0:
+            continue
+        parts.append(
+            f'<rect x="{x:.1f}" y="{y0}" width="{max(0.0, seg - 2):.1f}" '
+            f'height="{bh}" rx="4" fill="var({var})">'
+            f"<title>{_esc(name)}: {_esc(_fmt_num(v))} chip-s "
+            f"({_esc(_fmt_pct(v / total))})</title></rect>"
+        )
+        if seg > 150:  # label inside only when it comfortably fits
+            parts.append(
+                f'<text class="inbar" x="{x + 8:.1f}" y="{y0 + 16}">'
+                f"{_esc(name)} {_esc(_fmt_pct(v / total))}</text>"
+            )
+        x += seg
+    lx = 0.0
+    for name, v, var in legs:  # legend: identity never color-alone
+        parts.append(
+            f'<rect x="{lx:.1f}" y="{y0 + bh + 10}" width="10" height="10" '
+            f'rx="2" fill="var({var})"/>'
+            f'<text class="tick" x="{lx + 14:.1f}" y="{y0 + bh + 19}">'
+            f"{_esc(name)} {_esc(_fmt_num(v))} chip-s</text>"
+        )
+        lx += 240
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+# --------------------------------------------------------------------- #
+# HTML assembly
+
+_CSS = """
+:root { color-scheme: light dark; }
+body {
+  margin: 0; padding: 24px;
+  font-family: system-ui, -apple-system, "Segoe UI", sans-serif;
+  background: var(--page); color: var(--text-primary);
+}
+.viz-root {
+  --surface-1: #fcfcfb; --page: #f9f9f7;
+  --text-primary: #0b0b0b; --text-secondary: #52514e; --muted: #898781;
+  --grid: #e1e0d9; --baseline: #c3c2b7;
+  --series-1: #2a78d6; --series-2: #eb6834; --series-3: #1baf7a;
+  --border: rgba(11,11,11,0.10);
+}
+@media (prefers-color-scheme: dark) {
+  :root:where(:not([data-theme="light"])) .viz-root {
+    --surface-1: #1a1a19; --page: #0d0d0d;
+    --text-primary: #ffffff; --text-secondary: #c3c2b7; --muted: #898781;
+    --grid: #2c2c2a; --baseline: #383835;
+    --series-1: #3987e5; --series-2: #d95926; --series-3: #199e70;
+    --border: rgba(255,255,255,0.10);
+  }
+}
+:root[data-theme="dark"] .viz-root {
+  --surface-1: #1a1a19; --page: #0d0d0d;
+  --text-primary: #ffffff; --text-secondary: #c3c2b7; --muted: #898781;
+  --grid: #2c2c2a; --baseline: #383835;
+  --series-1: #3987e5; --series-2: #d95926; --series-3: #199e70;
+  --border: rgba(255,255,255,0.10);
+}
+body { background: var(--page); }
+h1 { font-size: 20px; margin: 0 0 4px; }
+h2 { font-size: 15px; margin: 24px 0 8px; }
+.meta { color: var(--text-secondary); font-size: 13px; margin-bottom: 16px; }
+.meta code { background: none; color: inherit; }
+.panel {
+  background: var(--surface-1); border: 1px solid var(--border);
+  border-radius: 8px; padding: 16px; margin-bottom: 16px;
+}
+.kpis { display: flex; flex-wrap: wrap; gap: 12px; }
+.tile {
+  background: var(--surface-1); border: 1px solid var(--border);
+  border-radius: 8px; padding: 12px 16px; min-width: 130px; flex: 1;
+}
+.tile .label { font-size: 12px; color: var(--text-secondary); }
+.tile .value { font-size: 26px; font-weight: 600; margin-top: 2px; }
+.tile .sub { font-size: 12px; color: var(--muted); margin-top: 2px; }
+svg { width: 100%; height: auto; display: block; }
+svg .grid { stroke: var(--grid); stroke-width: 1; }
+svg .axis { stroke: var(--baseline); stroke-width: 1; }
+svg .cap { stroke: var(--baseline); stroke-width: 1; stroke-dasharray: none; }
+svg .tick { fill: var(--muted); font-size: 11px; }
+svg .dlabel { fill: var(--text-secondary); font-size: 12px; }
+svg .inbar { fill: #ffffff; font-size: 12px; }
+svg .hit { fill: transparent; }
+svg .hit:hover { fill: var(--text-primary); fill-opacity: 0.05; }
+table { border-collapse: collapse; font-size: 13px; width: 100%; }
+th, td { text-align: right; padding: 4px 10px; border-bottom: 1px solid var(--grid); }
+th:first-child, td:first-child { text-align: left; }
+th { color: var(--text-secondary); font-weight: 600; }
+td { font-variant-numeric: tabular-nums; }
+.empty { color: var(--muted); font-size: 13px; }
+.integrity { color: var(--muted); font-size: 12px; margin-top: 16px; }
+"""
+
+
+def _tile(label: str, value: str, sub: str = "") -> str:
+    sub_html = f'<div class="sub">{_esc(sub)}</div>' if sub else ""
+    return (
+        f'<div class="tile"><div class="label">{_esc(label)}</div>'
+        f'<div class="value">{_esc(value)}</div>{sub_html}</div>'
+    )
+
+
+def _dist_table(dists: dict) -> str:
+    rows = []
+    fmt = {
+        "wait": _fmt_dur, "run": _fmt_dur, "jct": _fmt_dur,
+        "slowdown": lambda v: "–" if v is None else f"{v:.2f}x",
+        "preempt_count": _fmt_num, "fault_count": _fmt_num,
+    }
+    for name, block in dists.items():
+        f = fmt.get(name, _fmt_num)
+        rows.append(
+            f"<tr><td>{_esc(name)}</td><td>{block['n']}</td>"
+            + "".join(
+                f"<td>{_esc(f(block[q]))}</td>"
+                for q in ("mean", "p50", "p95", "p99", "max")
+            )
+            + "</tr>"
+        )
+    return (
+        "<table><thead><tr><th>metric</th><th>n</th><th>mean</th>"
+        "<th>p50</th><th>p95</th><th>p99</th><th>max</th></tr></thead>"
+        f"<tbody>{''.join(rows)}</tbody></table>"
+    )
+
+
+def _fault_kind_table(attribution: dict) -> str:
+    rows = []
+    for kind, row in attribution["kinds"].items():
+        rows.append(
+            f"<tr><td>{_esc(kind)}</td><td>{row['faults']}</td>"
+            f"<td>{row['revocations']}</td>"
+            f"<td>{_esc(_fmt_dur(row['lost_work_s']))}</td>"
+            f"<td>{_esc(_fmt_num(row['lost_chip_s']))}</td>"
+            f"<td>{_esc(_fmt_dur(row['restore_charged_s']))}</td></tr>"
+        )
+    return (
+        "<table><thead><tr><th>fault kind</th><th>outages</th>"
+        "<th>revocations</th><th>work lost</th><th>chip-s lost</th>"
+        "<th>restore charged</th></tr></thead>"
+        f"<tbody>{''.join(rows)}</tbody></table>"
+    )
+
+
+def _slowest_jobs_table(analysis: RunAnalysis, n: int = 10) -> str:
+    fin = [r for r in analysis.jobs if r.finished and r.jct() is not None]
+    worst = sorted(fin, key=lambda r: r.jct(), reverse=True)[:n]
+    if not worst:
+        return '<p class="empty">no finished jobs</p>'
+    rows = []
+    for r in worst:
+        rows.append(
+            f"<tr><td>{_esc(r.job_id)}</td><td>{r.chips}</td>"
+            f"<td>{_esc(_fmt_dur(r.wait()))}</td>"
+            f"<td>{_esc(_fmt_dur(r.jct()))}</td>"
+            f"<td>{'–' if r.slowdown() is None else f'{r.slowdown():.1f}x'}</td>"
+            f"<td>{r.preempts}</td><td>{r.faults}</td>"
+            f"<td>{_esc(r.end_state)}</td></tr>"
+        )
+    return (
+        "<table><thead><tr><th>job</th><th>chips</th><th>wait</th>"
+        "<th>JCT</th><th>slowdown</th><th>preempts</th><th>faults</th>"
+        "<th>end</th></tr></thead>"
+        f"<tbody>{''.join(rows)}</tbody></table>"
+    )
+
+
+def render_report(analysis: RunAnalysis, *, title: Optional[str] = None) -> str:
+    """The whole report as one HTML string (write it anywhere; it never
+    references the network or the filesystem)."""
+    h = analysis.header
+    s = analysis.summary()
+    dists = analysis.distributions()
+    attribution = analysis.fault_attribution()
+    gp = attribution["goodput"]
+    title = title or (
+        f"Run report — {h.policy or 'unknown policy'}" if h else "Run report"
+    )
+    meta_bits = []
+    if h is not None:
+        meta_bits = [
+            f"run <code>{_esc(h.run_id or '?')}</code>",
+            f"policy <code>{_esc(h.policy or '?')}</code>",
+            f"seed <code>{_esc(h.seed)}</code>",
+            f"config <code>{_esc(h.config_hash or '?')}</code>",
+            f"schema {h.schema}",
+        ]
+    meta_bits.append(f"{analysis.num_events:,} events")
+    meta_bits.append(f"span {_fmt_dur(analysis.end_t)}")
+
+    t_max = analysis.end_t or 1.0
+    occ_pts = [(t, float(used)) for t, used, _, _ in analysis.util_series]
+    pend_pts = [(t, float(p)) for t, _, _, p in analysis.util_series]
+    total_chips = h.total_chips if h else None
+
+    fin = [r for r in analysis.jobs if r.finished]
+    waits = [w for w in (r.wait() for r in fin) if w is not None]
+    jcts = [j for j in (r.jct() for r in fin) if j is not None]
+
+    kpis = [
+        _tile("Finished jobs", _fmt_num(s["num_finished"]),
+              f"{s['num_unfinished']} unfinished · {s['num_rejected']} rejected"),
+        _tile("Avg JCT", _fmt_dur(s["avg_jct"]),
+              f"p99 {_fmt_dur(dists['jct']['p99'])}"),
+        _tile("p99 wait", _fmt_dur(dists["wait"]["p99"]),
+              f"p50 {_fmt_dur(dists['wait']['p50'])}"),
+        _tile("Mean occupancy", _fmt_pct(s["mean_occupancy"]),
+              f"frag {_fmt_pct(s['mean_fragmentation'])}"),
+        _tile("Useful goodput", _fmt_pct(s["useful_frac"]),
+              f"{_fmt_num(gp['total_chip_s'])} chip-s total"),
+    ]
+
+    fault_panel = ""
+    if s["faults"] or s["revocations"] or gp["lost_chip_s"] > 0:
+        fault_panel = f"""
+<h2>Faults</h2>
+<div class="panel">
+  <p class="meta">{s['faults']} outages · {s['revocations']} revocations ·
+  {s['repairs']} repairs · {_esc(_fmt_dur(sum(
+      k['lost_work_s'] for k in attribution['kinds'].values())))} work lost</p>
+  {_stacked_goodput_bar(gp)}
+  {_fault_kind_table(attribution)}
+</div>"""
+
+    integrity = (
+        f"stream integrity: max analyzer-vs-engine progress drift "
+        f"{analysis.max_progress_drift:.2e}"
+        + (
+            f" · {analysis.counts.get('anomalies', 0)} anomalies"
+            if analysis.counts.get("anomalies") else ""
+        )
+    )
+
+    return f"""<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>{_esc(title)}</title>
+<style>{_CSS}</style>
+</head>
+<body class="viz-root">
+<h1>{_esc(title)}</h1>
+<p class="meta">{' · '.join(meta_bits)}</p>
+<div class="kpis">{''.join(kpis)}</div>
+
+<h2>Chip occupancy</h2>
+<div class="panel">
+{_step_series_chart(occ_pts, series_var='--series-1', label='chips allocated',
+                    t_max=t_max, cap_line=float(total_chips) if total_chips else None)}
+</div>
+
+<h2>Pending queue</h2>
+<div class="panel">
+{_step_series_chart(pend_pts, series_var='--series-2', label='jobs waiting',
+                    t_max=t_max, area=False)}
+</div>
+
+<h2>Wait &amp; completion-time CDF</h2>
+<div class="panel">
+{_cdf_chart([('wait', '--series-1', waits), ('JCT', '--series-2', jcts)],
+            'wait and JCT CDF')}
+</div>
+{fault_panel}
+<h2>Distributions</h2>
+<div class="panel">{_dist_table(dists)}</div>
+
+<h2>Slowest jobs</h2>
+<div class="panel">{_slowest_jobs_table(analysis)}</div>
+
+<p class="integrity">{_esc(integrity)}</p>
+</body>
+</html>
+"""
+
+
+def write_report(analysis: RunAnalysis, path, *, title: Optional[str] = None) -> Path:
+    out = Path(path)
+    if out.parent and not out.parent.exists():
+        out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(render_report(analysis, title=title))
+    return out

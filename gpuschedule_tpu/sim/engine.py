@@ -38,6 +38,22 @@ from gpuschedule_tpu.sim.metrics import MetricsLog, SimResult
 _COMPLETION, _ARRIVAL, _TICK, _FAULT, _REPAIR = 0, 1, 2, 3, 4
 
 
+def _prog(job: Job) -> dict:
+    """Exact cumulative progress snapshot carried under ``"prog"`` on every
+    per-job lifecycle event (schema 1, docs/events.md).  Full-precision
+    floats — json round-trips Python floats bit-exactly — so the analyzer
+    (obs/analyze.py) reconstructs the goodput decomposition to the last
+    float without replaying the engine's internal advance chunking."""
+    return {
+        "work": job.executed_work,
+        "service": job.attained_service,
+        "lost_service": job.lost_service,
+        "overhead_service": job.overhead_service,
+        "lost_work": job.lost_work,
+        "overhead_left": job.overhead_remaining,
+    }
+
+
 class Simulator:
     """Replay a trace against a cluster under a policy.
 
@@ -101,6 +117,14 @@ class Simulator:
         # for an empty plan so mtbf=inf replays stay event-for-event
         # identical to faults=None.
         self._drain_faults = False
+        # Event-stream header (obs/analyze.py): when the caller armed a
+        # header (run_meta), fill in the facts the engine knows and the
+        # caller might not have set — the policy name and cluster capacity
+        # (the analyzer's utilization denominator).  setdefault: explicit
+        # caller values win.
+        if self.metrics.run_meta is not None:
+            self.metrics.run_meta.setdefault("policy", policy.name)
+            self.metrics.run_meta.setdefault("total_chips", cluster.total_chips)
         # record identity -> stable index: fault and repair events carry it
         # as "fid" so the Perfetto exporter pairs each repair with ITS
         # outage even when outages of different durations overlap on one
@@ -189,7 +213,8 @@ class Simulator:
         self._schedule_completion(job)
         if self.metrics.record_events:
             extra = {"chips": chips, "speed": speed, "overhead": overhead,
-                     "track": track_label(alloc.detail)}
+                     "locality": job.locality_factor,
+                     "track": track_label(alloc.detail), "prog": _prog(job)}
             if why is not None:
                 extra["why"] = why
             self.metrics.event("start", self.now, job, **extra)
@@ -219,7 +244,7 @@ class Simulator:
         self.pending.append(job)
         self.metrics.count("preemptions")
         if record:
-            extra = {"suspend": suspend, "track": track}
+            extra = {"suspend": suspend, "track": track, "prog": _prog(job)}
             if why is not None:
                 extra["why"] = why
             self.metrics.event("preempt", self.now, job, **extra)
@@ -235,7 +260,7 @@ class Simulator:
         job.epoch += 1
         self._schedule_completion(job)
         if self.metrics.record_events:
-            extra = {"speed": speed}
+            extra = {"speed": speed, "prog": _prog(job)}
             if why is not None:
                 extra["why"] = why
             self.metrics.event("speed", self.now, job, **extra)
@@ -272,6 +297,7 @@ class Simulator:
             self._bind_allocation(job, alloc)
             job.epoch += 1
             self._schedule_completion(job)
+            self._emit_rebind(job, old_detail, alloc)
             return False
         self._bind_allocation(job, alloc)
         if old_detail is not None and alloc.detail == old_detail:
@@ -282,7 +308,8 @@ class Simulator:
         self._schedule_completion(job)
         self.metrics.count("migrations")
         if self.metrics.record_events:
-            extra = {"overhead": overhead, "track": track_label(alloc.detail)}
+            extra = {"overhead": overhead, "locality": job.locality_factor,
+                     "track": track_label(alloc.detail), "prog": _prog(job)}
             if why is not None:
                 extra["why"] = why
             self.metrics.event("migrate", self.now, job, **extra)
@@ -306,6 +333,7 @@ class Simulator:
         if chips == job.allocated_chips and speed == job.speed:
             return True
         job.advance(self.now)
+        old_detail = job.allocation.detail if job.allocation is not None else None
         self.cluster.free(job.allocation)
         alloc = self.cluster.allocate(chips, job=job)
         if alloc is None:
@@ -315,6 +343,7 @@ class Simulator:
             self._bind_allocation(job, alloc)
             job.epoch += 1
             self._schedule_completion(job)
+            self._emit_rebind(job, old_detail, alloc)
             return False
         self._bind_allocation(job, alloc)
         job.allocated_chips = chips
@@ -324,11 +353,31 @@ class Simulator:
         self._schedule_completion(job)
         if self.metrics.record_events:
             extra = {"chips": chips, "speed": speed,
-                     "track": track_label(alloc.detail)}
+                     "locality": job.locality_factor,
+                     "track": track_label(alloc.detail), "prog": _prog(job)}
             if why is not None:
                 extra["why"] = why
             self.metrics.event("resize", self.now, job, **extra)
         return True
+
+    def _emit_rebind(self, job: Job, old_detail, alloc) -> None:
+        """Event for the migrate/resize fallback that re-granted an
+        allocation in place: the move the policy asked for didn't happen,
+        but the job may now sit on a *different* slice (a better locality
+        tier), which changes its progress rate — a silent transition the
+        analyzer could not reconstruct without this record.  Skipped when
+        the re-grant is literally the same placement (nothing observable
+        changed)."""
+        if not self.metrics.record_events:
+            return
+        if old_detail is not None and alloc.detail == old_detail:
+            return
+        self.metrics.event(
+            "rebind", self.now, job,
+            chips=job.allocated_chips, speed=job.speed,
+            locality=job.locality_factor,
+            track=track_label(alloc.detail), prog=_prog(job),
+        )
 
     # ------------------------------------------------------------------ #
 
@@ -349,7 +398,8 @@ class Simulator:
         self.metrics.record_job(job)
         if record:
             self.metrics.event(
-                "finish", self.now, job, end_state=job.state.value, track=track
+                "finish", self.now, job, end_state=job.state.value, track=track,
+                prog=_prog(job),
             )
 
     # ------------------------------------------------------------------ #
@@ -423,11 +473,15 @@ class Simulator:
         self.pending.append(job)
         self.metrics.count("fault_revocations")
         if record:
+            # exact floats (schema 1): the analyzer attributes this event's
+            # lost work to its fault kind and closes the decomposition
+            # against SimResult.goodput bit-for-bit — rounding here would
+            # break the closure (docs/events.md)
             self.metrics.event(
                 "revoke", self.now, job,
                 scope=rec.label, fault=rec.kind,
-                lost_work=round(lost, 6), restore=round(restore, 6),
-                track=track,
+                lost_work=lost, restore=restore,
+                track=track, prog=_prog(job),
             )
 
     def _drain_batch(self, t: float) -> bool:
@@ -460,7 +514,13 @@ class Simulator:
                 else:
                     self.pending.append(job)
                     if self.metrics.record_events:
-                        self.metrics.event("arrival", t, job, chips=job.num_chips)
+                        # duration/status ride along so the analyzer can
+                        # derive slowdown and expected end states without
+                        # re-reading the trace
+                        self.metrics.event(
+                            "arrival", t, job, chips=job.num_chips,
+                            duration=job.duration, status=job.status,
+                        )
                 dirty = True
             elif kind == _COMPLETION:
                 job = payload
@@ -503,9 +563,23 @@ class Simulator:
     def _cutoff_at_horizon(self) -> None:
         """Horizon cutoff: charge running jobs up to max_time so executed
         work and utilization cover the full simulated span.  Shared by both
-        run-loop bodies — cold code, one owner."""
+        run-loop bodies — cold code, one owner.
+
+        Each still-running job gets a terminal ``cutoff`` event carrying its
+        final progress snapshot: the cutoff advance happens *after* the
+        job's last lifecycle event, so without this record the analyzer's
+        per-job legs would stop short of what SimResult.goodput integrates
+        (suspended/pending jobs don't advance here and need none)."""
         self.now = self.max_time
         self._advance_running(self.max_time)
+        if self.metrics.record_events:
+            for job in self.running:
+                self.metrics.event(
+                    "cutoff", self.now, job,
+                    chips=job.allocated_chips,
+                    track=track_label(job.allocation.detail),
+                    prog=_prog(job),
+                )
         self.metrics.sample(
             self.now, self.cluster, len(self.running), len(self.pending)
         )

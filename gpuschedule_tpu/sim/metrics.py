@@ -25,6 +25,15 @@ _DELAY_BUCKETS = (
     float("inf"),
 )
 
+# Version of the JSONL event-stream schema.  The stream's first record is a
+# header ``{"schema": EVENT_SCHEMA, "run_id", "seed", "policy",
+# "config_hash", ...}`` when the run supplies ``run_meta``; readers
+# (obs/analyze.py) refuse streams whose header is missing or from a
+# different schema version instead of silently mis-reconstructing.  Bump
+# this when event payloads change incompatibly (docs/events.md records the
+# policy).
+EVENT_SCHEMA = 1
+
 JOB_CSV_FIELDS = [
     "job_id",
     "num_chips",
@@ -129,6 +138,7 @@ class MetricsLog:
         record_events: bool = False,
         events_sink: Optional[Union[str, Path, IO]] = None,
         registry=None,
+        run_meta: Optional[dict] = None,
     ) -> None:
         self.job_rows: List[dict] = []
         # Structured event stream (SURVEY.md §5 "Metrics/logging": CSVs plus
@@ -142,6 +152,14 @@ class MetricsLog:
         # sink implies ``record_events``.
         self.record_events = record_events or events_sink is not None
         self.events: List[dict] = []
+        # Event-stream header (ISSUE 3 satellite): when the caller identifies
+        # the run (run_id/seed/policy/config_hash, CLI does), the first
+        # record emitted is a schema-versioned header so readers can refuse
+        # mismatched or concatenated streams.  None (the default, every
+        # pre-existing caller) emits no header and the stream is exactly the
+        # bare transition log it always was.
+        self.run_meta = dict(run_meta) if run_meta is not None else None
+        self._header_emitted = False
         self._sink_path: Optional[Path] = None
         self._sink_fh: Optional[IO] = None
         self._owns_sink = False
@@ -220,21 +238,46 @@ class MetricsLog:
             return self._sink_fh
         return None
 
+    def set_run_meta(self, **fields) -> None:
+        """Merge identifying fields into the pending event-stream header
+        (no-op once the header has been written — identity is immutable
+        after the first event)."""
+        if self._header_emitted:
+            return
+        if self.run_meta is None:
+            self.run_meta = {}
+        self.run_meta.update(fields)
+
+    def _emit_record(self, rec: dict) -> None:
+        sink = self._sink()
+        if sink is not None:
+            sink.write(json.dumps(rec) + "\n")
+        else:
+            self.events.append(rec)
+
+    def _emit_header(self) -> None:
+        """Write the schema-versioned header record ahead of the first
+        event (lazy so ``set_run_meta`` calls between construction and the
+        first transition — the Simulator fills in policy/cluster facts —
+        all land in it)."""
+        if self._header_emitted or self.run_meta is None:
+            return
+        self._header_emitted = True
+        self._emit_record({"schema": EVENT_SCHEMA, **self.run_meta})
+
     def event(self, kind: str, t: float, job: Optional[Job] = None, **extra) -> None:
         """Record one structured event (no-op unless ``record_events``):
         streamed straight to the JSONL sink when one is configured, buffered
         in :attr:`events` otherwise."""
         if not self.record_events:
             return
+        if not self._header_emitted:
+            self._emit_header()
         rec: dict = {"t": t, "event": kind}
         if job is not None:
             rec["job"] = job.job_id
         rec.update(extra)
-        sink = self._sink()
-        if sink is not None:
-            sink.write(json.dumps(rec) + "\n")
-        else:
-            self.events.append(rec)
+        self._emit_record(rec)
 
     def close_events(self) -> None:
         """Flush and (when this log opened it) close the JSONL sink.  Safe
@@ -245,6 +288,15 @@ class MetricsLog:
                 self._sink_fh.close()
                 self._sink_fh = None
                 self._owns_sink = False
+
+    def __enter__(self) -> "MetricsLog":
+        """Context-manager path (ISSUE 3 satellite): guarantees the JSONL
+        sink is flushed/closed even when the engine raises mid-run, so a
+        crashed replay still leaves an analyzable stream behind."""
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close_events()
 
     @staticmethod
     def _job_row(job: Job) -> dict:
@@ -402,12 +454,15 @@ class MetricsLog:
             if self._sink_path is not None or self._sink_fh is not None:
                 # streamed as they happened; just make them durable.  A
                 # zero-event run never opened its lazy path sink — force the
-                # file into existence so the (possibly empty) JSONL is always
-                # there, exactly as the buffered branch below guarantees.
+                # file into existence (header included, when armed) so the
+                # JSONL is always there, exactly as the buffered branch
+                # below guarantees.
                 if self._sink_path is not None and not self._sink_opened:
                     self._sink()
+                self._emit_header()
                 self.close_events()
             else:
+                self._emit_header()  # zero-event buffered run, header armed
                 with open(out / f"{prefix}events.jsonl", "w") as f:
                     for rec in self.events:
                         f.write(json.dumps(rec) + "\n")

@@ -1,0 +1,298 @@
+"""Run analytics layer (ISSUE 3): quantile helpers, header guard, lifecycle
+reconstruction, and the golden closure against SimResult.goodput."""
+
+from __future__ import annotations
+
+import csv
+import math
+
+import pytest
+
+from gpuschedule_tpu.cluster.base import SimpleCluster
+from gpuschedule_tpu.cluster.tpu import TpuCluster
+from gpuschedule_tpu.faults.recovery import FaultPlan, RecoveryModel
+from gpuschedule_tpu.faults.schedule import (
+    FaultConfig,
+    fault_horizon,
+    generate_fault_schedule,
+)
+from gpuschedule_tpu.faults.sweep import POLICY_CONFIGS
+from gpuschedule_tpu.obs.analyze import (
+    SCHEMA_VERSION,
+    RunAnalysis,
+    SchemaError,
+    StreamError,
+    analyze_events,
+    analyze_file,
+    config_hash,
+)
+from gpuschedule_tpu.obs.metrics import Histogram, exact_quantile, quantile_sorted
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.policies.fifo import FifoPolicy
+from gpuschedule_tpu.sim import Simulator
+from gpuschedule_tpu.sim.metrics import EVENT_SCHEMA, MetricsLog
+from gpuschedule_tpu.sim.philly import generate_philly_like_trace
+from gpuschedule_tpu.sim.trace import generate_poisson_trace
+
+META = {"run_id": "t", "seed": 0, "policy": "x", "config_hash": "c"}
+
+
+# --------------------------------------------------------------------- #
+# quantile helpers (satellite): pinned against numpy
+
+def test_exact_quantile_matches_numpy_bit_for_bit():
+    np = pytest.importorskip("numpy")
+    import random
+
+    rng = random.Random(42)
+    data = [rng.uniform(0, 1e4) for _ in range(257)]
+    for q in (0.0, 0.01, 0.25, 0.5, 0.75, 0.95, 0.99, 1.0):
+        assert exact_quantile(data, q) == float(np.quantile(data, q)), q
+    # small and degenerate inputs
+    assert exact_quantile([3.0], 0.5) == 3.0
+    assert exact_quantile([1.0, 2.0], 0.5) == float(np.quantile([1.0, 2.0], 0.5))
+    with pytest.raises(ValueError):
+        exact_quantile([], 0.5)
+    with pytest.raises(ValueError):
+        exact_quantile([1.0], 1.5)
+    # the one-sort-many-quantiles path agrees bit-for-bit
+    s = sorted(data)
+    for q in (0.0, 0.25, 0.95, 1.0):
+        assert quantile_sorted(s, q) == exact_quantile(data, q)
+
+
+def test_histogram_quantile_interpolates_buckets():
+    np = pytest.importorskip("numpy")
+    h = Histogram("t", buckets=(10.0, 20.0, 30.0, 40.0))
+    # 10 observations spread uniformly inside (10, 20]: the uniform-within-
+    # bucket assumption holds exactly, so interpolation is exact
+    data = [10.0 + (i + 1) for i in range(10)]
+    for v in data:
+        h.observe(v)
+    assert h.quantile(0.5) == pytest.approx(15.0)
+    assert h.quantile(1.0) == 20.0
+    # against numpy on the same data the error is bounded by one bucket
+    for q in (0.25, 0.5, 0.9):
+        assert abs(h.quantile(q) - float(np.quantile(data, q))) <= 10.0
+    # +Inf bucket saturates at the last finite edge
+    h2 = Histogram("t2", buckets=(1.0,))
+    h2.observe(50.0)
+    assert h2.quantile(0.99) == 1.0
+    assert math.isnan(Histogram("t3").quantile(0.5))
+    with pytest.raises(ValueError):
+        h.quantile(-0.1)
+
+
+# --------------------------------------------------------------------- #
+# header guard (satellite)
+
+def _events_for(policy=None, *, run_meta=META, n=40, chips=16, faults=None):
+    jobs = generate_poisson_trace(n, seed=9, mean_duration=600.0)
+    m = MetricsLog(record_events=True, run_meta=run_meta)
+    Simulator(
+        SimpleCluster(chips), policy or FifoPolicy(), jobs,
+        metrics=m, faults=faults,
+    ).run()
+    return m.events
+
+
+def test_reader_and_writer_agree_on_schema_version():
+    assert SCHEMA_VERSION == EVENT_SCHEMA
+
+
+def test_header_record_leads_the_stream_and_parses():
+    events = _events_for()
+    assert events[0]["schema"] == EVENT_SCHEMA
+    assert events[0]["total_chips"] == 16  # engine fills cluster capacity
+    an = analyze_events(iter(events))
+    assert an.header is not None
+    assert an.header.policy == "x" and an.header.seed == 0
+    assert an.header.total_chips == 16
+
+
+def test_missing_header_is_refused_unless_opted_out():
+    events = _events_for(run_meta=None)
+    with pytest.raises(SchemaError, match="no schema header"):
+        analyze_events(iter(events))
+    an = analyze_events(iter(events), require_header=False)
+    assert an.header is None and len(an.jobs) == 40
+
+
+def test_unknown_schema_version_is_refused():
+    events = _events_for()
+    events[0] = {**events[0], "schema": 999}
+    with pytest.raises(SchemaError, match="schema 999"):
+        analyze_events(iter(events))
+
+
+def test_concatenated_streams_are_refused():
+    events = _events_for()
+    with pytest.raises(StreamError, match="concatenates"):
+        analyze_events(iter(events + events))
+
+
+def test_illegal_transitions_are_stream_errors():
+    base = {"schema": EVENT_SCHEMA, **META}
+    arrival = {"t": 0.0, "event": "arrival", "job": "j0", "chips": 1}
+    with pytest.raises(StreamError, match="illegal transition"):
+        analyze_events(iter(
+            [base, arrival, {"t": 1.0, "event": "preempt", "job": "j0"}]
+        ))
+    with pytest.raises(StreamError, match="unknown/finished job"):
+        analyze_events(iter(
+            [base, {"t": 1.0, "event": "finish", "job": "ghost"}]
+        ))
+    # non-strict mode tallies instead of raising
+    an = analyze_events(iter(
+        [base, arrival, {"t": 1.0, "event": "preempt", "job": "j0"}]
+    ), strict=False)
+    assert an.counts["anomalies"] == 1
+
+
+def test_config_hash_is_stable_and_order_independent():
+    a = config_hash({"x": 1, "y": "z"})
+    b = config_hash({"y": "z", "x": 1})
+    assert a == b and len(a) == 12
+    assert config_hash({"x": 2, "y": "z"}) != a
+
+
+# --------------------------------------------------------------------- #
+# golden lifecycle reconstruction (satellite): all eight policies, with and
+# without faults — analyzer-derived per-job columns equal jobs.csv exactly,
+# and the fault-attribution closure equals SimResult.goodput to the last
+# float (acceptance criterion)
+
+def _run_policy_cell(policy_key: str, mtbf: float, tmp_path):
+    name, kwargs = POLICY_CONFIGS[policy_key]
+    cluster = TpuCluster("v5e", dims=(4, 4))
+    jobs = generate_philly_like_trace(40, seed=7)
+    plan = FaultPlan(
+        records=generate_fault_schedule(
+            cluster, FaultConfig(mtbf=mtbf, repair=1800.0),
+            horizon=fault_horizon(jobs), seed=7,
+        ),
+        recovery=RecoveryModel(ckpt_interval=900.0, restore=30.0),
+    )
+    metrics = MetricsLog(record_events=True, run_meta=dict(META))
+    res = Simulator(
+        cluster, make_policy(name, **kwargs), jobs,
+        metrics=metrics, faults=plan,
+    ).run()
+    metrics.write(tmp_path)
+    with open(tmp_path / "jobs.csv") as f:
+        rows = {r["job_id"]: r for r in csv.DictReader(f)}
+    return res, analyze_events(iter(metrics.events)), rows
+
+
+@pytest.mark.parametrize("policy_key", sorted(POLICY_CONFIGS))
+@pytest.mark.parametrize("mtbf", [math.inf, 6 * 3600.0],
+                         ids=["fault-free", "faulty"])
+def test_golden_lifecycle_reconstruction(policy_key, mtbf, tmp_path):
+    res, an, rows = _run_policy_cell(policy_key, mtbf, tmp_path)
+    assert len(an.jobs) == len(rows) == 40
+    if mtbf != math.inf:
+        assert an.counts.get("fault", 0) > 0  # the chaos arm really fired
+    for rec in an.jobs:
+        row = rows[rec.job_id]
+        # exact timestamps -> exact wait/JCT
+        if row["jct"]:
+            assert rec.jct() == float(row["jct"]), rec.job_id
+        else:
+            assert rec.jct() is None
+        if row["queueing_delay"]:
+            assert rec.wait() == float(row["queueing_delay"]), rec.job_id
+        elif rec.end_state != "rejected":
+            assert rec.wait() is None
+        # exact counters
+        assert rec.preempts == int(row["preempt_count"]), rec.job_id
+        assert rec.migrations == int(row["migration_count"]), rec.job_id
+        assert rec.faults == int(row["fault_count"]), rec.job_id
+        # service legs from the engine snapshots, rounded like the CSV
+        assert round(rec.work, 6) == float(row["executed_work"]), rec.job_id
+        assert round(rec.service, 6) == float(row["attained_service"]), rec.job_id
+        assert round(rec.lost_work, 6) == float(row["lost_work"]), rec.job_id
+        # terminal states agree (unfinished analyzer records have None)
+        if rec.end_state is not None:
+            assert rec.end_state == row["end_state"], rec.job_id
+        else:
+            assert row["end_state"] not in ("done", "failed", "killed", "rejected")
+    # the acceptance criterion: exact closure, every key, every float
+    assert an.goodput() == res.goodput
+    # cross-checked headline numbers (same formulas, same floats)
+    s = an.summary()
+    assert s["avg_jct"] == res.avg_jct
+    assert s["makespan"] == res.makespan
+    assert s["num_finished"] == res.num_finished
+    assert s["num_rejected"] == res.num_rejected
+    assert s["num_done"] == res.num_done
+    assert s["num_failed"] == res.num_failed
+    assert s["num_killed"] == res.num_killed
+    assert s["preemptions"] == res.counters.get("preemptions", 0)
+    assert s["revocations"] == res.counters.get("fault_revocations", 0)
+    # analyzer's own integration agrees with the engine snapshots
+    assert an.max_progress_drift < 1e-9
+
+
+def test_fault_attribution_kinds_cover_all_lost_work(tmp_path):
+    res, an, _ = _run_policy_cell("dlas", 6 * 3600.0, tmp_path)
+    attribution = an.fault_attribution()
+    assert attribution["goodput"] == res.goodput
+    # per-kind split telescopes to the exact total up to re-association
+    total = attribution["goodput"]["lost_chip_s"]
+    assert attribution["kinds_lost_chip_s"] == pytest.approx(total, rel=1e-9)
+    assert abs(attribution["closure_residual"]) <= 1e-6 * max(1.0, total)
+    assert sum(k["revocations"] for k in attribution["kinds"].values()) == \
+        res.counters.get("fault_revocations", 0)
+
+
+def test_distributions_pin_against_numpy(tmp_path):
+    np = pytest.importorskip("numpy")
+    _, an, _ = _run_policy_cell("srtf", math.inf, tmp_path)
+    fin = [r for r in an.jobs if r.finished]
+    waits = [r.wait() for r in fin if r.wait() is not None]
+    d = an.distributions()["wait"]
+    for name, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+        assert d[name] == float(np.quantile(waits, q)), name
+    assert d["n"] == len(waits)
+
+
+def test_util_series_and_occupancy_bounds(tmp_path):
+    _, an, _ = _run_policy_cell("fifo", math.inf, tmp_path)
+    assert an.util_series, "series must not be empty"
+    total = an.header.total_chips
+    for t, used, running, pending in an.util_series:
+        assert used >= 0 and running >= 0 and pending >= 0
+        assert used <= total  # fifo never overlay-packs
+    assert 0.0 < an.mean_occupancy <= 1.0
+    assert 0.0 <= an.mean_fragmentation <= 1.0
+    # series is time-ordered
+    times = [t for t, *_ in an.util_series]
+    assert times == sorted(times)
+
+
+def test_unfinished_jobs_get_cutoff_snapshots():
+    """A horizon cutoff advances running jobs past their last lifecycle
+    event; the cutoff record carries the final legs so closure holds."""
+    jobs = generate_poisson_trace(30, seed=3, mean_duration=4000.0)
+    m = MetricsLog(record_events=True, run_meta=dict(META))
+    res = Simulator(
+        SimpleCluster(8), FifoPolicy(), jobs, metrics=m, max_time=3000.0,
+    ).run()
+    kinds = [e.get("event") for e in m.events]
+    assert "cutoff" in kinds
+    an = analyze_events(iter(m.events))
+    assert an.goodput() == res.goodput
+    unfinished = [r for r in an.jobs if r.end_state is None]
+    assert unfinished and any(r.service > 0 for r in unfinished)
+
+
+def test_analyze_file_streams_jsonl(tmp_path):
+    sink = tmp_path / "ev.jsonl"
+    jobs = generate_poisson_trace(25, seed=5, mean_duration=400.0)
+    m = MetricsLog(events_sink=sink, run_meta=dict(META))
+    res = Simulator(SimpleCluster(8), FifoPolicy(), jobs, metrics=m).run()
+    m.close_events()
+    an = analyze_file(sink)
+    assert isinstance(an, RunAnalysis)
+    assert an.goodput() == res.goodput
+    assert len(an.jobs) == 25

@@ -435,9 +435,14 @@ def test_run_events_flag_writes_jsonl(tmp_path, capsys):
         "--out", str(tmp_path),
     )
     assert rc == 0
-    events = (tmp_path / "events.jsonl").read_text().strip().splitlines()
-    assert events
-    kinds = {json.loads(ln)["event"] for ln in events}
+    records = [
+        json.loads(ln)
+        for ln in (tmp_path / "events.jsonl").read_text().strip().splitlines()
+    ]
+    assert records
+    # CLI captures open with the schema-1 identity header (ISSUE 3)
+    assert records[0]["schema"] == 1 and records[0]["policy"] == "srtf"
+    kinds = {r["event"] for r in records if "event" in r}
     assert "start" in kinds and "finish" in kinds
 
 

@@ -4,6 +4,8 @@ from __future__ import annotations
 
 import json
 
+import pytest
+
 from gpuschedule_tpu.cluster.base import SimpleCluster
 from gpuschedule_tpu.policies.dlas import DlasPolicy
 from gpuschedule_tpu.policies.fifo import FifoPolicy
@@ -154,6 +156,83 @@ def test_start_and_preempt_events_carry_rationale_and_track():
         assert "rank" in why and "queue" in why
     for e in (e for e in metrics.events if e["event"] == "preempt"):
         assert e["why"]["rule"] == "displaced-by-priority-prefix"
+
+
+# --------------------------------------------------------------------- #
+# ISSUE 3 satellites: schema header + deterministic flush on engine crash
+
+
+class _ExplodingPolicy(FifoPolicy):
+    """Schedules normally, then raises once a few events have streamed."""
+
+    def schedule(self, sim):
+        if sim.now > 0 and sim.metrics.counters.get("arrivals", 0) >= 5:
+            raise RuntimeError("boom mid-run")
+        return super().schedule(sim)
+
+
+def test_context_manager_flushes_sink_on_engine_exception(tmp_path):
+    """Regression (ISSUE 3 satellite): an engine crash inside `with
+    MetricsLog(...)` must leave a flushed, closed, analyzable JSONL behind
+    — not a half-buffered file lost with the traceback."""
+    sink = tmp_path / "crash.jsonl"
+    jobs = generate_poisson_trace(30, seed=4, mean_duration=600.0)
+    metrics = MetricsLog(
+        events_sink=sink,
+        run_meta={"run_id": "crash", "seed": 4, "policy": "fifo",
+                  "config_hash": "cafe"},
+    )
+    with pytest.raises(RuntimeError, match="boom"):
+        with metrics:
+            Simulator(SimpleCluster(8), _ExplodingPolicy(), jobs,
+                      metrics=metrics).run()
+    assert metrics._sink_fh is None  # really closed, not just flushed
+    lines = [json.loads(x) for x in sink.read_text().splitlines()]
+    assert lines[0]["schema"] == 1 and lines[0]["run_id"] == "crash"
+    assert any(e.get("event") == "start" for e in lines[1:])
+    # the partial stream is still analyzable (crashed runs are exactly
+    # when you want to ask it questions)
+    from gpuschedule_tpu.obs import analyze_file
+
+    an = analyze_file(sink)
+    assert an.header.run_id == "crash" and an.jobs
+
+
+def test_header_leads_sink_stream_and_zero_event_runs(tmp_path):
+    meta = {"run_id": "z", "seed": 0, "policy": "p", "config_hash": "00"}
+    sink = tmp_path / "ev.jsonl"
+    log = MetricsLog(events_sink=sink, run_meta=dict(meta))
+    log.event("start", 1.0)
+    log.close_events()
+    lines = [json.loads(x) for x in sink.read_text().splitlines()]
+    assert lines[0]["schema"] == 1 and lines[1]["event"] == "start"
+
+    # a zero-event run still materializes header-only files on write()
+    out = tmp_path / "out"
+    log2 = MetricsLog(events_sink=out / "ev.jsonl", run_meta=dict(meta))
+    log2.write(out)
+    assert json.loads((out / "ev.jsonl").read_text())["schema"] == 1
+    log3 = MetricsLog(record_events=True, run_meta=dict(meta))
+    log3.write(out / "buffered")
+    assert json.loads(
+        (out / "buffered" / "events.jsonl").read_text()
+    )["schema"] == 1
+
+
+def test_no_header_without_run_meta():
+    """Pre-existing callers (no run_meta) keep the bare stream: headers
+    are strictly opt-in."""
+    _, metrics = _run(FifoPolicy())
+    assert "schema" not in metrics.events[0]
+    assert metrics.events[0]["event"] == "arrival"
+
+
+def test_set_run_meta_merges_until_first_event():
+    log = MetricsLog(record_events=True, run_meta={"run_id": "a"})
+    log.set_run_meta(seed=5)
+    log.event("start", 0.0)
+    log.set_run_meta(seed=99)  # too late: identity froze with the header
+    assert log.events[0] == {"schema": 1, "run_id": "a", "seed": 5}
 
 
 def test_rationale_skipped_when_events_off():

@@ -1,0 +1,273 @@
+"""HTML report + cross-run compare + the report/compare/--events CLI
+surfaces (ISSUE 3 tentpole + satellites)."""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+
+import pytest
+
+from gpuschedule_tpu.cli import main
+from gpuschedule_tpu.cluster.base import SimpleCluster
+from gpuschedule_tpu.obs.analyze import SchemaError, analyze_events, analyze_file
+from gpuschedule_tpu.obs.compare import (
+    compare_runs,
+    parse_thresholds,
+)
+from gpuschedule_tpu.obs.report import render_report
+from gpuschedule_tpu.policies.dlas import DlasPolicy
+from gpuschedule_tpu.policies.fifo import FifoPolicy
+from gpuschedule_tpu.sim import Simulator
+from gpuschedule_tpu.sim.metrics import MetricsLog
+from gpuschedule_tpu.sim.trace import generate_poisson_trace
+
+META = {"run_id": "r0", "seed": 11, "policy": "p", "config_hash": "deadbeef0123"}
+
+
+def _analysis(policy=None, *, seed=11, faults=None, run_meta=None, n=50):
+    jobs = generate_poisson_trace(n, seed=seed, mean_duration=600.0)
+    meta = dict(run_meta if run_meta is not None else META)
+    m = MetricsLog(record_events=True, run_meta=meta)
+    Simulator(SimpleCluster(8), policy or FifoPolicy(), jobs,
+              metrics=m, faults=faults).run()
+    return analyze_events(iter(m.events))
+
+
+# --------------------------------------------------------------------- #
+# report: one self-contained file, zero network references
+
+def test_report_is_self_contained_html():
+    doc = render_report(_analysis(DlasPolicy(thresholds=(600.0,))))
+    assert doc.lstrip().startswith("<!DOCTYPE html>")
+    # the acceptance criterion: no network fetch of any kind
+    for pattern in ("http://", "https://", "<script", "<link", "@import",
+                    "src=", "url("):
+        assert pattern not in doc, pattern
+    # the panels are all there
+    for marker in ("Chip occupancy", "Pending queue", "completion-time CDF",
+                   "Distributions", "Slowest jobs", "<svg", "viz-root"):
+        assert marker in doc, marker
+    # header identity is surfaced
+    assert "r0" in doc and "deadbeef0123" in doc
+
+
+def test_report_fault_panel_appears_only_with_faults(tmp_path):
+    quiet = render_report(_analysis())
+    assert "<h2>Faults</h2>" not in quiet
+
+    from gpuschedule_tpu.cluster.tpu import TpuCluster
+    from gpuschedule_tpu.faults.recovery import FaultPlan, RecoveryModel
+    from gpuschedule_tpu.faults.schedule import (
+        FaultConfig,
+        fault_horizon,
+        generate_fault_schedule,
+    )
+    from gpuschedule_tpu.sim.philly import generate_philly_like_trace
+
+    cluster = TpuCluster("v5e", dims=(4, 4))
+    jobs = generate_philly_like_trace(40, seed=7)
+    plan = FaultPlan(
+        records=generate_fault_schedule(
+            cluster, FaultConfig(mtbf=6 * 3600.0, repair=1800.0),
+            horizon=fault_horizon(jobs), seed=7),
+        recovery=RecoveryModel(ckpt_interval=900.0, restore=30.0),
+    )
+    m = MetricsLog(record_events=True, run_meta=dict(META))
+    Simulator(cluster, DlasPolicy(thresholds=(600.0,)), jobs,
+              metrics=m, faults=plan).run()
+    doc = render_report(analyze_events(iter(m.events)))
+    assert "<h2>Faults</h2>" in doc
+    assert "revocations" in doc and "fault kind" in doc
+    # every chart's data also exists as text (tables/labels), so nothing
+    # is color-only; the report embeds balanced SVG markup
+    assert doc.count("<svg") == doc.count("</svg>") >= 4
+
+
+def test_report_tolerates_empty_run():
+    an = analyze_events(iter([{"schema": 1, **META}]))
+    doc = render_report(an)
+    assert "no samples" in doc or "no finished jobs" in doc
+
+
+# --------------------------------------------------------------------- #
+# compare semantics
+
+def test_self_compare_is_clean():
+    a = _analysis()
+    b = _analysis()
+    result = compare_runs(a, b)
+    assert result.ok and result.exit_code == 0
+    assert all(r.delta in (0.0, None) for r in result.rows)
+
+
+def test_cross_policy_compare_allowed_and_detects_regression():
+    a = _analysis(DlasPolicy(thresholds=(600.0,)))
+    b = _analysis(FifoPolicy(), run_meta={**META, "policy": "fifo"})
+    # same seed + config hash, different policy: comparable by design
+    result = compare_runs(a, b, threshold=1e-12)
+    assert not result.ok and result.exit_code == 1
+    assert result.regressions
+    # polarity respected: a REGRESSED row must actually be worse
+    for row in result.regressions:
+        assert row.rel is not None and row.rel != 0.0
+
+
+def test_mismatched_headers_are_refused():
+    a = _analysis()
+    b = _analysis(seed=12, run_meta={**META, "seed": 12, "config_hash": "ffff"})
+    with pytest.raises(SchemaError, match="not comparable"):
+        compare_runs(a, b)
+    assert compare_runs(a, b, allow_mismatch=True) is not None
+
+
+def test_missing_header_refused_by_compare():
+    jobs = generate_poisson_trace(10, seed=1, mean_duration=60.0)
+    m = MetricsLog(record_events=True)
+    Simulator(SimpleCluster(4), FifoPolicy(), jobs, metrics=m).run()
+    bare = analyze_events(iter(m.events), require_header=False)
+    with pytest.raises(SchemaError, match="no stream header"):
+        compare_runs(bare, bare)
+
+
+def test_parse_thresholds():
+    default, per = parse_thresholds(["0.1", "wait_p99=0.01", "avg_jct=-0.05"])
+    assert default == 0.1
+    assert per == {"wait_p99": 0.01, "avg_jct": -0.05}
+    with pytest.raises(ValueError, match="non-gated"):
+        parse_thresholds(["not_a_metric=1.0"])
+    with pytest.raises(ValueError, match="FLOAT"):
+        parse_thresholds(["wait_p99=abc"])
+
+
+def test_negative_threshold_demands_improvement():
+    a = _analysis(DlasPolicy(thresholds=(600.0,)))
+    b = _analysis(FifoPolicy(), run_meta={**META, "policy": "fifo"})
+    # fifo is strictly worse here; demanding improvement must fail too
+    assert not compare_runs(a, b, threshold=-0.99).ok
+    # and an UNCHANGED metric fails an improvement demand (review fix: the
+    # float-dust floor must not neutralize negative thresholds)
+    same = compare_runs(_analysis(), _analysis(),
+                        per_metric={"avg_jct": -0.01})
+    assert not same.ok
+    assert [r.metric for r in same.regressions] == ["avg_jct"]
+
+
+def test_compare_refuses_corrupt_or_missing_streams(tmp_path, capsys):
+    """Review fix: a truncated record (writer SIGKILLed mid-line) or a
+    wrong path must take the exit-2 'not comparable' path, never exit 1
+    ('scheduler regressed') via a raw traceback."""
+    good = tmp_path / "good.jsonl"
+    rc = main([
+        "run", "--policy", "fifo", "--cluster", "simple", "--chips", "8",
+        "--synthetic", "10", "--seed", "1", "--events", str(good),
+    ])
+    assert rc == 0
+    truncated = tmp_path / "trunc.jsonl"
+    truncated.write_text(good.read_text()[:-25])
+    assert main(["compare", str(good), str(truncated)]) == 2
+    assert "corrupt" in capsys.readouterr().err
+    assert main(["compare", str(good), str(tmp_path / "missing.jsonl")]) == 2
+    with pytest.raises(SystemExit):
+        main(["report", "--events", str(truncated),
+              "--out", str(tmp_path / "r.html")])
+
+
+# --------------------------------------------------------------------- #
+# CLI wiring: run --events PATH, faults --events DIR, report, compare
+
+def _cli_run(tmp_path, name, *extra):
+    path = tmp_path / name
+    rc = main([
+        "run", "--policy", "dlas", "--cluster", "simple", "--chips", "16",
+        "--synthetic", "40", "--seed", "2", "--events", str(path), *extra,
+    ])
+    assert rc == 0
+    return path
+
+
+def test_run_events_path_without_out(tmp_path):
+    path = _cli_run(tmp_path, "ev.jsonl")
+    lines = path.read_text().splitlines()
+    header = json.loads(lines[0])
+    assert header["schema"] == 1
+    assert header["policy"] == "dlas" and header["seed"] == 2
+    assert header["total_chips"] == 16
+    assert len(header["config_hash"]) == 12
+    an = analyze_file(path)
+    assert len(an.jobs) == 40
+
+
+def test_cli_report_and_compare_roundtrip(tmp_path):
+    a = _cli_run(tmp_path, "a.jsonl")
+    b = _cli_run(tmp_path, "b.jsonl")
+    out = tmp_path / "report.html"
+    rc = main(["report", "--events", str(a), "--out", str(out),
+               "--json", str(tmp_path / "analysis.json")])
+    assert rc == 0
+    doc = out.read_text()
+    assert "<!DOCTYPE html>" in doc and "https://" not in doc
+    analysis = json.loads((tmp_path / "analysis.json").read_text())
+    assert analysis["summary"]["num_jobs"] == 40
+
+    # identical runs: exit 0
+    assert main(["compare", str(a), str(b),
+                 "--json", str(tmp_path / "cmp.json")]) == 0
+    cmp_doc = json.loads((tmp_path / "cmp.json").read_text())
+    assert cmp_doc["ok"] is True and cmp_doc["regressions"] == []
+
+
+def test_cli_compare_gates_and_refuses(tmp_path, capsys):
+    a = _cli_run(tmp_path, "a.jsonl")
+    # different policy, same world: allowed, and a hostile threshold
+    # forces a nonzero exit (the CI-gate contract)
+    b = tmp_path / "b.jsonl"
+    assert main([
+        "run", "--policy", "fifo", "--cluster", "simple", "--chips", "16",
+        "--synthetic", "40", "--seed", "2", "--events", str(b),
+    ]) == 0
+    assert main(["compare", str(a), str(b), "--threshold", "1e-12"]) == 1
+
+    # different seed: refused with exit 2
+    c = tmp_path / "c.jsonl"
+    assert main([
+        "run", "--policy", "dlas", "--cluster", "simple", "--chips", "16",
+        "--synthetic", "40", "--seed", "3", "--events", str(c),
+    ]) == 0
+    assert main(["compare", str(a), str(c)]) == 2
+    assert "refusing to compare" in capsys.readouterr().err
+    # ... unless explicitly overridden
+    assert main(["compare", str(a), str(c), "--allow-mismatch",
+                 "--threshold", "1e9"]) == 0
+
+
+def test_cli_faults_events_dir(tmp_path, capsys):
+    out_dir = tmp_path / "cells"
+    rc = main([
+        "faults", "--policies", "fifo,dlas", "--num-jobs", "30",
+        "--mtbf", "21600", "--max-time", "40000", "--dims", "4x4",
+        "--events", str(out_dir),
+    ])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.splitlines()[-1])
+    for key in ("fifo", "dlas"):
+        path = out_dir / f"{key}.events.jsonl"
+        assert path.exists()
+        an = analyze_file(path)
+        assert an.header.policy == key
+    # the two cells share seed + config hash: compare-compatible
+    ha = analyze_file(out_dir / "fifo.events.jsonl").header
+    hb = analyze_file(out_dir / "dlas.events.jsonl").header
+    assert ha.seed == hb.seed and ha.config_hash == hb.config_hash
+    assert {c["policy"] for c in doc["cells"]} == {"fifo", "dlas"}
+    assert all("events" in c for c in doc["cells"])
+
+
+def test_report_refuses_headerless_stream_without_flag(tmp_path):
+    bare = tmp_path / "bare.jsonl"
+    bare.write_text('{"t": 0.0, "event": "arrival", "job": "j", "chips": 1}\n')
+    with pytest.raises(SystemExit, match="no schema header"):
+        main(["report", "--events", str(bare), "--out", str(tmp_path / "r.html")])
+    assert main(["report", "--events", str(bare), "--no-header",
+                 "--out", str(tmp_path / "r.html")]) == 0

@@ -1,0 +1,24 @@
+"""Slow-marked wrapper around tools/report_smoke.py (ISSUE 3 satellite):
+the 200-job Philly-scale report + compare acceptance path."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+)
+
+
+@pytest.mark.slow
+def test_report_smoke_end_to_end(tmp_path):
+    from report_smoke import run_smoke
+
+    res = run_smoke(tmp_path)
+    assert res["ok"]
+    assert res["self_compare_rc"] == 0
+    assert res["tightened_compare_rc"] == 1
+    assert res["report_bytes"] > 10_000
