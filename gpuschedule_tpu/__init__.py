@@ -17,10 +17,15 @@ Layering (SURVEY.md §1):
     cluster/    TPU torus topology + contiguous slice allocator (+ GPU model
                 for the topology-aware comparison config)
     policies/   FIFO, SRTF, Tiresias-DLAS, Gandiva, Optimus
-    placement/  consolidated / random / greedy / topology-aware schemes
+    placement/  consolidated / random / greedy / topology-aware /
+                contention-aware schemes
     faults/     fault injection & recovery: seeded chip/slice failure
                 schedules, checkpoint-rollback recovery, MTBF robustness
                 sweeps (engine _FAULT/_REPAIR events + cluster health masks)
+    net/        shared-fabric DCN contention model: per-pod uplinks + an
+                oversubscribed aggregation core, max-min fair bandwidth
+                shares driving dynamic multislice speed factors, link
+                faults, link-level telemetry
     obs/        span tracer, metrics registry, Perfetto trace export
     profiler/   JAX step-time harness, ICI cost model, goodput curve fitting
     models/     flax benchmark models driven by the profiler

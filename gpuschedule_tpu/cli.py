@@ -42,7 +42,7 @@ def _parse_dims(raw: str) -> tuple:
     return tuple(int(x) for x in raw.lower().split("x"))
 
 
-def build_cluster(args) -> object:
+def build_cluster(args, net=None) -> object:
     if args.cluster == "simple":
         cluster = SimpleCluster(args.chips)
     elif args.cluster in ("tpu-v5e", "tpu-v5p"):
@@ -61,10 +61,33 @@ def build_cluster(args) -> object:
         # with_placement validates per flavor — an unknown/mismatched scheme
         # must error, not silently run a different experiment than requested
         try:
-            cluster = with_placement(cluster, args.placement, seed=args.placement_seed)
+            cluster = with_placement(
+                cluster, args.placement, seed=args.placement_seed, net=net
+            )
         except ValueError as e:
             raise SystemExit(str(e)) from None
     return cluster
+
+
+def build_net(args):
+    """The shared-fabric contention model for ``run --net`` (None when the
+    flag is absent — the static-factor path, bit-identical to before the
+    net layer existed)."""
+    if not getattr(args, "net", None):
+        return None
+    if args.cluster not in ("tpu-v5e", "tpu-v5p"):
+        raise SystemExit(
+            "--net models the TPU DCN fabric; use --cluster tpu-v5e/tpu-v5p"
+        )
+    from gpuschedule_tpu.net import NetConfig, NetModel, parse_net_spec
+
+    try:
+        config = (
+            parse_net_spec(args.net) if isinstance(args.net, str) else NetConfig()
+        )
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+    return NetModel(config)
 
 
 def load_jobs(args) -> List:
@@ -123,6 +146,10 @@ def _run_config_hash(args) -> str:
         "failure_rate": args.failure_rate, "util_min": args.util_min,
         "max_job_chips": args.max_job_chips, "max_time": args.max_time,
         "faults": args.faults,
+        # only present when --net is on: a net-free run's hash (and
+        # therefore its run_id and events header) must stay byte-identical
+        # to what it was before the net layer existed
+        **({"net": args.net} if getattr(args, "net", None) else {}),
     })
 
 
@@ -148,7 +175,16 @@ def cmd_run(args) -> int:
         from gpuschedule_tpu.obs import MetricsRegistry
 
         registry = MetricsRegistry()
-    cluster = build_cluster(args)
+    net_model = build_net(args)
+    if args.placement == "contention" and net_model is None:
+        # without the net model every pod scores equally and the scheme
+        # silently becomes consolidated — a different experiment than the
+        # one requested, so refuse (same rule as unknown schemes)
+        raise SystemExit(
+            "--placement contention scores pods by residual DCN bandwidth "
+            "and needs the fabric model: add --net"
+        )
+    cluster = build_cluster(args, net=net_model)
     jobs = load_jobs(args)
     # Fault injection (faults/): one --seed governs every stochastic stream
     # in the run — trace synthesis keeps the bare seed (unchanged from
@@ -203,6 +239,7 @@ def cmd_run(args) -> int:
         metrics=metrics,
         max_time=args.max_time or float("inf"),
         faults=fault_plan,
+        net=net_model,
     )
     # context-manager path: an engine exception still flushes/closes the
     # JSONL sink, leaving an analyzable stream behind (ISSUE 3 satellite)
@@ -450,6 +487,21 @@ def cmd_compare_topology(args) -> int:
             jobs(pods_of.get(name, 1)),
         ).run()
 
+    # contention column: the 2-pod fleet again, this time with the shared-
+    # fabric model on — whales pay a max-min fair share of the DCN instead
+    # of each assuming an isolated fabric.  The ratio vs the static 2-pod
+    # replay is the shared-fabric penalty under the default 4:1 core
+    # oversubscription: >= 1.0 even for a lone gang (the static model
+    # assumed an isolated, non-blocking fabric), larger when gangs
+    # actually contend; mean link utilization says how loaded it was.
+    from gpuschedule_tpu.net import NetModel
+
+    net_model = NetModel()
+    results["tpu-v5p-2pod-net"] = Simulator(
+        TpuCluster("v5p", num_pods=2), make_policy(args.policy, **pol_kwargs),
+        jobs(2), net=net_model,
+    ).run()
+
     rand = [results[k] for k in results if k.startswith("gpu-random-s")]
     # how many gangs actually spanned pods in the 2-pod replay: on the
     # synthetic path (or a whale-free Philly trace) the answer is zero and
@@ -472,6 +524,20 @@ def cmd_compare_topology(args) -> int:
                 results["tpu-v5p-2pod"].avg_jct / results["tpu-v5p"].avg_jct
                 if n_multislice else None
             ),
+        },
+        "contention": {
+            "multislice_jobs": n_multislice,
+            "oversubscription": net_model.config.oversubscription,
+            "jct_ratio_net_over_static": (
+                results["tpu-v5p-2pod-net"].avg_jct
+                / results["tpu-v5p-2pod"].avg_jct
+                if n_multislice and results["tpu-v5p-2pod"].avg_jct > 0
+                else None
+            ),
+            "net_reprices": int(
+                results["tpu-v5p-2pod-net"].counters.get("net_reprices", 0)
+            ),
+            "mean_link_utilization": net_model.mean_utilization(),
         },
     }
     if args.load_sweep:
@@ -876,6 +942,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                           "size).  The fault schedule derives from --seed "
                           "via an independent RNG stream, so trace and "
                           "faults reproduce together")
+    run.add_argument("--net", nargs="?", const=True, default=None,
+                     metavar="SPEC",
+                     help="model the shared DCN fabric (net/): multislice "
+                          "jobs get max-min fair bandwidth shares instead "
+                          "of the static isolated-fabric speed factor, "
+                          "re-priced on every running-set change.  SPEC is "
+                          "k=v pairs: os (core oversubscription ratio, "
+                          "default 4), ingest (Gbps per occupied chip, "
+                          "default 0.05).  TPU clusters only; enables the "
+                          "'contention' placement scheme's residual-"
+                          "bandwidth scoring and ('link', pod) fault "
+                          "degradation")
     run.add_argument("--prom", metavar="PATH",
                      help="write run counters/gauges/histograms in the "
                           "Prometheus text exposition format (with --out, "

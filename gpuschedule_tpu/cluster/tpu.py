@@ -333,6 +333,11 @@ class TpuCluster(OverlayMixin, ClusterBase):
             free &= self._health[pod] == 0
         return int(free.sum())
 
+    def pod_used_chips(self, pod: int) -> int:
+        """Occupied chips in one pod (the net/ ingest-demand input: each
+        running chip pulls training data over its pod's DCN uplink)."""
+        return int(self._occ[pod].sum())
+
     def round_up(self, num_chips: int) -> int:
         """Smallest valid allocation size >= num_chips: a power-of-two
         slice within one pod, or — on a multi-pod fleet — a whole-pod
@@ -357,7 +362,12 @@ class TpuCluster(OverlayMixin, ClusterBase):
           - ``pod``: restrict search to one pod index;
           - ``origin_order``: callable mapping a list of candidate origins to
             the preferred order (placement schemes inject random/spread
-            orders here; default is lexicographic first-fit).
+            orders here; default is lexicographic first-fit);
+          - ``pod_order``: callable mapping the list of candidate pod
+            indices to the preferred search order (the contention scheme
+            sorts pods by residual DCN uplink bandwidth; default is
+            ascending pod index).  Also orders the empty pods a multislice
+            claims.
         """
         self.allocation_attempts += 1
         overlay = self._try_overlay(num_chips, hint, job)
@@ -366,7 +376,7 @@ class TpuCluster(OverlayMixin, ClusterBase):
         if num_chips <= 0:
             return None
         if num_chips > self.pod_chips:
-            return self._allocate_multislice(num_chips, job=job)
+            return self._allocate_multislice(num_chips, job=job, hint=hint)
         shapes = valid_slice_shapes(num_chips, self.dims)
         if not shapes:
             # Grant-or-None contract (ClusterBase): a non-pow2 / oversized
@@ -385,9 +395,12 @@ class TpuCluster(OverlayMixin, ClusterBase):
             p = hint["pod"]
             if not 0 <= p < self.num_pods:
                 raise ValueError(f"hinted pod {p} out of range [0, {self.num_pods})")
-            pods = [p]
+            pods: Sequence[int] = [p]
         else:
             pods = range(self.num_pods)
+            pod_order = hint.get("pod_order")
+            if pod_order is not None:
+                pods = pod_order(list(pods))
         origin_order = hint.get("origin_order")
 
         if num_chips > self.free_chips:
@@ -415,11 +428,12 @@ class TpuCluster(OverlayMixin, ClusterBase):
             and (self._unhealthy_cells == 0 or not self._health[p].any())
         ]
 
-    def _allocate_multislice(self, num_chips: int, *, job=None):
+    def _allocate_multislice(self, num_chips: int, *, job=None, hint=None):
         """Grant a gang larger than one pod as whole empty pods joined
         over DCN, or None.  Only whole-pod multiples are valid multislice
         sizes (each per-pod slice is the full torus, so every pod keeps
-        its wraparound ICI)."""
+        its wraparound ICI).  A ``pod_order`` hint decides which empty
+        pods the gang claims first."""
         m, rem = divmod(num_chips, self.pod_chips)
         if rem or m > self.num_pods:
             self.invalid_size_failures += 1
@@ -427,6 +441,10 @@ class TpuCluster(OverlayMixin, ClusterBase):
         if num_chips > self.free_chips:
             return None
         empty = self._empty_pods()
+        pod_order = (hint or {}).get("pod_order")
+        if pod_order is not None:
+            allowed = set(empty)
+            empty = [p for p in pod_order(list(empty)) if p in allowed]
         if len(empty) < m:
             # enough chips in aggregate but not enough whole pods free:
             # cross-pod fragmentation
@@ -455,14 +473,18 @@ class TpuCluster(OverlayMixin, ClusterBase):
         jobs without a known model pay a representative default."""
         # runtime import: profiler.ici imports this module for the
         # topology tables, so a top-level import would be circular
-        from gpuschedule_tpu.models.config import MODEL_CONFIGS
+        from gpuschedule_tpu.models.config import resolve_model_config
         from gpuschedule_tpu.profiler.ici import (
             cross_pod_allreduce_seconds,
             dp_gradient_bytes,
         )
 
-        cfg = MODEL_CONFIGS.get(getattr(job, "model_name", None))
-        param_count = cfg.param_count if cfg is not None else 30_000_000
+        # unknown models resolve through the shared zoo-median fallback, the
+        # same phantom model that prices their checkpoint/restore cost
+        # (sim/overhead.py) and network demand (net/)
+        param_count = resolve_model_config(
+            getattr(job, "model_name", None)
+        ).param_count
         # tp-sharded params shrink the per-chip dp-sync payload by tp —
         # the same division profile_model applies to the curve's
         # dcn_grad_bytes, so the planner's cliff and this enacted toll

@@ -30,7 +30,8 @@ one user-facing ``--seed`` governs every stochastic stream in a run.
 Trace synthesis keeps the bare seed (``random.Random(seed)``, unchanged
 from before faults existed), while each fault process derives its own
 independent stream as ``random.Random(f"{seed}:faults:<process>")`` with
-``<process>`` in ``{"mtbf", "spot"}`` (maintenance is deterministic).
+``<process>`` in ``{"mtbf", "spot", "link"}`` (maintenance is
+deterministic).
 String seeding hashes stably across runs and platforms, so the same seed
 always yields byte-identical trace *and* fault schedules, and changing
 the fault config never perturbs the trace stream (or vice versa).
@@ -42,7 +43,10 @@ Scope tuples are cluster-flavor specific (the injector hands them back to
 - ``("chip", pod, coord)`` — one chip of a TPU torus;
 - ``("box", pod, origin, shape)`` — an axis-aligned TPU sub-box;
 - ``("pod", pod)`` — a whole TPU pod;
-- ``("node", switch, node)`` — a whole GPU host node.
+- ``("node", switch, node)`` — a whole GPU host node;
+- ``("link", pod)`` — a TPU pod's DCN uplink (kind ``"link"``): handled
+  by the engine + net/ contention model, never by the health mask —
+  multislice jobs *slow down* for the outage instead of being revoked.
 """
 
 from __future__ import annotations
@@ -56,12 +60,19 @@ from typing import List, Optional, Sequence, Tuple
 @dataclass(frozen=True)
 class FaultRecord:
     """One hardware outage: ``scope`` goes down at ``time`` for
-    ``duration`` seconds (``inf`` = never repaired)."""
+    ``duration`` seconds (``inf`` = never repaired).
+
+    ``degrade`` only applies to ``("link", pod)`` scopes: the fraction of
+    the uplink's capacity that *remains* during the outage (0.0 = hard
+    outage).  Link faults slow multislice jobs through the contention
+    model (net/) instead of revoking anything — the first partial-
+    degradation fault kind."""
 
     time: float
     scope: Tuple
     duration: float
-    kind: str = "mtbf"  # mtbf | maintenance | spot
+    kind: str = "mtbf"  # mtbf | maintenance | spot | link
+    degrade: float = 0.0
 
     @property
     def label(self) -> str:
@@ -80,6 +91,8 @@ class FaultRecord:
             return f"pod{s[1]}"
         if s[0] == "node":
             return f"gpu/s{s[1]}n{s[2]}"
+        if s[0] == "link":
+            return f"dcn/pod{s[1]}"
         return str(s)
 
 
@@ -96,6 +109,13 @@ class FaultConfig:
     spot_fraction: float = 0.0          # trailing fraction of capacity that is spot
     spot_mtbf: float = 4 * 3600.0       # mean time between revocations per unit
     spot_outage: float = 1800.0         # fixed outage per revocation
+    # DCN-uplink outages (kind="link", TPU fleets only): each pod's uplink
+    # is an independent exponential process; an outage *degrades* the link
+    # to link_degrade of its capacity instead of killing anything — the
+    # contention model (net/) turns that into a multislice slowdown.
+    link_mtbf: float = math.inf         # per-uplink mean time between outages (s)
+    link_repair: float = 3600.0         # mean outage duration (s)
+    link_degrade: float = 0.25          # residual capacity fraction during outage
 
 
 def fault_horizon(jobs: Sequence, *, slack: float = 2.0) -> float:
@@ -201,6 +221,31 @@ def generate_fault_schedule(
             k += 1
             t = k * config.maintenance_period
 
+    # -- DCN-uplink degradation (TPU fleets; slows, never kills) ------- #
+    if (
+        flavor == "tpu"
+        and config.link_mtbf > 0
+        and math.isfinite(config.link_mtbf)
+        and horizon > 0
+    ):
+        rng = random.Random(f"{seed}:faults:link")
+        rate = inner.num_pods / config.link_mtbf
+
+        def link_duration() -> float:
+            if math.isinf(config.link_repair):
+                return math.inf
+            if config.link_repair > 0:
+                return rng.expovariate(1.0 / config.link_repair)
+            return 0.0
+
+        t = rng.expovariate(rate)
+        while t <= horizon:
+            records.append(FaultRecord(
+                t, ("link", rng.randrange(inner.num_pods)), link_duration(),
+                "link", degrade=config.link_degrade,
+            ))
+            t += rng.expovariate(rate)
+
     # -- spot/preemptible revocation ----------------------------------- #
     # spot_mtbf=inf (or <=0) means the spot capacity is never revoked:
     # no records, rather than a ZeroDivisionError out of expovariate
@@ -247,6 +292,9 @@ _SPEC_KEYS = {
     "spot": ("config", "spot_fraction"),
     "spot_mtbf": ("config", "spot_mtbf"),
     "spot_outage": ("config", "spot_outage"),
+    "link_mtbf": ("config", "link_mtbf"),
+    "link_repair": ("config", "link_repair"),
+    "link_degrade": ("config", "link_degrade"),
     "ckpt": ("recovery", "ckpt_interval"),
     "restore": ("recovery", "restore"),
 }
@@ -258,8 +306,10 @@ def parse_fault_spec(spec: str):
 
     Keys: ``mtbf``, ``repair``, ``maintenance`` (period),
     ``maintenance_duration``, ``spot`` (fraction), ``spot_mtbf``,
-    ``spot_outage``, ``ckpt`` (checkpoint interval), ``restore``
-    (seconds or ``auto``).  Values are seconds; ``inf`` is accepted.
+    ``spot_outage``, ``link_mtbf``, ``link_repair``, ``link_degrade``
+    (residual capacity fraction), ``ckpt`` (checkpoint interval),
+    ``restore`` (seconds or ``auto``).  Values are seconds unless noted;
+    ``inf`` is accepted.
     """
     from gpuschedule_tpu.faults.recovery import RecoveryModel
 
@@ -281,4 +331,12 @@ def parse_fault_spec(spec: str):
         else:
             value = float(raw)
         setattr(config if target == "config" else recovery, attr, value)
+    if not 0.0 <= config.link_degrade <= 1.0:
+        # a fraction, not seconds: an out-of-range value would be clamped
+        # downstream (net/), silently turning every link fault into a
+        # no-op while the counters still tick
+        raise ValueError(
+            f"link_degrade is the residual capacity FRACTION in [0, 1], "
+            f"got {config.link_degrade}"
+        )
     return config, recovery

@@ -126,6 +126,25 @@ class CnnConfig:
         return 3.0 * fl
 
 
+# Unknown-model fallback shared by every consumer that must price a job
+# whose trace-supplied model name is not in the zoo (straight-from-Philly
+# workload names): the zoo median, transformer-small.  Before this existed,
+# cluster/tpu.py hardcoded a 30M-param default while sim/overhead.py fell
+# back to the zoo median — the same Philly job paid a DCN toll and a
+# restore cost derived from two different phantom models.
+FALLBACK_MODEL = "transformer-small"
+
+
+def resolve_model_config(model_name) -> "ModelConfig | CnnConfig":
+    """The config for ``model_name``, or the shared :data:`FALLBACK_MODEL`
+    config when the name is unknown (or None).  Single source of the
+    unknown-model fallback: DCN toll (cluster/tpu.py), restore cost
+    (sim/overhead.py), and network demand (net/) all agree on what a
+    nameless job "is"."""
+    cfg = MODEL_CONFIGS.get(model_name)
+    return cfg if cfg is not None else MODEL_CONFIGS[FALLBACK_MODEL]
+
+
 # Both families expose the same estimate interface — ``param_count`` and
 # ``flops_per_token()`` (per-token for LMs, per-SAMPLE for CNNs) — which the
 # goodput, overhead, and bench arithmetic depend on.

@@ -1,0 +1,52 @@
+"""Shared-fabric network contention model (ISSUE 4 tentpole).
+
+The simulator's multislice speed model priced every DCN-spanning gang in
+isolation; this package models the *shared* fabric so contention becomes
+a scheduling signal (the axis TopoOpt and Blink show changes placement
+decisions at production scale):
+
+- :mod:`gpuschedule_tpu.net.fabric` — the capacitated topology graph:
+  per-pod DCN uplinks (``hosts x DCN_GBPS``) feeding one aggregation
+  core (``sum(uplinks) / oversubscription``);
+- :mod:`gpuschedule_tpu.net.maxmin` — the deterministic max-min fair
+  allocator (progressive filling over the active flow set);
+- :mod:`gpuschedule_tpu.net.model` — ``NetModel``: per-job demands from
+  the :mod:`~gpuschedule_tpu.profiler.ici` analytic allreduce model,
+  dynamic ``locality_factor`` re-pricing on every running-set change,
+  ``("link", pod)`` fault degradation, and residual-bandwidth scoring
+  for the ``contention`` placement scheme;
+- :mod:`gpuschedule_tpu.net.sweep` — the contention-vs-offered-load grid
+  behind ``tools/net_sweep.py``.
+
+Engine integration lives in :mod:`gpuschedule_tpu.sim.engine`
+(``Simulator(net=...)``, the ``net`` / ``netlink`` event kinds); the
+observability side is in :mod:`gpuschedule_tpu.obs` (link-utilization
+gauges, per-link Perfetto tracks, the analyzer's network panel).  Like
+the sim core, this package is deliberately jax-free.
+"""
+
+from gpuschedule_tpu.net.fabric import CORE, FabricTopology, Link, uplink
+from gpuschedule_tpu.net.maxmin import Flow, maxmin_allocate
+from gpuschedule_tpu.net.model import (
+    JobShare,
+    LinkSample,
+    NetConfig,
+    NetModel,
+    NetState,
+    parse_net_spec,
+)
+
+__all__ = [
+    "CORE",
+    "FabricTopology",
+    "Link",
+    "uplink",
+    "Flow",
+    "maxmin_allocate",
+    "JobShare",
+    "LinkSample",
+    "NetConfig",
+    "NetModel",
+    "NetState",
+    "parse_net_spec",
+]

@@ -1,0 +1,120 @@
+"""Shared-fabric topology: per-pod DCN uplinks + an aggregation core.
+
+The multislice speed model in :mod:`gpuschedule_tpu.cluster.tpu` prices a
+DCN-spanning gang *in isolation* — every job sees the full nominal
+:data:`~gpuschedule_tpu.cluster.tpu.DCN_GBPS` as if it owned the fabric.
+This module is the shared fabric that isolation assumption ignores: a
+capacitated graph the contention model (:mod:`gpuschedule_tpu.net.model`)
+allocates real bandwidth over.
+
+The graph is deliberately the smallest one that exhibits contention
+(TopoOpt/Blink model richer fabrics; see docs/network.md for the
+omissions):
+
+- one **uplink per pod**, capacity ``hosts_per_pod x dcn_gbps`` — every
+  host in a pod has one ``dcn_gbps`` NIC toward the datacenter network,
+  and a pod's aggregate DCN injection is bounded by the sum of its NICs;
+- one **aggregation core** all cross-pod traffic traverses, capacity
+  ``sum(uplinks) / oversubscription`` — the classic Clos oversubscription
+  knob (1.0 = non-blocking, in which case disjoint-pod jobs never
+  contend; the 4.0 default is the textbook 4:1 datacenter fabric).
+
+Pure stdlib, jax-free (sim-core rule): the topology tables come from the
+same ``GENERATIONS`` spec the allocator uses, via the cluster instance.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, Iterable, Tuple
+
+CORE = "core"
+
+
+def uplink(pod: int) -> str:
+    """Canonical link name for pod ``pod``'s DCN uplink."""
+    return f"uplink/pod{pod}"
+
+
+@dataclass(frozen=True)
+class Link:
+    """One capacitated fabric edge."""
+
+    name: str
+    capacity_gbps: float
+
+
+class FabricTopology:
+    """The capacitated link set of one TPU fleet's shared DCN fabric."""
+
+    def __init__(
+        self,
+        *,
+        num_pods: int,
+        hosts_per_pod: int,
+        dcn_gbps: float,
+        oversubscription: float = 4.0,
+    ):
+        if num_pods < 1:
+            raise ValueError(f"num_pods must be >= 1, got {num_pods}")
+        if hosts_per_pod < 1:
+            raise ValueError(f"hosts_per_pod must be >= 1, got {hosts_per_pod}")
+        if dcn_gbps <= 0:
+            raise ValueError(f"dcn_gbps must be > 0, got {dcn_gbps}")
+        if oversubscription <= 0:
+            raise ValueError(
+                f"oversubscription must be > 0, got {oversubscription}"
+            )
+        self.num_pods = int(num_pods)
+        self.hosts_per_pod = int(hosts_per_pod)
+        self.dcn_gbps = float(dcn_gbps)
+        self.oversubscription = float(oversubscription)
+        self.uplink_gbps = self.hosts_per_pod * self.dcn_gbps
+        self.core_gbps = self.num_pods * self.uplink_gbps / self.oversubscription
+        self.links: Dict[str, Link] = {
+            CORE: Link(CORE, self.core_gbps),
+            **{
+                uplink(p): Link(uplink(p), self.uplink_gbps)
+                for p in range(self.num_pods)
+            },
+        }
+
+    @classmethod
+    def from_cluster(cls, cluster, *, oversubscription: float = 4.0):
+        """Build the fabric for a (possibly placement-wrapped) TpuCluster,
+        reusing the allocator's own generation spec for hosts-per-pod and
+        the nominal per-host DCN bandwidth."""
+        from gpuschedule_tpu.cluster.tpu import DCN_GBPS
+
+        inner = getattr(cluster, "inner", cluster)
+        if not hasattr(inner, "pod_chips") or not hasattr(inner, "spec"):
+            raise ValueError(
+                "the shared-fabric model needs a TpuCluster (per-pod DCN "
+                f"uplinks); got {type(inner).__name__}"
+            )
+        hosts = max(1, math.ceil(inner.pod_chips / inner.spec["chips_per_host"]))
+        return cls(
+            num_pods=inner.num_pods,
+            hosts_per_pod=hosts,
+            dcn_gbps=DCN_GBPS,
+            oversubscription=oversubscription,
+        )
+
+    def path(self, pods: Iterable[int]) -> Tuple[Tuple[str, float], ...]:
+        """The weighted link set a ``pods``-spanning flow loads, as
+        ``(link, weight)`` pairs: weight 1 on each pod's uplink (the flow
+        rate is the per-uplink injection rate) and weight ``m`` on the
+        core — all ``m`` pods' injections cross the aggregation layer, so
+        a flow at rate ``r`` consumes ``m * r`` of core capacity."""
+        pods = sorted(set(pods))
+        for p in pods:
+            if not 0 <= p < self.num_pods:
+                raise ValueError(f"pod {p} out of range [0, {self.num_pods})")
+        return tuple((uplink(p), 1.0) for p in pods) + ((CORE, float(len(pods))),)
+
+    def __repr__(self) -> str:
+        return (
+            f"FabricTopology(pods={self.num_pods}, "
+            f"uplink={self.uplink_gbps:g} Gbps, core={self.core_gbps:g} Gbps)"
+        )

@@ -1,0 +1,356 @@
+"""The shared-fabric contention model: dynamic multislice speed factors.
+
+This is the piece that turns :class:`~gpuschedule_tpu.cluster.tpu.
+TpuCluster`'s *static* per-allocation ``speed_factor`` into a **dynamic**
+one.  The static model assumes every DCN-spanning gang owns the whole
+fabric; here, whenever the engine's running set changes (start / done /
+preempt / migrate / revoke) or a link degrades (``("link", pod)`` faults),
+:meth:`NetModel.recompute` re-derives every multislice job's effective
+bandwidth by max-min fair sharing over the fabric graph and re-prices its
+``locality_factor`` with the same analytic allreduce term the static
+model uses — just fed the job's *actual* share instead of the nominal
+:data:`~gpuschedule_tpu.cluster.tpu.DCN_GBPS`.
+
+Demands, from the existing :mod:`gpuschedule_tpu.profiler.ici` model:
+
+- each running **multislice** job contributes one elastic flow over the
+  uplinks of the pods it spans plus the aggregation core (weighted by its
+  pod count — see :meth:`FabricTopology.path`).  Its offered demand is
+  one full uplink (``hosts_per_pod x dcn_gbps``): with every host NIC
+  saturated the per-host share is the nominal ``DCN_GBPS``, which is
+  exactly what the static model assumed — so an uncontended job on a
+  non-blocking core reproduces the static factor bit-for-bit;
+- each running job (any size) contributes **inelastic ingest** of
+  ``ingest_gbps_per_chip`` per occupied chip on its pod's uplink — the
+  input-pipeline traffic that makes residual-bandwidth placement scoring
+  meaningful.  Ingest is subtracted from link capacity before the elastic
+  flows are filled (it does not slow the ingesting job; docs/network.md
+  records that omission).
+
+The resulting per-host bandwidth ``share / hosts_per_pod`` feeds
+``cross_pod_allreduce_seconds(..., dcn_gbps=share_per_host)`` and the
+familiar ``t / (t + t_dcn)`` factor.  A fully degraded uplink gives a
+factor of 0.0: the job *stalls* (holding its chips) until the link is
+repaired — slowed, never killed.
+
+Deterministic, pure Python, jax-free (sim-core rule).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from gpuschedule_tpu.net.fabric import CORE, FabricTopology, uplink
+from gpuschedule_tpu.net.maxmin import Flow, maxmin_allocate
+
+
+@dataclass
+class NetConfig:
+    """Knobs of the shared-fabric model.
+
+    ``oversubscription`` is the core:uplink capacity ratio (1.0 =
+    non-blocking, no contention between disjoint-pod jobs; 4.0 = the
+    textbook 4:1 datacenter fabric).  ``ingest_gbps_per_chip`` is the
+    inelastic input-pipeline draw per occupied chip (0 disables the
+    ingest term entirely)."""
+
+    oversubscription: float = 4.0
+    ingest_gbps_per_chip: float = 0.05
+
+
+_SPEC_KEYS = {
+    "os": "oversubscription",
+    "oversubscription": "oversubscription",
+    "ingest": "ingest_gbps_per_chip",
+}
+
+
+def parse_net_spec(spec: str) -> NetConfig:
+    """Parse the CLI's ``--net k=v,...`` spec.  Keys: ``os`` /
+    ``oversubscription`` (core oversubscription ratio), ``ingest``
+    (Gbps per occupied chip)."""
+    config = NetConfig()
+    for pair in spec.split(","):
+        pair = pair.strip()
+        if not pair:
+            continue
+        key, sep, raw = pair.partition("=")
+        key = key.strip().replace("-", "_")
+        if not sep or key not in _SPEC_KEYS:
+            raise ValueError(
+                f"bad --net entry {pair!r}; known keys: {sorted(set(_SPEC_KEYS))}"
+            )
+        setattr(config, _SPEC_KEYS[key], float(raw))
+    # range-check here, not deep inside FabricTopology at Simulator
+    # construction: a bad spec must be a clean CLI error, not a traceback
+    if not config.oversubscription > 0:
+        raise ValueError(
+            f"--net oversubscription must be > 0, got {config.oversubscription}"
+        )
+    if config.ingest_gbps_per_chip < 0:
+        raise ValueError(
+            f"--net ingest must be >= 0, got {config.ingest_gbps_per_chip}"
+        )
+    return config
+
+
+@dataclass(frozen=True)
+class JobShare:
+    """One multislice job's allocation in the latest recompute."""
+
+    gbps: float           # per-uplink injection rate granted (max-min fair)
+    demand_gbps: float    # offered demand (one full uplink)
+    factor: float         # the dynamic locality factor at this share
+    pods: Tuple[int, ...]
+
+
+@dataclass(frozen=True)
+class LinkSample:
+    """One link's load in the latest recompute (capacity is post-degrade)."""
+
+    used_gbps: float
+    capacity_gbps: float
+
+    @property
+    def util(self) -> float:
+        if self.capacity_gbps <= 0.0:
+            return 1.0 if self.used_gbps > 0.0 else 0.0
+        return self.used_gbps / self.capacity_gbps
+
+
+@dataclass
+class NetState:
+    """What one :meth:`NetModel.recompute` derived."""
+
+    shares: Dict[str, JobShare] = field(default_factory=dict)
+    links: Dict[str, LinkSample] = field(default_factory=dict)
+
+
+class NetModel:
+    """Engine-facing contention model over one fleet's shared fabric.
+
+    The engine calls :meth:`attach` once, :meth:`recompute` after every
+    event batch that may have changed the running set, and
+    :meth:`degrade_link` / :meth:`repair_link` from ``("link", pod)``
+    fault records.  Placement (the ``contention`` scheme) reads
+    :meth:`residual_gbps` between recomputes.
+    """
+
+    def __init__(self, config: Optional[NetConfig] = None):
+        self.config = config or NetConfig()
+        self.topology: Optional[FabricTopology] = None
+        self._cluster = None
+        # active uplink degradations: pod -> list of residual-capacity
+        # fractions (stacked outages multiply; repair pops one instance)
+        self._degraded: Dict[int, List[float]] = {}
+        # last recompute's elastic usage per link (residual_gbps reads it)
+        self._elastic_used: Dict[str, float] = {}
+        self.recomputes = 0
+        # time-weighted utilization integrals (tools/net_sweep.py and the
+        # compare-topology contention column read the means)
+        self._last_t: Optional[float] = None
+        self._last_util: Dict[str, float] = {}
+        self._util_area: Dict[str, float] = {}
+        self._horizon = 0.0
+
+    # ------------------------------------------------------------------ #
+
+    def attach(self, cluster) -> None:
+        """Bind to a (possibly placement-wrapped) TpuCluster; idempotent —
+        the engine and the CLI may both attach the same cluster."""
+        inner = getattr(cluster, "inner", cluster)
+        if self._cluster is inner:
+            return
+        self.topology = FabricTopology.from_cluster(
+            inner, oversubscription=self.config.oversubscription
+        )
+        self._cluster = inner
+        self._elastic_used = {}
+        self._degraded = {}
+
+    def _require_attached(self) -> FabricTopology:
+        if self.topology is None:
+            raise RuntimeError("NetModel.attach(cluster) must run first")
+        return self.topology
+
+    # ------------------------------------------------------------------ #
+    # link health (the ("link", pod) fault scope, faults/)
+
+    def degrade_link(self, pod: int, residual_frac: float) -> None:
+        """One DCN-uplink outage: pod ``pod``'s uplink drops to
+        ``residual_frac`` of its current capacity (0.0 = hard outage).
+        Outages stack multiplicatively until each is repaired."""
+        topo = self._require_attached()
+        if not 0 <= pod < topo.num_pods:
+            raise ValueError(f"link fault pod {pod} out of range")
+        self._degraded.setdefault(pod, []).append(
+            min(1.0, max(0.0, float(residual_frac)))
+        )
+
+    def repair_link(self, pod: int, residual_frac: float) -> None:
+        """Undo one :meth:`degrade_link` of the same severity."""
+        stack = self._degraded.get(pod)
+        frac = min(1.0, max(0.0, float(residual_frac)))
+        if not stack or frac not in stack:
+            raise ValueError(f"repair of healthy link pod{pod}")
+        stack.remove(frac)
+        if not stack:
+            del self._degraded[pod]
+
+    def _capacity(self, link: str) -> float:
+        """Current (post-degrade) capacity of one link."""
+        topo = self._require_attached()
+        cap = topo.links[link].capacity_gbps
+        if link != CORE:
+            pod = int(link.rsplit("pod", 1)[1])
+            for frac in self._degraded.get(pod, ()):
+                cap *= frac
+        return cap
+
+    # ------------------------------------------------------------------ #
+    # demands
+
+    def _multislice_pods(self, job) -> Optional[Tuple[int, ...]]:
+        """The pods a running job's allocation spans, or None when it is
+        not a DCN-spanning gang (single-pod slices produce no elastic
+        flow).  Overlay guests with their own multislice detail count —
+        they share the base's uplinks and must share its bandwidth."""
+        alloc = getattr(job, "allocation", None)
+        detail = getattr(alloc, "detail", None)
+        slices = getattr(detail, "slices", None)
+        if not slices:
+            return None
+        return tuple(sorted({s.pod for s in slices}))
+
+    def _demand_gbps(self) -> float:
+        """Offered demand of one multislice flow: one full uplink, i.e.
+        per-host nominal DCN_GBPS across all the pod's host NICs — the
+        bandwidth the static model silently assumed."""
+        topo = self._require_attached()
+        return topo.uplink_gbps
+
+    def _grad_bytes(self, job) -> float:
+        from gpuschedule_tpu.models.config import resolve_model_config
+        from gpuschedule_tpu.profiler.ici import dp_gradient_bytes
+
+        cfg = resolve_model_config(getattr(job, "model_name", None))
+        tp = max(1, int(getattr(job, "tp", 1) or 1))
+        return dp_gradient_bytes(cfg.param_count // tp)
+
+    def _factor(self, job, m: int, per_host_gbps: float) -> float:
+        """The dynamic locality factor: the static formula with the job's
+        actual per-host share in place of the nominal DCN_GBPS."""
+        from gpuschedule_tpu.profiler.ici import cross_pod_allreduce_seconds
+
+        t_step = float(getattr(self._cluster, "dcn_step_seconds", 1.0))
+        t_dcn = cross_pod_allreduce_seconds(
+            self._grad_bytes(job), m, dcn_gbps=per_host_gbps
+        )
+        if math.isinf(t_dcn):
+            return 0.0
+        return t_step / (t_step + t_dcn)
+
+    def _ingest_gbps(self, pod: int) -> float:
+        """Inelastic input-pipeline draw on one pod's uplink, clamped to
+        the link's (post-degrade) capacity."""
+        rate = self.config.ingest_gbps_per_chip
+        if rate <= 0.0 or self._cluster is None:
+            return 0.0
+        used = self._cluster.pod_used_chips(pod)
+        return min(used * rate, self._capacity(uplink(pod)))
+
+    # ------------------------------------------------------------------ #
+
+    def recompute(self, now: float, running_jobs: Iterable) -> NetState:
+        """Progressive-filling pass over the active flow set: derive every
+        running multislice job's max-min fair share, its dynamic locality
+        factor, and each link's load.  Deterministic — same running set,
+        occupancy, and link health give identical floats."""
+        topo = self._require_attached()
+        self._integrate(now)
+        self.recomputes += 1
+
+        demand = self._demand_gbps()
+        flows: List[Flow] = []
+        meta: Dict[str, Tuple[int, ...]] = {}
+        job_by_id: Dict[str, object] = {}
+        for job in running_jobs:
+            pods = self._multislice_pods(job)
+            if pods is None:
+                continue
+            flows.append(Flow(job.job_id, topo.path(pods), demand))
+            meta[job.job_id] = pods
+            job_by_id[job.job_id] = job
+
+        ingest = {p: self._ingest_gbps(p) for p in range(topo.num_pods)}
+        capacity: Dict[str, float] = {}
+        for name in topo.links:
+            cap = self._capacity(name)
+            if name == CORE:
+                capacity[name] = max(0.0, cap - sum(ingest.values()))
+            else:
+                pod = int(name.rsplit("pod", 1)[1])
+                capacity[name] = max(0.0, cap - ingest[pod])
+        rates = maxmin_allocate(flows, capacity)
+
+        state = NetState()
+        elastic: Dict[str, float] = {name: 0.0 for name in topo.links}
+        for flow in flows:
+            r = rates[flow.key]
+            pods = meta[flow.key]
+            for link, w in flow.links:
+                elastic[link] += w * r
+            per_host = r / topo.hosts_per_pod
+            job = job_by_id[flow.key]
+            state.shares[flow.key] = JobShare(
+                gbps=r,
+                demand_gbps=demand,
+                factor=self._factor(job, len(pods), per_host),
+                pods=pods,
+            )
+        for name in sorted(topo.links):
+            cap = self._capacity(name)
+            if name == CORE:
+                used = sum(ingest.values()) + elastic[name]
+            else:
+                pod = int(name.rsplit("pod", 1)[1])
+                used = ingest[pod] + elastic[name]
+            state.links[name] = LinkSample(used_gbps=used, capacity_gbps=cap)
+        self._elastic_used = elastic
+        self._last_util = {n: s.util for n, s in state.links.items()}
+        return state
+
+    def residual_gbps(self, pod: int) -> float:
+        """Unallocated uplink bandwidth on pod ``pod`` right now: the
+        (post-degrade) capacity minus live ingest minus the elastic load
+        the last recompute granted — the contention placement scheme's
+        scoring signal."""
+        cap = self._capacity(uplink(pod))
+        used = self._ingest_gbps(pod) + self._elastic_used.get(uplink(pod), 0.0)
+        return max(0.0, cap - used)
+
+    # ------------------------------------------------------------------ #
+    # time-weighted link utilization (sweep / compare-topology reporting)
+
+    def _integrate(self, now: float) -> None:
+        if self._last_t is not None and now > self._last_t:
+            dt = now - self._last_t
+            self._horizon += dt
+            for name, util in self._last_util.items():
+                self._util_area[name] = self._util_area.get(name, 0.0) + util * dt
+        self._last_t = now
+
+    def close(self, now: float) -> None:
+        """Close the utilization integrals at the end of a run."""
+        self._integrate(now)
+
+    def mean_utilization(self) -> Dict[str, float]:
+        """Time-weighted mean utilization per link over the replay."""
+        if self._horizon <= 0.0:
+            return {}
+        return {
+            name: area / self._horizon
+            for name, area in sorted(self._util_area.items())
+        }

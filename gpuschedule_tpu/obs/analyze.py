@@ -143,6 +143,12 @@ class JobRecord:
     migrations: int = 0
     rebinds: int = 0
     faults: int = 0
+    # shared-fabric contention (net/): how often this job's bandwidth was
+    # re-priced, its time-integrated allocated bandwidth (Gbps x s while
+    # running), and its offered demand — the bandwidth-share table inputs
+    net_updates: int = 0
+    bw_gbps_s: float = 0.0
+    demand_gbps: Optional[float] = None
     run_time: float = 0.0         # seconds spent RUNNING
     queue_time: float = 0.0       # seconds QUEUED after submit (incl. requeues)
     suspended_time: float = 0.0   # seconds SUSPENDED (preempted with resume intent)
@@ -169,6 +175,13 @@ class JobRecord:
             return None
         return j / max(self.duration, 1e-9)
 
+    def mean_bw_gbps(self) -> Optional[float]:
+        """Time-weighted mean allocated DCN bandwidth while running (None
+        for jobs the contention model never priced)."""
+        if not self.net_updates or self.run_time <= 0.0:
+            return None
+        return self.bw_gbps_s / self.run_time
+
     @property
     def finished(self) -> bool:
         return self.end_state in ("done", "failed", "killed")
@@ -186,6 +199,9 @@ class JobRecord:
             "lost_service": self.lost_service,
             "overhead_service": self.overhead_service,
             "lost_work": self.lost_work,
+            "net_updates": self.net_updates,
+            "mean_bw_gbps": self.mean_bw_gbps(),
+            "demand_gbps": self.demand_gbps,
         }
 
 
@@ -201,6 +217,8 @@ class _Active:
     locality: float = 1.0
     overhead_left: float = 0.0
     t_prog: float = 0.0        # time of the last adopted snapshot
+    bw_gbps: float = 0.0       # current net/ bandwidth allocation
+    t_bw: float = 0.0          # time the current allocation was set
 
 
 def _stat_block(values: Sequence[float]) -> dict:
@@ -234,6 +252,12 @@ class RunAnalysis:
     mean_fragmentation: Optional[float] = None  # time-weighted free/total while demand waits
     mean_pending: float = 0.0                   # time-weighted queue length
     max_progress_drift: float = 0.0             # analyzer-vs-engine integration check
+    # shared-fabric telemetry (net/): per-link load series reconstructed
+    # from "netlink" events — (t, used_gbps, capacity_gbps) change points —
+    # and the exact time-weighted mean utilization per link
+    net_links: Dict[str, List[Tuple[float, float, float]]] = field(
+        default_factory=dict)
+    net_link_means: Dict[str, float] = field(default_factory=dict)
     # memoized derived views (report/compare each read them several times;
     # at Philly scale recomputing means redundant full scans and sorts)
     _goodput_cache: Optional[Dict[str, float]] = field(
@@ -294,6 +318,39 @@ class RunAnalysis:
             "closure_residual": kinds_lost - gp["lost_chip_s"],
         }
 
+    def network(self) -> dict:
+        """The network panel's data: per-link utilization series/means and
+        the per-job bandwidth-share table (jobs the contention model
+        priced at least once).  Empty links + jobs means the run had no
+        net model (or no multislice job ever ran)."""
+        jobs = []
+        for r in self.jobs:
+            if not r.net_updates:
+                continue
+            mean_bw = r.mean_bw_gbps()
+            jobs.append({
+                "job_id": r.job_id,
+                "chips": r.chips,
+                "net_updates": r.net_updates,
+                "mean_bw_gbps": mean_bw,
+                "demand_gbps": r.demand_gbps,
+                "mean_share": (
+                    mean_bw / r.demand_gbps
+                    if mean_bw is not None and r.demand_gbps else None
+                ),
+            })
+        return {
+            "links": {
+                name: {
+                    "mean_util": self.net_link_means.get(name),
+                    "samples": len(series),
+                    "last_capacity_gbps": series[-1][2] if series else None,
+                }
+                for name, series in sorted(self.net_links.items())
+            },
+            "jobs": jobs,
+        }
+
     def summary(self) -> Dict[str, object]:
         """Headline scalars (the compare surface).  avg_jct and makespan
         use SimResult's exact formulas so the two cross-check bit-for-bit."""
@@ -332,6 +389,7 @@ class RunAnalysis:
             "faults": self.counts.get("fault", 0),
             "revocations": self.counts.get("revoke", 0),
             "repairs": self.counts.get("repair", 0),
+            "net_reprices": self.counts.get("net", 0),
             "useful_frac": useful_frac,
             **{f"goodput_{k}": v for k, v in gp.items()},
         }
@@ -345,6 +403,7 @@ class RunAnalysis:
             "distributions": self.distributions(),
             "faults": self.fault_attribution(),
             "fault_timeline": list(self.fault_timeline),
+            "network": self.network(),
             "max_progress_drift": self.max_progress_drift,
             "jobs": [r.to_json() for r in self.jobs],
         }
@@ -364,6 +423,7 @@ _LEGAL_FROM = {
     "revoke": (RUNNING,),
     "finish": (RUNNING,),
     "cutoff": (RUNNING,),
+    "net": (RUNNING,),
 }
 
 
@@ -392,6 +452,10 @@ def analyze_events(
     fault_timeline: List[dict] = []
     util_series: List[Tuple[float, int, int, int]] = []
     stride, sample_i = 1, 0
+    # net/ link telemetry: change-point series per link plus an exact
+    # piecewise-constant utilization integral ([last_t, last_util, area])
+    net_links: Dict[str, List[Tuple[float, float, float]]] = {}
+    net_acc: Dict[str, List[float]] = {}
 
     used = running_n = pending_n = 0
     last_t: Optional[float] = None
@@ -456,6 +520,13 @@ def analyze_events(
             a.rec.queue_time += dt
         else:
             a.rec.suspended_time += dt
+
+    def settle_bw(a: _Active, t: float) -> None:
+        """Integrate the job's current bandwidth allocation up to ``t``
+        (piecewise-constant between net events, exact)."""
+        if a.bw_gbps > 0.0 and t > a.t_bw:
+            a.rec.bw_gbps_s += a.bw_gbps * (t - a.t_bw)
+        a.t_bw = t
 
     def sample(t: float) -> None:
         """Integrate occupancy/fragmentation/pending exactly (piecewise-
@@ -539,6 +610,28 @@ def analyze_events(
             continue
         if kind == "repair":
             continue
+        if kind == "netlink":
+            name = str(ev.get("link", "?"))
+            util = float(ev.get("util", 0.0))
+            acc = net_acc.get(name)
+            if acc is None:
+                net_acc[name] = [t, util, 0.0, t]  # last_t, last_util, area, first_t
+            else:
+                acc[2] += acc[1] * (t - acc[0])
+                acc[0], acc[1] = t, util
+            series = net_links.setdefault(name, [])
+            series.append((
+                t, float(ev.get("used_gbps", 0.0)),
+                float(ev.get("capacity_gbps", 0.0)),
+            ))
+            if len(series) > max_util_samples:
+                # decimate but always keep the newest sample — the report
+                # reads the link's current capacity off series[-1]
+                last = series[-1]
+                del series[::2]
+                if series[-1] != last:
+                    series.append(last)
+            continue
 
         # ---- per-job transitions ------------------------------------- #
         a = active.get(ev.get("job"))
@@ -575,6 +668,8 @@ def analyze_events(
         elif kind == "preempt":
             leave_state(a, t)
             adopt_snapshot(a, ev, t)
+            settle_bw(a, t)
+            a.bw_gbps = 0.0
             a.rec.preempts += 1
             used -= a.chips_alloc
             running_n -= 1
@@ -590,8 +685,22 @@ def analyze_events(
         elif kind == "speed":
             adopt_snapshot(a, ev, t)
             a.speed = float(ev.get("speed", a.speed))
+        elif kind == "net":
+            # contention re-price (net/): progress up to t accrued at the
+            # OLD locality (adopt first), the new factor applies onward
+            adopt_snapshot(a, ev, t)
+            settle_bw(a, t)
+            a.locality = float(ev.get("locality", a.locality))
+            a.bw_gbps = float(ev.get("bw_gbps", 0.0))
+            a.rec.net_updates += 1
+            if ev.get("demand_gbps") is not None:
+                a.rec.demand_gbps = float(ev["demand_gbps"])
         elif kind in ("migrate", "resize", "rebind"):
             adopt_snapshot(a, ev, t)
+            # close the bandwidth integral at the placement boundary; the
+            # engine emits a follow-up "net" event (possibly bw=0) when
+            # the move changed the job's flow-set membership or share
+            settle_bw(a, t)
             if kind == "migrate":
                 a.rec.migrations += 1
             elif kind == "rebind":
@@ -606,6 +715,8 @@ def analyze_events(
             prev_lost = a.rec.lost_service
             leave_state(a, t)
             adopt_snapshot(a, ev, t, rollback=float(ev.get("lost_work", 0.0)))
+            settle_bw(a, t)
+            a.bw_gbps = 0.0
             a.rec.faults += 1
             row = kind_row(str(ev.get("fault", "?")))
             row["revocations"] += 1
@@ -622,6 +733,7 @@ def analyze_events(
         elif kind == "finish":
             leave_state(a, t)
             adopt_snapshot(a, ev, t)
+            settle_bw(a, t)
             a.rec.end_t = t
             a.rec.end_state = str(ev.get("end_state", "done"))
             used -= a.chips_alloc
@@ -633,6 +745,7 @@ def analyze_events(
             # job stays unfinished (end_state None) like its jobs.csv row
             leave_state(a, t)
             adopt_snapshot(a, ev, t)
+            settle_bw(a, t)
             a.t_state = t
 
     if header is None and require_header:
@@ -642,6 +755,11 @@ def analyze_events(
             "analyze (pass require_header=False to accept bare streams)"
         )
     sample(end_t)  # close the last integration interval
+    net_link_means: Dict[str, float] = {}
+    for name, (last_t_l, util, area, first_t) in sorted(net_acc.items()):
+        area += util * (end_t - last_t_l)  # hold the last value to the end
+        span = end_t - first_t
+        net_link_means[name] = area / span if span > 0 else util
 
     analysis = RunAnalysis(
         header=header,
@@ -656,6 +774,8 @@ def analyze_events(
         mean_fragmentation=frag_area / horizon if horizon > 0 and header and header.total_chips else None,
         mean_pending=pend_area / horizon if horizon > 0 else 0.0,
         max_progress_drift=max_drift,
+        net_links=net_links,
+        net_link_means=net_link_means,
     )
     return analysis
 

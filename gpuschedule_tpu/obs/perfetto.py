@@ -21,6 +21,10 @@ timeline" half of the observability layer (ISSUE 1 tentpole):
   "health" process with a fault/repair instant pair and an "unhealthy"
   interval spanning the outage (overlapping outages on one scope nest
   FIFO; unrepaired ones extend to the horizon);
+- **net tracks** (net/): each fabric link gets a thread under the "net"
+  process with one utilization slice per constant-load interval (named
+  by percentage); contention re-prices land as "net" instants on the
+  affected job's occupancy track;
 - scheduling-rationale payloads (the policies' ``why`` records) ride along
   in each slice's ``args``, so clicking an interval answers *which rule put
   this job here*.
@@ -120,6 +124,8 @@ def trace_events(events: Iterable[dict]) -> List[dict]:
     timed: List[dict] = []
     # job -> (track, start_ts_us, args) for the open occupancy interval
     open_iv: Dict[str, Tuple[str, float, dict]] = {}
+    # net/ link -> (start_ts_us, args) for the open utilization slice
+    open_net: Dict[str, Tuple[float, dict]] = {}
     # fault scope label -> open outages as (start_ts_us, args) entries.
     # Engine-emitted events carry a per-record "fid" so a repair closes ITS
     # outage even when outages of different durations overlap on one scope;
@@ -146,6 +152,21 @@ def trace_events(events: Iterable[dict]) -> List[dict]:
         timed.append({
             "name": name, "cat": "transition", "ph": "i", "s": "t",
             "ts": t_us, "pid": pid, "tid": tid, "args": args,
+        })
+
+    def close_net(track: str, t_us: float) -> None:
+        """Close one link's open utilization slice (net/ tracks: one
+        slice per constant-utilization interval, named by percentage)."""
+        iv = open_net.pop(track, None)
+        if iv is None:
+            return
+        t0, args = iv
+        pid, tid = ids.ids(track)
+        timed.append({
+            "name": f"{100.0 * float(args.get('util', 0.0)):.0f}%",
+            "cat": "net", "ph": "X",
+            "ts": t0, "dur": max(0.0, t_us - t0),
+            "pid": pid, "tid": tid, "args": args,
         })
 
     for ev in events:
@@ -203,12 +224,24 @@ def trace_events(events: Iterable[dict]) -> List[dict]:
                         "ts": h0, "dur": max(0.0, t_us - h0),
                         "pid": pid, "tid": tid, "args": args,
                     })
+        elif kind == "net":
+            # contention re-price: instant on the job's occupancy track
+            iv = open_iv.get(job)
+            instant("net", iv[0] if iv else f"job/{job}", t_us, extra)
+        elif kind == "netlink":
+            # per-link utilization slices: one thread per fabric link
+            # under the "net" process, a slice per constant-load interval
+            track = f"net/{ev.get('link', '?')}"
+            close_net(track, t_us)
+            open_net[track] = (t_us, extra)
         # arrival / speed / rationale-only events carry no timeline geometry
 
     # horizon cutoff: unfinished occupancies and unrepaired outages extend
     # to the last seen time
     for job in list(open_iv):
         close(job, t_last, "horizon")
+    for track in list(open_net):
+        close_net(track, t_last)
     for label, stack in open_health.items():
         pid, tid = ids.ids(f"health/{label}")
         for h0, args in stack:

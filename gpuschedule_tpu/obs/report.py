@@ -224,6 +224,56 @@ def _step_series_chart(
     return "".join(parts)
 
 
+_SERIES_VARS = ("--series-1", "--series-2", "--series-3", "--series-4",
+                "--series-5")
+
+
+def _multi_step_chart(
+    series: List[Tuple[str, List[Tuple[float, float]]]],
+    *,
+    label: str,
+    t_max: float,
+    v_max: float = 1.0,
+    y_fmt=_fmt_pct,
+) -> str:
+    """Several step-after series on one axis (the network panel's link-
+    utilization view).  Identity is never color-alone: each line ends in
+    a direct label and carries a native-tooltip ``<title>``."""
+    series = [(n, pts) for n, pts in series if pts]
+    if not series:
+        return '<p class="empty">no samples</p>'
+    unit_div, unit = _time_axis(t_max)
+    parts = ['<svg viewBox="0 0 %d %d" role="img" aria-label="%s">'
+             % (_W, _H, _esc(label))]
+    parts += _grid_and_axes(t_max, v_max, unit_div, unit, y_fmt=y_fmt)
+    for i, (name, pts) in enumerate(series):
+        var = _SERIES_VARS[i % len(_SERIES_VARS)]
+        pts = _decimate(pts)
+        path = []
+        for j, (t, v) in enumerate(pts):
+            x, y = _xy(t, min(v, v_max), t_max, v_max)
+            if j == 0:
+                path.append(f"M{x:.1f},{y:.1f}")
+            else:
+                _, py = _xy(pts[j - 1][0], min(pts[j - 1][1], v_max), t_max, v_max)
+                path.append(f"L{x:.1f},{py:.1f} L{x:.1f},{y:.1f}")
+        d = " ".join(path)
+        parts.append(
+            f'<path d="{d}" fill="none" stroke="var({var})" '
+            f'stroke-width="2" stroke-linejoin="round" stroke-linecap="round">'
+            f"<title>{_esc(name)}</title></path>"
+        )
+        ex, ey = _xy(pts[-1][0], min(pts[-1][1], v_max), t_max, v_max)
+        parts.append(
+            f'<circle cx="{ex:.1f}" cy="{ey:.1f}" r="3.5" fill="var({var})" '
+            f'stroke="var(--surface-1)" stroke-width="2"/>'
+            f'<text class="dlabel" x="{min(ex + 6, _W - 90):.1f}" '
+            f'y="{ey - 5:.1f}">{_esc(name)}</text>'
+        )
+    parts.append("</svg>")
+    return "".join(parts)
+
+
 def _cdf_points(values: Sequence[float]) -> List[Tuple[float, float]]:
     s = sorted(values)
     n = len(s)
@@ -342,6 +392,7 @@ body {
   --text-primary: #0b0b0b; --text-secondary: #52514e; --muted: #898781;
   --grid: #e1e0d9; --baseline: #c3c2b7;
   --series-1: #2a78d6; --series-2: #eb6834; --series-3: #1baf7a;
+  --series-4: #9556c7; --series-5: #c23f87;
   --border: rgba(11,11,11,0.10);
 }
 @media (prefers-color-scheme: dark) {
@@ -350,6 +401,7 @@ body {
     --text-primary: #ffffff; --text-secondary: #c3c2b7; --muted: #898781;
     --grid: #2c2c2a; --baseline: #383835;
     --series-1: #3987e5; --series-2: #d95926; --series-3: #199e70;
+    --series-4: #a365d6; --series-5: #d052a0;
     --border: rgba(255,255,255,0.10);
   }
 }
@@ -358,6 +410,7 @@ body {
   --text-primary: #ffffff; --text-secondary: #c3c2b7; --muted: #898781;
   --grid: #2c2c2a; --baseline: #383835;
   --series-1: #3987e5; --series-2: #d95926; --series-3: #199e70;
+  --series-4: #a365d6; --series-5: #d052a0;
   --border: rgba(255,255,255,0.10);
 }
 body { background: var(--page); }
@@ -446,6 +499,43 @@ def _fault_kind_table(attribution: dict) -> str:
     )
 
 
+def _net_jobs_table(net: dict) -> str:
+    rows = []
+    for j in net["jobs"]:
+        share = j["mean_share"]
+        rows.append(
+            f"<tr><td>{_esc(j['job_id'])}</td><td>{j['chips']}</td>"
+            f"<td>{_esc(_fmt_num(j['mean_bw_gbps']))}</td>"
+            f"<td>{_esc(_fmt_num(j['demand_gbps']))}</td>"
+            f"<td>{_esc(_fmt_pct(share))}</td>"
+            f"<td>{j['net_updates']}</td></tr>"
+        )
+    if not rows:
+        return '<p class="empty">no multislice job was priced</p>'
+    return (
+        "<table><thead><tr><th>job</th><th>chips</th>"
+        "<th>mean bw (Gbps)</th><th>demand (Gbps)</th><th>mean share</th>"
+        "<th>re-prices</th></tr></thead>"
+        f"<tbody>{''.join(rows)}</tbody></table>"
+    )
+
+
+def _net_links_table(analysis: RunAnalysis, net: dict) -> str:
+    rows = []
+    for name, info in net["links"].items():
+        rows.append(
+            f"<tr><td>{_esc(name)}</td>"
+            f"<td>{_esc(_fmt_pct(info['mean_util']))}</td>"
+            f"<td>{_esc(_fmt_num(info['last_capacity_gbps']))}</td>"
+            f"<td>{info['samples']}</td></tr>"
+        )
+    return (
+        "<table><thead><tr><th>link</th><th>mean util</th>"
+        "<th>capacity (Gbps)</th><th>changes</th></tr></thead>"
+        f"<tbody>{''.join(rows)}</tbody></table>"
+    )
+
+
 def _slowest_jobs_table(analysis: RunAnalysis, n: int = 10) -> str:
     fin = [r for r in analysis.jobs if r.finished and r.jct() is not None]
     worst = sorted(fin, key=lambda r: r.jct(), reverse=True)[:n]
@@ -514,6 +604,37 @@ def render_report(analysis: RunAnalysis, *, title: Optional[str] = None) -> str:
               f"{_fmt_num(gp['total_chip_s'])} chip-s total"),
     ]
 
+    net = analysis.network()
+    net_panel = ""
+    if analysis.net_links:
+        max_links = 6  # core + 5 busiest uplinks; the table lists them all
+        by_load = sorted(
+            analysis.net_links,
+            key=lambda n: (n != "core", -(analysis.net_link_means.get(n) or 0.0), n),
+        )
+        link_series = [
+            # same 0/0 rule as LinkSample.util: a dead link carrying no
+            # traffic is 0% (idle), not 100% — the table agrees
+            (name, [(t, (u / c) if c > 0 else (1.0 if u > 0 else 0.0))
+                    for t, u, c in analysis.net_links[name]])
+            for name in by_load[:max_links]
+        ]
+        dropped = len(analysis.net_links) - len(link_series)
+        drop_note = (
+            f'<p class="meta">{dropped} more uplinks in the table below</p>'
+            if dropped > 0 else ""
+        )
+        net_panel = f"""
+<h2>Network</h2>
+<div class="panel">
+  <p class="meta">{s['net_reprices']} bandwidth re-prices ·
+  {len(net['jobs'])} multislice jobs priced</p>
+  {_multi_step_chart(link_series, label='link utilization', t_max=t_max)}
+  {drop_note}
+  {_net_links_table(analysis, net)}
+  {_net_jobs_table(net)}
+</div>"""
+
     fault_panel = ""
     if s["faults"] or s["revocations"] or gp["lost_chip_s"] > 0:
         fault_panel = f"""
@@ -565,6 +686,7 @@ def render_report(analysis: RunAnalysis, *, title: Optional[str] = None) -> str:
 {_cdf_chart([('wait', '--series-1', waits), ('JCT', '--series-2', jcts)],
             'wait and JCT CDF')}
 </div>
+{net_panel}
 {fault_panel}
 <h2>Distributions</h2>
 <div class="panel">{_dist_table(dists)}</div>

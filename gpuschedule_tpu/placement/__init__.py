@@ -12,9 +12,11 @@ One knob, two cluster flavors:
   toward the origin corner (the allocator default), ``random`` picks a
   random free origin (seeded, deterministic), ``spread`` packs toward the
   far corner — keeping the origin region clear for large slices.
+  ``contention`` (net/) searches pods by residual DCN uplink bandwidth
+  (``hint["pod_order"]``), steering gangs away from loaded uplinks.
 
-``with_placement(cluster, scheme, seed)`` is the single entry point the
-CLI and experiments use.
+``with_placement(cluster, scheme, seed, net=...)`` is the single entry
+point the CLI and experiments use.
 """
 
 from gpuschedule_tpu.placement.schemes import PlacedTpuCluster, with_placement

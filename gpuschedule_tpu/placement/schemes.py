@@ -9,7 +9,7 @@ from gpuschedule_tpu.cluster.gpu import SCHEMES as GPU_SCHEMES
 from gpuschedule_tpu.cluster.gpu import GpuCluster
 from gpuschedule_tpu.cluster.tpu import TpuCluster
 
-TPU_SCHEMES = ("consolidated", "random", "spread")
+TPU_SCHEMES = ("consolidated", "random", "spread", "contention")
 
 Origin = Tuple[int, ...]
 
@@ -20,13 +20,29 @@ class PlacedTpuCluster:
     Delegates everything else to the wrapped cluster, so it satisfies the
     ClusterBase surface (and OverlayMixin's) by forwarding.  Policy-supplied
     hints (overlay, shape, pod) always win over the scheme's origin order.
+
+    The ``contention`` scheme is network-aware: it searches pods in order
+    of residual DCN uplink bandwidth (highest first; see
+    :meth:`~gpuschedule_tpu.net.model.NetModel.residual_gbps`) before the
+    allocator's lexicographic origin scan, steering new gangs away from
+    uplinks already loaded with multislice allreduce or ingest traffic.
+    Without a :class:`~gpuschedule_tpu.net.model.NetModel` attached, every
+    pod scores equally and the scheme degrades to consolidated's pod-index
+    order — deterministic either way (no RNG involved).
     """
 
-    def __init__(self, cluster: TpuCluster, scheme: str = "consolidated", seed: int = 0):
+    def __init__(
+        self,
+        cluster: TpuCluster,
+        scheme: str = "consolidated",
+        seed: int = 0,
+        net=None,
+    ):
         if scheme not in TPU_SCHEMES:
             raise ValueError(f"unknown TPU scheme {scheme!r}; known: {TPU_SCHEMES}")
         self.inner = cluster
         self.scheme = scheme
+        self.net = net
         self._rng = random.Random(seed)
 
     def _origin_order(self, origins: List[Origin]) -> List[Origin]:
@@ -36,10 +52,23 @@ class PlacedTpuCluster:
             return picked
         if self.scheme == "spread":
             return sorted(origins, reverse=True)  # far corner first
-        return origins  # consolidated: allocator's lexicographic first-fit
+        return origins  # consolidated/contention: lexicographic first-fit
+
+    def _pod_order(self, pods: List[int]) -> List[int]:
+        """Contention scoring: most residual uplink bandwidth first, pod
+        index as the deterministic tie-break (ties are the rule when no
+        net model is attached or nothing is running)."""
+        if self.net is None:
+            return sorted(pods)
+        return sorted(pods, key=lambda p: (-self.net.residual_gbps(p), p))
 
     def allocate(self, num_chips: int, *, job=None, hint: Optional[dict] = None):
-        merged = {} if self.scheme == "consolidated" else {"origin_order": self._origin_order}
+        if self.scheme == "consolidated":
+            merged: dict = {}
+        elif self.scheme == "contention":
+            merged = {"pod_order": self._pod_order}
+        else:
+            merged = {"origin_order": self._origin_order}
         if hint:
             merged.update(hint)  # policy hints (overlay etc.) take precedence
         return self.inner.allocate(num_chips, job=job, hint=merged or None)
@@ -51,8 +80,11 @@ class PlacedTpuCluster:
         return f"PlacedTpuCluster({self.scheme}, {self.inner!r})"
 
 
-def with_placement(cluster, scheme: str, *, seed: int = 0):
-    """Attach a placement scheme to a cluster (flavor-appropriate)."""
+def with_placement(cluster, scheme: str, *, seed: int = 0, net=None):
+    """Attach a placement scheme to a cluster (flavor-appropriate).
+    ``net`` (a :class:`~gpuschedule_tpu.net.model.NetModel`) powers the
+    TPU ``contention`` scheme's residual-bandwidth scoring; other schemes
+    ignore it."""
     if isinstance(cluster, GpuCluster):
         if scheme not in GPU_SCHEMES:
             raise ValueError(f"unknown GPU scheme {scheme!r}; known: {GPU_SCHEMES}")
@@ -64,5 +96,5 @@ def with_placement(cluster, scheme: str, *, seed: int = 0):
     if isinstance(cluster, TpuCluster):
         if scheme == "consolidated":
             return cluster  # the allocator default; no wrapper needed
-        return PlacedTpuCluster(cluster, scheme, seed=seed)
+        return PlacedTpuCluster(cluster, scheme, seed=seed, net=net)
     raise TypeError(f"no placement schemes for cluster type {type(cluster).__name__}")

@@ -75,12 +75,23 @@ def dp_gradient_bytes(param_count: int, *, dtype_bytes: int = 4) -> float:
     return float(param_count) * dtype_bytes
 
 
-def cross_pod_allreduce_seconds(bytes_per_chip: float, num_pods: int) -> float:
+def cross_pod_allreduce_seconds(
+    bytes_per_chip: float, num_pods: int, *, dcn_gbps: float = DCN_GBPS
+) -> float:
     """DCN-tier allreduce across pods (slices never span pods; multi-pod
-    jobs sync over the datacenter network)."""
+    jobs sync over the datacenter network).
+
+    ``dcn_gbps`` is the per-host DCN bandwidth the ring actually gets: the
+    static planner passes the nominal :data:`DCN_GBPS`; the shared-fabric
+    contention model (net/) passes each job's max-min fair share, which is
+    how contention stretches this term dynamically.  ``dcn_gbps <= 0``
+    (a fully degraded uplink) returns ``inf`` — the sync never completes
+    until bandwidth comes back."""
     if num_pods <= 1:
         return 0.0
-    bw_bytes = DCN_GBPS / 8.0 * 1e9
+    if dcn_gbps <= 0.0:
+        return math.inf
+    bw_bytes = dcn_gbps / 8.0 * 1e9
     return 2.0 * (num_pods - 1) / num_pods * bytes_per_chip / bw_bytes + (
         num_pods - 1
     ) * 10 * LATENCY_S

@@ -73,9 +73,20 @@ class Simulator:
         max_time: float = float("inf"),
         eps: float = 1e-6,
         faults=None,
+        net=None,
     ):
         self.cluster = cluster
         self.policy = policy
+        # Shared-fabric contention (net/): a NetModel that re-prices every
+        # running multislice job's locality_factor by max-min fair
+        # bandwidth sharing whenever the running set or link health
+        # changes.  None (the default) is the static-factor path,
+        # bit-identical to the pre-net engine.
+        self.net = net
+        self._net_links: Dict[str, tuple] = {}  # last emitted link sample
+        self._net_priced: Dict[str, float] = {}  # job_id -> last emitted bw
+        if net is not None:
+            net.attach(cluster)
         # Fault injection (faults/): a FaultPlan whose records become
         # _FAULT events and whose RecoveryModel prices each revocation.
         # None (the default) is the fault-free path, bit-identical to the
@@ -403,11 +414,77 @@ class Simulator:
             )
 
     # ------------------------------------------------------------------ #
+    # shared-fabric contention (net/)
+
+    def _net_update(self) -> None:
+        """Re-price every running multislice job's dynamic locality factor
+        from its max-min fair bandwidth share (net/), after any event
+        batch that may have changed the running set or link health.
+
+        Factor changes ride the same re-predict machinery as the migrate/
+        resize in-place fallback: advance progress at the old rate, bind
+        the new factor, bump the epoch, reschedule the completion.  Each
+        change is emitted as a ``net`` event (with the exact progress
+        snapshot) and changed link loads as ``netlink`` events, so the
+        analyzer reconstructs bandwidth shares and link utilization from
+        the stream alone."""
+        state = self.net.recompute(self.now, self.running)
+        record = self.metrics.record_events
+        priced, self._net_priced = self._net_priced, {}
+        for job in self.running:
+            share = state.shares.get(job.job_id)
+            if share is None:
+                if priced.get(job.job_id):
+                    # still running but no longer a flow (an elastic
+                    # shrink/migration back inside one pod): close its
+                    # bandwidth in the stream, or the analyzer would
+                    # integrate the stale share for the rest of the run
+                    self.metrics.count("net_reprices")
+                    if record:
+                        self.metrics.event(
+                            "net", self.now, job,
+                            locality=job.locality_factor, bw_gbps=0.0,
+                            prog=_prog(job),
+                        )
+                continue
+            self._net_priced[job.job_id] = share.gbps
+            if (share.factor == job.locality_factor
+                    and priced.get(job.job_id) == share.gbps):
+                continue
+            if share.factor != job.locality_factor:
+                job.advance(self.now)
+                job.locality_factor = share.factor
+                job.epoch += 1
+                self._schedule_completion(job)
+            self.metrics.count("net_reprices")
+            if record:
+                self.metrics.event(
+                    "net", self.now, job, locality=share.factor,
+                    bw_gbps=share.gbps, demand_gbps=share.demand_gbps,
+                    prog=_prog(job),
+                )
+        if record:
+            for name, sample in state.links.items():
+                cur = (sample.used_gbps, sample.capacity_gbps)
+                if self._net_links.get(name) == cur:
+                    continue
+                self._net_links[name] = cur
+                self.metrics.event(
+                    "netlink", self.now, None, link=name,
+                    used_gbps=sample.used_gbps,
+                    capacity_gbps=sample.capacity_gbps, util=sample.util,
+                )
+        self.metrics.net_link_samples(state.links)
+
+    # ------------------------------------------------------------------ #
     # fault injection (faults/)
 
     def _apply_fault(self, rec) -> None:
         """One hardware outage: mark the scope unhealthy, revoke every
         running gang on it, schedule the repair, and let the policy react."""
+        if rec.scope and rec.scope[0] == "link":
+            self._apply_link_fault(rec)
+            return
         victim_ids = self.cluster.mark_unhealthy(rec.scope)
         self.metrics.count("faults")
         self.metrics.count(f"faults_{rec.kind}")
@@ -434,6 +511,32 @@ class Simulator:
         for job in victims:
             self._revoke(job, rec)
         self.policy.on_fault(self, rec, victims)
+
+    def _apply_link_fault(self, rec) -> None:
+        """A ``("link", pod)`` DCN-uplink outage — the first *partial
+        degradation* fault (ROADMAP PR-2 open item): nothing is revoked
+        and no chip goes unhealthy; the degraded uplink slows multislice
+        jobs through the contention model (the post-batch ``_net_update``
+        re-prices them).  Without a net model the outage is recorded but
+        cannot change any speed — counted as ``link_faults_inert`` so an
+        operator sees the fault spec asked for something the run cannot
+        express (run with ``--net``)."""
+        self.metrics.count("faults")
+        self.metrics.count(f"faults_{rec.kind}")
+        if self.metrics.record_events:
+            self.metrics.event(
+                "fault", self.now, None,
+                scope=rec.label, fault=rec.kind, fid=self._fault_ids[id(rec)],
+                degrade=rec.degrade,
+                duration=rec.duration if math.isfinite(rec.duration) else "inf",
+            )
+        if self.net is not None:
+            self.net.degrade_link(int(rec.scope[1]), rec.degrade)
+        else:
+            self.metrics.count("link_faults_inert")
+        if math.isfinite(rec.duration):
+            self._push(self.now + max(0.0, rec.duration), _REPAIR, rec)
+        self.policy.on_fault(self, rec, [])
 
     def _revoke(self, job: Job, rec) -> None:
         """Fault-revoke one running job: progress rolls back to its last
@@ -536,7 +639,14 @@ class Simulator:
                 self._apply_fault(payload)
                 dirty = True
             elif kind == _REPAIR:
-                self.cluster.repair(payload.scope)
+                if payload.scope and payload.scope[0] == "link":
+                    # uplink outages live in the net model, not the chip
+                    # health mask (nothing was marked unhealthy)
+                    if self.net is not None:
+                        self.net.repair_link(int(payload.scope[1]),
+                                             payload.degrade)
+                else:
+                    self.cluster.repair(payload.scope)
                 self.metrics.count("repairs")
                 if self.metrics.record_events:
                     self.metrics.event(
@@ -594,13 +704,25 @@ class Simulator:
         would loop forever for policies that always re-request a wakeup
         while jobs wait (Gandiva rounds).  Gated on _drain_faults: the
         fault-free path cannot strand jobs (unsatisfiable gangs are
-        rejected at admission) and keeps its exact pre-faults behavior."""
-        return (
-            self._drain_faults
-            and (
-                len(self.finished) == len(self.jobs)
-                or (self._nonticks == 0 and not self.running)
-            )
+        rejected at admission) and keeps its exact pre-faults behavior.
+
+        The net/ analogue of a stranded gang: a permanent hard link
+        outage (link_repair=inf, degrade=0) pins a multislice job's
+        dynamic locality factor at 0.0 — it runs forever at zero rate
+        and never schedules a completion.  With nothing pending and only
+        ticks left, no tick can revive it (the policy already ran after
+        the outage and every tick since; the dead uplink stays dead), so
+        the run quiesces instead of spinning through the tick chain."""
+        if not self._drain_faults:
+            return False
+        if len(self.finished) == len(self.jobs):
+            return True
+        if self._nonticks:
+            return False
+        if not self.running:
+            return True
+        return not self.pending and all(
+            j.remaining_runtime() == math.inf for j in self.running
         )
 
     def _run_plain(self) -> SimResult:
@@ -617,7 +739,11 @@ class Simulator:
                 wakeup = self.policy.schedule(self)
                 if wakeup is not None:
                     self.request_wakeup(wakeup)
+                if self.net is not None:
+                    self._net_update()
             self.metrics.sample(self.now, self.cluster, len(self.running), len(self.pending))
+        if self.net is not None:
+            self.net.close(self.now)
         return self.metrics.result(self.jobs, self.now)
 
     def _run_traced(self) -> SimResult:
@@ -651,10 +777,14 @@ class Simulator:
                             )
                         if wakeup is not None:
                             self.request_wakeup(wakeup)
+                        if self.net is not None:
+                            self._net_update()
                     sp.set(dirty=dirty).end_sim(self.now)
                 n_batches += 1
                 self.metrics.sample(
                     self.now, self.cluster, len(self.running), len(self.pending)
                 )
             run_sp.set(batches=n_batches).end_sim(self.now)
+        if self.net is not None:
+            self.net.close(self.now)
         return self.metrics.result(self.jobs, self.now)

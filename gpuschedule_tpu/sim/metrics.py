@@ -194,6 +194,11 @@ class MetricsLog:
                 "terminal job states (trace-declared Pass/Failed/Killed "
                 "plus admission rejections)",
                 labelnames=("state",))
+        # net/ link gauges are created lazily on the first sample: a run
+        # without the contention model must leave the registry (and its
+        # metrics.prom bytes) exactly as before the net layer existed
+        self._reg_net_util = None
+        self._reg_net_gbps = None
         self.util_samples: List[tuple] = []  # (t, used, total, running, pending)
         self.counters: Counter = Counter()
         self._all_jobs: Sequence[Job] = ()   # set by attach_jobs(); lets write()
@@ -357,6 +362,26 @@ class MetricsLog:
                 self.util_samples = self.util_samples[::2]
                 self._stride *= 2
         self._sample_calls += 1
+
+    def net_link_samples(self, links) -> None:
+        """Mirror the contention model's per-link load into labeled
+        registry gauges (net/ tentpole observability).  No-op without a
+        registry; gauges materialize on the first call so net-free runs
+        keep a byte-identical Prometheus exposition."""
+        if self._registry is None or not links:
+            return
+        if self._reg_net_util is None:
+            self._reg_net_util = self._registry.gauge(
+                "net_link_utilization",
+                "fraction of DCN link capacity in use (ingest + allreduce)",
+                labelnames=("link",))
+            self._reg_net_gbps = self._registry.gauge(
+                "net_link_used_gbps",
+                "DCN link load in Gbps (ingest + allreduce)",
+                labelnames=("link",))
+        for name, sample in links.items():
+            self._reg_net_util.labels(name).set(sample.util)
+            self._reg_net_gbps.labels(name).set(sample.used_gbps)
 
     def _flush_tail(self) -> None:
         """Ensure the final observed sample is stored: once decimation raises

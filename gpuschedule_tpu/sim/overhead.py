@@ -36,7 +36,7 @@ from __future__ import annotations
 import math
 
 from gpuschedule_tpu.cluster.tpu import DCN_GBPS, GENERATIONS
-from gpuschedule_tpu.models.config import MODEL_CONFIGS
+from gpuschedule_tpu.models.config import resolve_model_config
 
 # Framework teardown/setup floor (process restart, compile-cache hit, data
 # pipeline rewind) — the part of Gandiva's observed suspend/resume cost that
@@ -47,13 +47,12 @@ BYTES_PER_PARAM = 12  # f32 params + 2 Adam moments
 
 
 def ckpt_bytes(model_name: str) -> int:
-    """Persisted training-state size for a model (params + opt state)."""
-    cfg = MODEL_CONFIGS.get(model_name)
-    if cfg is None:
-        # Unknown model names (e.g. straight from a Philly trace) fall back
-        # to the zoo median so replay never crashes on workload names.
-        cfg = MODEL_CONFIGS["transformer-small"]
-    return BYTES_PER_PARAM * cfg.param_count
+    """Persisted training-state size for a model (params + opt state).
+
+    Unknown model names (e.g. straight from a Philly trace) resolve through
+    the shared zoo-median fallback (models/config.py), the same phantom
+    model that prices their DCN toll — one job, one consistent size."""
+    return BYTES_PER_PARAM * resolve_model_config(model_name).param_count
 
 
 def restore_seconds(

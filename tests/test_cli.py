@@ -97,8 +97,8 @@ def test_compare_topology_writes_report(tmp_path, capsys):
     summary = json.loads(out[-1])
     assert set(summary) == {
         "gpu-consolidated", "gpu-random-s0", "gpu-random-s1", "gpu-topology",
-        "tpu-v5p", "tpu-v5e", "tpu-v5p-2pod", "acceptance", "gpu-random-mean",
-        "dcn_vs_ici",
+        "tpu-v5p", "tpu-v5e", "tpu-v5p-2pod", "tpu-v5p-2pod-net",
+        "acceptance", "gpu-random-mean", "dcn_vs_ici", "contention",
     }
     acc = summary["acceptance"]
     assert set(acc) == {
@@ -108,6 +108,9 @@ def test_compare_topology_writes_report(tmp_path, capsys):
     # (it would only measure doubled capacity), with the count saying why
     assert summary["dcn_vs_ici"]["multislice_jobs"] == 0
     assert summary["dcn_vs_ici"]["jct_ratio_2pod_over_1pod"] is None
+    # same nulling rule for the net contention column on a whale-free trace
+    assert summary["contention"]["jct_ratio_net_over_static"] is None
+    assert "mean_link_utilization" in summary["contention"]
     assert summary["gpu-random-mean"]["seeds"] == 2
     assert (tmp_path / "summary.json").exists()
     assert json.loads((tmp_path / "summary.json").read_text())["acceptance"] == acc

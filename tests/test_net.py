@@ -1,0 +1,667 @@
+"""Shared-fabric contention model tests (net/, ISSUE 4).
+
+Covers the tentpole end to end — topology capacities, the max-min
+allocator's hand-computable fixed points, dynamic speed factors through
+the engine (the two-job acceptance scenario with re-equalization), link
+faults as partial degradation, the analyzer/report/Perfetto telemetry
+round trip — plus the regression guard: with no net model the engine,
+event stream, and analyzer are bit-identical to the pre-net paths.
+"""
+
+import json
+import math
+
+import pytest
+
+from gpuschedule_tpu.cluster.tpu import DCN_GBPS, TpuCluster
+from gpuschedule_tpu.faults import (
+    FaultConfig,
+    FaultPlan,
+    FaultRecord,
+    RecoveryModel,
+    generate_fault_schedule,
+    parse_fault_spec,
+)
+from gpuschedule_tpu.models.config import resolve_model_config
+from gpuschedule_tpu.net import (
+    CORE,
+    FabricTopology,
+    Flow,
+    NetConfig,
+    NetModel,
+    maxmin_allocate,
+    parse_net_spec,
+    uplink,
+)
+from gpuschedule_tpu.obs import analyze_events, render_report, trace_events
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.profiler.ici import (
+    cross_pod_allreduce_seconds,
+    dp_gradient_bytes,
+)
+from gpuschedule_tpu.sim import Job, Simulator
+from gpuschedule_tpu.sim.metrics import MetricsLog
+
+
+def _fleet(pods=4, dims=(4, 4)):
+    """v5e (4,4) pods: 16 chips, 2 hosts, 200 Gbps uplink each."""
+    return TpuCluster("v5e", dims=dims, num_pods=pods)
+
+
+def _whale(name, submit, duration, model="transformer-base", chips=32):
+    return Job(name, submit, num_chips=chips, duration=duration,
+               model_name=model)
+
+
+def _factor(model, m, per_host_gbps, t_step=1.0):
+    B = dp_gradient_bytes(resolve_model_config(model).param_count)
+    t_dcn = cross_pod_allreduce_seconds(B, m, dcn_gbps=per_host_gbps)
+    return t_step / (t_step + t_dcn)
+
+
+# --------------------------------------------------------------------- #
+# topology
+
+
+def test_fabric_capacities_from_generation_tables():
+    topo = FabricTopology.from_cluster(_fleet(), oversubscription=4.0)
+    # 16-chip v5e pod, 8 chips/host -> 2 hosts -> 2 x 100 Gbps uplink
+    assert topo.hosts_per_pod == 2
+    assert topo.uplink_gbps == 2 * DCN_GBPS
+    assert topo.core_gbps == 4 * topo.uplink_gbps / 4.0
+    assert set(topo.links) == {CORE, *(uplink(p) for p in range(4))}
+
+
+def test_fabric_path_weights_core_by_pod_count():
+    topo = FabricTopology.from_cluster(_fleet())
+    path = dict(topo.path([2, 0]))
+    assert path == {uplink(0): 1.0, uplink(2): 1.0, CORE: 2.0}
+    with pytest.raises(ValueError):
+        topo.path([9])
+
+
+def test_fabric_rejects_non_tpu_clusters():
+    from gpuschedule_tpu.cluster import SimpleCluster
+
+    with pytest.raises(ValueError, match="TpuCluster"):
+        FabricTopology.from_cluster(SimpleCluster(64))
+
+
+# --------------------------------------------------------------------- #
+# max-min allocator
+
+
+def test_maxmin_single_flow_demand_limited():
+    rates = maxmin_allocate(
+        [Flow("a", (("l", 1.0),), 5.0)], {"l": 10.0})
+    assert rates == {"a": 5.0}
+
+
+def test_maxmin_equal_split_on_shared_link():
+    rates = maxmin_allocate(
+        [Flow("a", (("l", 1.0),), 8.0), Flow("b", (("l", 1.0),), 8.0)],
+        {"l": 10.0})
+    assert rates == {"a": 5.0, "b": 5.0}
+
+
+def test_maxmin_small_demand_frees_headroom():
+    rates = maxmin_allocate(
+        [Flow("a", (("l", 1.0),), 3.0), Flow("b", (("l", 1.0),), 8.0)],
+        {"l": 10.0})
+    assert rates == {"a": 3.0, "b": 7.0}
+
+
+def test_maxmin_weighted_core():
+    # weight 2 on the core: a flow at rate r consumes 2r there
+    rates = maxmin_allocate(
+        [Flow("a", (("core", 2.0),), 100.0)], {"core": 10.0})
+    assert rates == {"a": 5.0}
+
+
+def test_maxmin_multi_bottleneck_waterfill():
+    # classic: a shares l1 with b, b shares l2 with c; l1 tight, l2 loose
+    flows = [
+        Flow("a", (("l1", 1.0),), 100.0),
+        Flow("b", (("l1", 1.0), ("l2", 1.0)), 100.0),
+        Flow("c", (("l2", 1.0),), 100.0),
+    ]
+    rates = maxmin_allocate(flows, {"l1": 10.0, "l2": 100.0})
+    assert rates["a"] == pytest.approx(5.0)
+    assert rates["b"] == pytest.approx(5.0)
+    assert rates["c"] == pytest.approx(95.0)
+
+
+def test_maxmin_dead_link_gives_zero():
+    rates = maxmin_allocate(
+        [Flow("a", (("l", 1.0),), 5.0)], {"l": 0.0})
+    assert rates == {"a": 0.0}
+
+
+def test_maxmin_order_independent_and_deterministic():
+    flows = [
+        Flow("b", (("l1", 1.0), ("core", 2.0)), 7.0),
+        Flow("a", (("l2", 1.0), ("core", 2.0)), 9.0),
+        Flow("c", (("l1", 1.0),), 4.0),
+    ]
+    caps = {"l1": 10.0, "l2": 10.0, "core": 20.0}
+    r1 = maxmin_allocate(flows, caps)
+    r2 = maxmin_allocate(list(reversed(flows)), caps)
+    assert r1 == r2
+
+
+def test_maxmin_rejects_unknown_link_and_dup_keys():
+    with pytest.raises(ValueError, match="unknown link"):
+        maxmin_allocate([Flow("a", (("nope", 1.0),), 1.0)], {"l": 1.0})
+    with pytest.raises(ValueError, match="duplicate"):
+        maxmin_allocate(
+            [Flow("a", (("l", 1.0),), 1.0), Flow("a", (("l", 1.0),), 1.0)],
+            {"l": 1.0})
+
+
+# --------------------------------------------------------------------- #
+# NetModel: static-factor consistency + contention
+
+
+def test_solo_job_on_nonblocking_core_matches_static_factor():
+    """os=1 and no ingest: an uncontended multislice job saturates its
+    own uplinks, per-host share == nominal DCN_GBPS, and the dynamic
+    factor equals the static model's bit for bit."""
+    c = _fleet(pods=2)
+    net = NetModel(NetConfig(oversubscription=1.0, ingest_gbps_per_chip=0.0))
+    job = _whale("w", 0.0, 100.0, model="transformer-tiny")
+    res = Simulator(c, make_policy("fifo"), [job], net=net).run()
+    static = c._multislice_speed_factor(
+        2, Job("p", 0.0, 32, 1.0, model_name="transformer-tiny"))
+    (j,) = res.jobs
+    assert j.locality_factor == static
+    assert j.end_time == pytest.approx(100.0 / static, rel=1e-9)
+
+
+def test_two_jobs_contend_then_reequalize():
+    """The acceptance scenario: two 2-pod jobs share the core max-min
+    fairly (hand-computed), and the survivor's share re-equalizes the
+    instant the first job finishes."""
+    c = _fleet(pods=4)
+    net = NetModel(NetConfig(oversubscription=4.0, ingest_gbps_per_chip=0.0))
+    a = _whale("a", 0.0, 100.0)
+    b = _whale("b", 0.0, 300.0)
+    ml = MetricsLog(record_events=True)
+    res = Simulator(c, make_policy("fifo"), [a, b], metrics=ml, net=net).run()
+    assert res.num_finished == 2
+
+    # core = 4 uplinks / 4 = 200 Gbps; two flows, core weight 2 each ->
+    # progressive filling stops at r = 200 / (2 + 2) = 50 Gbps per flow;
+    # per-host share = 50 / 2 hosts = 25 Gbps
+    f_both = _factor("transformer-base", 2, 25.0)
+    # alone: r = min(demand 200, core 200/2 = 100) = 100 -> 50 Gbps/host
+    f_solo = _factor("transformer-base", 2, 50.0)
+    assert f_both < f_solo < 1.0
+
+    t_a = 100.0 / f_both
+    assert a.end_time == pytest.approx(t_a, rel=1e-12)
+    # b: contended until a finishes, then re-priced to the solo share
+    t_b = t_a + (300.0 - t_a * f_both) / f_solo
+    assert b.end_time == pytest.approx(t_b, rel=1e-12)
+    assert b.locality_factor == pytest.approx(f_solo, rel=1e-12)
+
+    # the re-price is visible in the stream: b gets a second net event
+    nets = [e for e in ml.events if e.get("event") == "net"]
+    assert [e["job"] for e in nets] == ["a", "b", "b"]
+    assert nets[0]["locality"] == pytest.approx(f_both, rel=1e-12)
+    assert nets[2]["locality"] == pytest.approx(f_solo, rel=1e-12)
+    assert nets[2]["bw_gbps"] == pytest.approx(100.0)
+
+
+def test_ingest_reduces_elastic_capacity_and_residual():
+    c = _fleet(pods=2)
+    net = NetModel(NetConfig(oversubscription=1.0, ingest_gbps_per_chip=0.5))
+    net.attach(c)
+    small = c.allocate(8, hint={"pod": 0})
+    assert small is not None
+    state = net.recompute(0.0, [])
+    # 8 occupied chips x 0.5 Gbps ride pod0's uplink
+    assert state.links[uplink(0)].used_gbps == pytest.approx(4.0)
+    assert state.links[uplink(1)].used_gbps == 0.0
+    assert state.links[CORE].used_gbps == pytest.approx(4.0)
+    assert net.residual_gbps(0) == pytest.approx(200.0 - 4.0)
+    assert net.residual_gbps(1) == pytest.approx(200.0)
+
+
+def test_overlay_guest_on_multislice_base_shares_bandwidth():
+    """A packed guest with its own multislice geometry is a flow too —
+    it contends with its base for the same uplinks."""
+    c = _fleet(pods=2)
+    net = NetModel(NetConfig(oversubscription=1.0, ingest_gbps_per_chip=0.0))
+    net.attach(c)
+    base_job = _whale("base", 0.0, 1.0)
+    base = c.allocate(32, job=base_job)
+    guest_job = _whale("guest", 0.0, 1.0)
+    guest = c.allocate(32, job=guest_job, hint={"overlay": base})
+    base_job.allocation, guest_job.allocation = base, guest
+    state = net.recompute(0.0, [base_job, guest_job])
+    # both flows share the same two uplinks: 200 / 2 = 100 Gbps each
+    assert state.shares["base"].gbps == pytest.approx(100.0)
+    assert state.shares["guest"].gbps == pytest.approx(100.0)
+
+
+def test_net_spec_parsing():
+    cfg = parse_net_spec("os=2,ingest=0.1")
+    assert cfg.oversubscription == 2.0
+    assert cfg.ingest_gbps_per_chip == 0.1
+    with pytest.raises(ValueError, match="known keys"):
+        parse_net_spec("bogus=1")
+    # out-of-range values fail at parse time (clean CLI error), not deep
+    # inside FabricTopology at Simulator construction
+    with pytest.raises(ValueError, match="oversubscription"):
+        parse_net_spec("os=0")
+    with pytest.raises(ValueError, match="oversubscription"):
+        parse_net_spec("os=-2")
+    with pytest.raises(ValueError, match="ingest"):
+        parse_net_spec("ingest=-0.1")
+
+
+def test_shrink_out_of_multislice_closes_bandwidth():
+    """An elastic resize from a 2-pod gang to a single-pod slice drops
+    the job out of the flow set while it keeps running: the engine must
+    emit a closing bw=0 net event, or the analyzer would integrate the
+    stale share for the rest of the run."""
+    from gpuschedule_tpu.policies.base import Policy
+
+    class ShrinkAt50(Policy):
+        name = "shrink"
+
+        def schedule(self, sim):
+            for job in list(sim.pending):
+                sim.try_start(job)
+            if sim.now >= 50.0 and sim.running:
+                job = sim.running[0]
+                if job.allocated_chips > 8:
+                    assert sim.resize(job, chips=8, speed=1.0)
+                    return None
+            return 50.0 if sim.now < 50.0 else None
+
+    c = _fleet(pods=2)
+    net = NetModel(NetConfig(oversubscription=1.0, ingest_gbps_per_chip=0.0))
+    job = _whale("w", 0.0, 200.0, model="transformer-tiny")
+    ml = MetricsLog(record_events=True, run_meta={
+        "run_id": "x", "seed": 0, "policy": "shrink", "config_hash": "h",
+        "total_chips": 32})
+    Simulator(c, ShrinkAt50(), [job], metrics=ml, net=net).run()
+    nets = [e for e in ml.events if e.get("event") == "net"]
+    assert nets[-1]["bw_gbps"] == 0.0  # the closing event at the shrink
+    an = analyze_events(iter(ml.events))
+    (row,) = an.network()["jobs"]
+    # bandwidth only accrued over the 50 multislice seconds at the full
+    # 200 Gbps uplink (os=1, solo): mean over run_time dilutes it
+    rec = next(r for r in an.jobs if r.job_id == "w")
+    assert rec.bw_gbps_s == pytest.approx(200.0 * 50.0, rel=1e-9)
+    assert row["mean_bw_gbps"] == pytest.approx(
+        200.0 * 50.0 / rec.run_time, rel=1e-9)
+
+
+def test_permanent_dead_link_terminates_tick_policies():
+    """A permanent hard uplink outage pins the job's factor at 0.0; a
+    policy that always requests wakeups must not spin the engine forever
+    — the run quiesces with the job unfinished (the net/ analogue of the
+    stranded-gang guard)."""
+    from gpuschedule_tpu.policies.base import Policy
+
+    class AlwaysTick(Policy):
+        name = "ticker"
+
+        def schedule(self, sim):
+            for job in list(sim.pending):
+                sim.try_start(job)
+            return sim.now + 60.0
+
+    c = _fleet(pods=2)
+    net = NetModel(NetConfig(oversubscription=1.0, ingest_gbps_per_chip=0.0))
+    job = _whale("w", 0.0, 1000.0, model="transformer-tiny")
+    plan = FaultPlan(records=[
+        FaultRecord(10.0, ("link", 0), math.inf, "link", degrade=0.0)])
+    res = Simulator(c, AlwaysTick(), [job], faults=plan, net=net).run()
+    assert res.num_finished == 0 and res.num_unfinished == 1
+    assert job.locality_factor == 0.0
+    assert res.end_time < 1e6  # quiesced, not tick-spun to infinity
+
+
+# --------------------------------------------------------------------- #
+# link faults: partial degradation
+
+
+def test_link_fault_stalls_then_resumes_never_revokes():
+    """A hard uplink outage drops the job's factor to 0 — it holds its
+    chips and stalls for exactly the outage, with no revocation, no lost
+    work, and no restore cost."""
+    c = _fleet(pods=2)
+    job = _whale("w", 0.0, 100.0, model="transformer-tiny")
+    plan = FaultPlan(records=[
+        FaultRecord(10.0, ("link", 0), 20.0, "link", degrade=0.0)])
+    net = NetModel(NetConfig(oversubscription=1.0, ingest_gbps_per_chip=0.0))
+    res = Simulator(c, make_policy("fifo"), [job], faults=plan, net=net).run()
+    (j,) = res.jobs
+    f = c._multislice_speed_factor(
+        2, Job("p", 0.0, 32, 1.0, model_name="transformer-tiny"))
+    assert j.fault_count == 0 and j.lost_work == 0.0
+    assert j.end_time == pytest.approx(30.0 + (100.0 - 10.0 * f) / f, rel=1e-9)
+    assert res.goodput["lost_chip_s"] == 0.0
+    assert res.goodput["restart_overhead_chip_s"] == 0.0
+
+
+def test_link_fault_partial_degrade_slows():
+    c = _fleet(pods=2)
+    job = _whale("w", 0.0, 100.0, model="transformer-tiny")
+    plan = FaultPlan(records=[
+        FaultRecord(10.0, ("link", 0), 20.0, "link", degrade=0.5)])
+    net = NetModel(NetConfig(oversubscription=1.0, ingest_gbps_per_chip=0.0))
+    res = Simulator(c, make_policy("fifo"), [job], faults=plan, net=net).run()
+    (j,) = res.jobs
+    f = c._multislice_speed_factor(
+        2, Job("p", 0.0, 32, 1.0, model_name="transformer-tiny"))
+    f_degraded = _factor("transformer-tiny", 2, DCN_GBPS / 2.0)
+    expected = 10.0 + 20.0 * 1.0 + ((100.0 - 10.0 * f - 20.0 * f_degraded) / f)
+    # runs at f, then 20 s at the degraded factor, then f again
+    assert 100.0 / f < j.end_time < 30.0 + (100.0 - 10.0 * f) / f
+    assert j.end_time == pytest.approx(expected, rel=1e-9)
+
+
+def test_link_fault_without_net_is_inert():
+    c = _fleet(pods=2)
+    job = _whale("w", 0.0, 100.0, model="transformer-tiny")
+    plan = FaultPlan(records=[
+        FaultRecord(10.0, ("link", 0), 20.0, "link", degrade=0.0)])
+    res = Simulator(c, make_policy("fifo"), [job], faults=plan).run()
+    f = c._multislice_speed_factor(
+        2, Job("p", 0.0, 32, 1.0, model_name="transformer-tiny"))
+    assert res.jobs[0].end_time == pytest.approx(100.0 / f, rel=1e-9)
+    assert res.counters["link_faults_inert"] == 1
+    assert res.counters["faults_link"] == 1
+
+
+def test_link_fault_schedule_deterministic_and_parseable():
+    c = _fleet(pods=3)
+    cfg, _ = parse_fault_spec(
+        "link_mtbf=3600,link_repair=600,link_degrade=0.5")
+    assert cfg.link_mtbf == 3600.0 and cfg.link_degrade == 0.5
+    r1 = generate_fault_schedule(c, cfg, horizon=50_000, seed=11)
+    r2 = generate_fault_schedule(c, cfg, horizon=50_000, seed=11)
+    assert r1 == r2 and r1
+    assert all(r.scope[0] == "link" and r.kind == "link" for r in r1)
+    assert all(0 <= r.scope[1] < 3 for r in r1)
+    assert r1[0].label.startswith("dcn/pod")
+    # the link stream is independent of the chip-MTBF stream (seed-split)
+    cfg2, _ = parse_fault_spec(
+        "link_mtbf=3600,link_repair=600,link_degrade=0.5,mtbf=86400")
+    links_only = [r for r in generate_fault_schedule(
+        c, cfg2, horizon=50_000, seed=11) if r.kind == "link"]
+    assert links_only == r1
+
+
+def test_gpu_cluster_generates_no_link_faults():
+    from gpuschedule_tpu.cluster import GpuCluster
+
+    cfg = FaultConfig(link_mtbf=3600.0)
+    recs = generate_fault_schedule(
+        GpuCluster(num_switches=2, nodes_per_switch=2, gpus_per_node=8),
+        cfg, horizon=50_000, seed=1)
+    assert recs == []
+
+
+# --------------------------------------------------------------------- #
+# telemetry round trip: analyzer, report, perfetto, gauges
+
+
+def _contended_run(ml=None, ingest=0.05):
+    c = _fleet(pods=4)
+    net = NetModel(NetConfig(oversubscription=4.0,
+                             ingest_gbps_per_chip=ingest))
+    jobs = [
+        _whale("a", 0.0, 100.0),
+        _whale("b", 0.0, 300.0),
+        Job("s", 5.0, num_chips=8, duration=50.0),
+    ]
+    ml = ml or MetricsLog(record_events=True, run_meta={
+        "run_id": "net-test", "seed": 0, "policy": "fifo",
+        "config_hash": "h", "total_chips": 64})
+    res = Simulator(c, make_policy("fifo"), jobs, metrics=ml, net=net).run()
+    return res, ml, net
+
+
+def test_analyzer_reconstructs_bandwidth_and_links():
+    """The acceptance criterion's analyzer half: per-job bandwidth shares
+    and link utilization reconstructed from the stream equal the model's
+    own numbers (and progress drift stays at float dust)."""
+    res, ml, net = _contended_run(ingest=0.0)
+    an = analyze_events(iter(ml.events))
+    assert an.max_progress_drift < 1e-12
+    assert an.goodput() == res.goodput  # closure still exact with net on
+
+    netdoc = an.network()
+    jobs = {r["job_id"]: r for r in netdoc["jobs"]}
+    # a ran its whole life at the contended 50 Gbps share
+    assert jobs["a"]["mean_bw_gbps"] == pytest.approx(50.0, rel=1e-12)
+    assert jobs["a"]["mean_share"] == pytest.approx(0.25, rel=1e-12)
+    # b: 50 Gbps while a ran, 100 Gbps after — the time-weighted mean
+    f_both = _factor("transformer-base", 2, 25.0)
+    f_solo = _factor("transformer-base", 2, 50.0)
+    t_a = 100.0 / f_both
+    t_b = t_a + (300.0 - t_a * f_both) / f_solo
+    mean_b = (50.0 * t_a + 100.0 * (t_b - t_a)) / t_b
+    assert jobs["b"]["mean_bw_gbps"] == pytest.approx(mean_b, rel=1e-9)
+    # the core sat at 100% while any whale ran (max-min fills it)
+    assert an.net_link_means[CORE] == pytest.approx(1.0)
+    assert set(an.net_links) == {CORE, *(uplink(p) for p in range(4))}
+
+
+def test_analyzer_rejects_net_event_for_idle_job():
+    events = [
+        {"schema": 1, "run_id": "x", "seed": 0, "policy": "p",
+         "config_hash": "h", "total_chips": 4},
+        {"t": 0.0, "event": "arrival", "job": "j", "chips": 4,
+         "duration": 1.0, "status": "Pass"},
+        {"t": 1.0, "event": "net", "job": "j", "locality": 0.5,
+         "bw_gbps": 1.0},
+    ]
+    from gpuschedule_tpu.obs import StreamError
+
+    with pytest.raises(StreamError, match="illegal transition"):
+        analyze_events(iter(events))
+
+
+def test_report_renders_network_panel():
+    _, ml, _ = _contended_run()
+    an = analyze_events(iter(ml.events))
+    html = render_report(an)
+    assert "<h2>Network</h2>" in html
+    assert "link utilization" in html
+    assert "mean share" in html
+    assert "http" not in html.split("</style>")[1]  # still self-contained
+
+
+def test_report_without_net_has_no_network_panel():
+    c = _fleet(pods=1)
+    ml = MetricsLog(record_events=True, run_meta={
+        "run_id": "x", "seed": 0, "policy": "fifo", "config_hash": "h",
+        "total_chips": 16})
+    Simulator(c, make_policy("fifo"),
+              [Job("j", 0.0, 4, 10.0)], metrics=ml).run()
+    html = render_report(analyze_events(iter(ml.events)))
+    assert "<h2>Network</h2>" not in html
+
+
+def test_perfetto_net_tracks():
+    _, ml, _ = _contended_run()
+    evs = trace_events(iter(ml.events))
+    net_slices = [e for e in evs if e.get("cat") == "net" and e["ph"] == "X"]
+    assert net_slices, "expected per-link utilization slices"
+    assert all(e["name"].endswith("%") for e in net_slices)
+    tracks = {e["args"]["name"] for e in evs
+              if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert any(t.startswith("net/uplink") for t in tracks)
+    assert "net/core" in tracks
+    from gpuschedule_tpu.obs import validate_chrome_trace
+
+    assert validate_chrome_trace({"traceEvents": evs}) == []
+
+
+def test_registry_gauges_lazy_and_labeled():
+    from gpuschedule_tpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry()
+    ml = MetricsLog(registry=reg)
+    assert "net_link_utilization" not in reg.to_json()  # lazy: net-free
+    ml2 = MetricsLog(registry=reg, record_events=True)
+    _contended_run(ml=ml2)
+    doc = reg.to_json()
+    assert "net_link_utilization" in doc
+    assert any("core" in k for k in doc["net_link_utilization"]["value"])
+
+
+# --------------------------------------------------------------------- #
+# the unified unknown-model fallback (satellite)
+
+
+def test_unknown_model_fallback_is_shared():
+    from gpuschedule_tpu.models.config import FALLBACK_MODEL, MODEL_CONFIGS
+    from gpuschedule_tpu.sim.overhead import BYTES_PER_PARAM, ckpt_bytes
+
+    median = MODEL_CONFIGS[FALLBACK_MODEL].param_count
+    # restore cost side
+    assert ckpt_bytes("no-such-model") == BYTES_PER_PARAM * median
+    # DCN toll side: an unknown model pays the SAME phantom model's toll
+    c = _fleet(pods=2)
+    unknown = c._multislice_speed_factor(
+        2, Job("u", 0.0, 32, 1.0, model_name="no-such-model"))
+    known = c._multislice_speed_factor(
+        2, Job("k", 0.0, 32, 1.0, model_name=FALLBACK_MODEL))
+    assert unknown == known
+
+
+# --------------------------------------------------------------------- #
+# regression guard: the no-net path is bit-identical
+
+
+def test_net_disabled_is_bit_identical(tmp_path):
+    """The off path: a seeded replay with the net kwarg at its default is
+    byte-for-byte the run it always was — jobs.csv, events stream,
+    goodput closure, and analyzer reconstruction all identical to an
+    explicit net=None run, with zero net/netlink records."""
+    from gpuschedule_tpu.sim.trace import generate_poisson_trace
+
+    def run(out, net_kwarg):
+        c = _fleet(pods=2)
+        jobs = generate_poisson_trace(40, seed=9)
+        jobs += [_whale(f"w{i}", 400.0 * i, 200.0) for i in range(3)]
+        ml = MetricsLog(
+            record_events=True,
+            events_sink=tmp_path / f"{out}.jsonl",
+            run_meta={"run_id": "guard", "seed": 9, "policy": "fifo",
+                      "config_hash": "h"},
+        )
+        with ml:
+            res = Simulator(c, make_policy("fifo"), jobs, metrics=ml,
+                            **net_kwarg).run()
+        ml.write(tmp_path / out)
+        return res
+
+    res_default = run("default", {})
+    res_none = run("explicit", {"net": None})
+    a = (tmp_path / "default" / "jobs.csv").read_bytes()
+    b = (tmp_path / "explicit" / "jobs.csv").read_bytes()
+    assert a == b
+    ev_a = (tmp_path / "default.jsonl").read_bytes()
+    ev_b = (tmp_path / "explicit.jsonl").read_bytes()
+    assert ev_a == ev_b
+    assert res_default.goodput == res_none.goodput
+    assert res_default.summary() == res_none.summary()
+    # no net event kinds, and the analyzer reconstructs the stream with
+    # an empty network panel
+    from gpuschedule_tpu.obs import analyze_file
+
+    an = analyze_file(tmp_path / "default.jsonl")
+    assert an.counts.get("net", 0) == 0
+    assert an.counts.get("netlink", 0) == 0
+    assert an.network() == {"links": {}, "jobs": []}
+    assert an.goodput() == res_default.goodput
+
+
+def test_cli_config_hash_unchanged_without_net(tmp_path):
+    """--net must not perturb the events header of a run that never asked
+    for it: the config hash still covers exactly the pre-net field set."""
+    from gpuschedule_tpu.cli import main
+    from gpuschedule_tpu.obs import config_hash
+
+    out = tmp_path / "ev.jsonl"
+    main(["run", "--policy", "fifo", "--cluster", "tpu-v5e",
+          "--dims", "4x4", "--pods", "2", "--synthetic", "5",
+          "--seed", "4", "--events", str(out)])
+    header = json.loads(out.read_text().splitlines()[0])
+    expected = config_hash({
+        "cluster": "tpu-v5e", "chips": 64, "dims": "4x4",
+        "pods": 2, "gpu_shape": "2x4x8",
+        "placement": "consolidated", "placement_seed": 0,
+        "philly": None, "trace": None,
+        "synthetic": 5, "seed": 4,
+        "arrival_rate": 1.0 / 60.0, "mean_duration": 3600.0,
+        "failure_rate": 0.0, "util_min": 1.0,
+        "max_job_chips": 256, "max_time": None,
+        "faults": None,
+    })
+    assert header["config_hash"] == expected
+
+
+# --------------------------------------------------------------------- #
+# CLI surface
+
+
+def test_cli_run_net_smoke(tmp_path, capsys):
+    from gpuschedule_tpu.cli import main
+
+    ev = tmp_path / "ev.jsonl"
+    rc = main(["run", "--policy", "fifo", "--cluster", "tpu-v5e",
+               "--dims", "4x4", "--pods", "4", "--synthetic", "20",
+               "--seed", "3", "--net", "os=4,ingest=0.05",
+               "--events", str(ev)])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["num_finished"] == 20
+    kinds = {json.loads(l).get("event") for l in ev.read_text().splitlines()}
+    assert "netlink" in kinds
+    header = json.loads(ev.read_text().splitlines()[0])
+    assert header["config_hash"]  # --net folded into the hash
+
+
+def test_cli_net_requires_tpu_cluster():
+    from gpuschedule_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="TPU"):
+        main(["run", "--cluster", "simple", "--synthetic", "3", "--net"])
+
+
+def test_cli_net_bad_spec():
+    from gpuschedule_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="known keys"):
+        main(["run", "--cluster", "tpu-v5e", "--synthetic", "3",
+              "--net", "bogus=1"])
+    with pytest.raises(SystemExit, match="oversubscription"):
+        main(["run", "--cluster", "tpu-v5e", "--synthetic", "3",
+              "--net", "os=0"])
+
+
+def test_cli_contention_placement_requires_net():
+    """Without the fabric model the contention scheme would silently run
+    the consolidated experiment — the CLI must refuse, same as an
+    unknown scheme."""
+    from gpuschedule_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="--net"):
+        main(["run", "--cluster", "tpu-v5e", "--synthetic", "3",
+              "--placement", "contention"])
+
+
+def test_fault_spec_link_degrade_must_be_fraction():
+    with pytest.raises(ValueError, match="FRACTION"):
+        parse_fault_spec("link_mtbf=3600,link_degrade=25")
+    with pytest.raises(ValueError, match="FRACTION"):
+        parse_fault_spec("link_degrade=-0.5")
