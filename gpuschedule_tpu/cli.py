@@ -228,11 +228,20 @@ def cmd_run(args) -> int:
             "run_id": f"{args.policy}-s{args.seed}-{chash}",
             "seed": args.seed, "policy": args.policy, "config_hash": chash,
         }
+    if args.sample_interval is not None and args.sample_interval <= 0.0:
+        raise SystemExit(
+            f"--sample-interval must be > 0 seconds, got {args.sample_interval}"
+        )
+    # Attribution/sampling (ISSUE 5) are observability, not experiment
+    # config: they are deliberately NOT in the config hash, so an
+    # attribution-armed capture stays `compare`-compatible with (and,
+    # flags off, byte-identical to) the plain run of the same world.
     metrics = MetricsLog(
         record_events=bool(args.events) or bool(args.perfetto),
         events_sink=events_sink,
         registry=registry,
         run_meta=run_meta,
+        attribution=bool(args.attrib),
     )
     sim = Simulator(
         cluster, build_policy(args), jobs,
@@ -240,6 +249,7 @@ def cmd_run(args) -> int:
         max_time=args.max_time or float("inf"),
         faults=fault_plan,
         net=net_model,
+        sample_interval=args.sample_interval,
     )
     # context-manager path: an engine exception still flushes/closes the
     # JSONL sink, leaving an analyzable stream behind (ISSUE 3 satellite)
@@ -314,38 +324,64 @@ def cmd_report(args) -> int:
 
 
 def cmd_compare(args) -> int:
-    """Regression-diff two event streams, metric by metric, for CI gating:
-    exit 0 when B stays within threshold of A on every gated metric, 1
-    past any threshold, 2 when the runs are not comparable (missing or
-    mismatched headers)."""
+    """Regression-diff event streams for CI gating.
+
+    Two streams: the gate — exit 0 when B stays within threshold of A on
+    every gated metric, 1 past any threshold, 2 when the runs are not
+    comparable (missing or mismatched headers).  Three or more: the
+    n-way policy x metric matrix with per-metric best/worst highlighting
+    (exit 0, or 2 when any pair is not comparable; thresholds apply only
+    to the two-run gate)."""
     from gpuschedule_tpu.obs import (
         SchemaError,
         StreamError,
         analyze_file,
+        compare_matrix,
         compare_runs,
         parse_thresholds,
         write_compare_json,
+        write_matrix_json,
     )
 
     try:
         default, per_metric = parse_thresholds(args.threshold)
     except ValueError as e:
         raise SystemExit(str(e)) from None
-    try:
-        a = analyze_file(args.a)
-        b = analyze_file(args.b)
-        result = compare_runs(
-            a, b,
-            threshold=default, per_metric=per_metric,
-            allow_mismatch=args.allow_mismatch,
+    if len(args.streams) < 2:
+        # usage error, not a regression: exit 2 (the not-comparable
+        # bucket) so a CI glob matching one file doesn't read as exit-1
+        # "metric regressed"
+        print("compare needs at least two event streams", file=sys.stderr)
+        return 2
+    if len(args.streams) > 2 and args.threshold:
+        print(
+            "--threshold gates the two-run compare; the n-way matrix "
+            "ranks, it does not gate",
+            file=sys.stderr,
         )
+        return 2
+    try:
+        analyses = [analyze_file(path) for path in args.streams]
+        if len(analyses) == 2:
+            result = compare_runs(
+                analyses[0], analyses[1],
+                threshold=default, per_metric=per_metric,
+                allow_mismatch=args.allow_mismatch,
+            )
+        else:
+            result = compare_matrix(
+                analyses, allow_mismatch=args.allow_mismatch
+            )
     except (SchemaError, StreamError) as e:
         print(f"refusing to compare: {e}", file=sys.stderr)
         return 2
     print(result.format_table())
     if args.json:
-        write_compare_json(result, args.json)
-    return result.exit_code
+        if len(analyses) == 2:
+            write_compare_json(result, args.json)
+        else:
+            write_matrix_json(result, args.json)
+    return result.exit_code if len(analyses) == 2 else 0
 
 
 def cmd_faults(args) -> int:
@@ -954,6 +990,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                           "'contention' placement scheme's residual-"
                           "bandwidth scoring and ('link', pod) fault "
                           "degradation")
+    run.add_argument("--attrib", action="store_true",
+                     help="causal slowdown attribution: blame every queued "
+                          "interval with its cause (capacity / policy-"
+                          "preempt / fault-outage / admission), split "
+                          "running time into work / policy-share / net-"
+                          "degraded / overhead legs, and stamp the exact "
+                          "cumulative legs onto the event stream — the "
+                          "analyzer's wait/JCT decompositions close bit-"
+                          "exactly against the engine's own arithmetic.  "
+                          "Adds delay_<cause>_s keys to the summary line; "
+                          "off, the run is byte-identical to before this "
+                          "flag existed")
+    run.add_argument("--sample-interval", type=float, metavar="SECONDS",
+                     help="emit periodic cluster-side 'sample' events "
+                          "(physical occupancy, health-masked chips, per-"
+                          "pod fragmentation, queue depth) every SECONDS "
+                          "of sim time; with --events the analyzer/report "
+                          "overlay physical on demand occupancy and "
+                          "Perfetto gains counter tracks.  Sampling never "
+                          "perturbs the replay")
     run.add_argument("--prom", metavar="PATH",
                      help="write run counters/gauges/histograms in the "
                           "Prometheus text exposition format (with --out, "
@@ -1027,10 +1083,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     cmpr = sub.add_parser(
         "compare",
         help="regression-diff two event streams for CI gating (exit 0 "
-             "within thresholds, 1 regressed, 2 not comparable)",
+             "within thresholds, 1 regressed, 2 not comparable); three "
+             "or more render an n-way policy x metric matrix with "
+             "best/worst highlighting",
     )
-    cmpr.add_argument("a", metavar="BASELINE_EVENTS")
-    cmpr.add_argument("b", metavar="CANDIDATE_EVENTS")
+    cmpr.add_argument("streams", nargs="+", metavar="EVENTS_JSONL",
+                      help="two streams: baseline + candidate (the CI "
+                           "gate); three or more: n-way matrix columns")
     cmpr.add_argument("--threshold", action="append",
                       metavar="FLOAT|METRIC=FLOAT",
                       help="relative worsening gate: a bare float sets the "

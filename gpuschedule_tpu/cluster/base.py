@@ -86,6 +86,17 @@ class ClusterBase:
         placement can still fail; SimpleCluster's answer is exact)."""
         return num_chips <= self.free_chips
 
+    def sample_state(self) -> dict:
+        """Snapshot for the engine's periodic ``sample`` events (ISSUE 5):
+        *physical* occupancy and health, straight from the flavor's own
+        bookkeeping.  ``used`` counts chips physically held — under
+        overlay packing two jobs share the same chips, so this can be
+        *less* than the demand series the analyzer derives from start
+        events (the divergence IS the packing signal).  Flavors extend
+        with their topology's own facts (per-pod fragmentation, down
+        nodes); keys are additive, schema stays v1."""
+        return {"used": self.used_chips, "unhealthy": self.unhealthy_chips}
+
     def is_satisfiable(self, num_chips: int) -> bool:
         """Could ``num_chips`` EVER be granted on this cluster (ignoring the
         current occupancy)?  The engine rejects unsatisfiable jobs at
@@ -114,6 +125,14 @@ class OverlayMixin:
 
     def _init_overlays(self) -> None:
         self._overlays: dict[int, int] = {}  # overlay alloc_id -> base alloc_id
+
+    def sample_state(self) -> dict:
+        state = super().sample_state()
+        # live overlay count: how many packed guests currently share a
+        # base allocation's chips — the reason the analyzer's demand
+        # series can exceed the ``used`` reported here
+        state["overlays"] = len(self._overlays)
+        return state
 
     def _base_id(self, allocation: Allocation) -> int:
         return self._overlays.get(allocation.alloc_id, allocation.alloc_id)

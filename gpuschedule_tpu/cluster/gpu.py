@@ -107,6 +107,19 @@ class GpuCluster(ClusterBase):
             raise ValueError(f"fault node {nd} not in {self!r}")
         return nd
 
+    def sample_state(self) -> dict:
+        state = super().sample_state()
+        # node-granular facts: how many hosts are down, and how many are
+        # entirely free (the consolidated scheme's placement currency —
+        # a gang that fits one free node runs at full NVLink speed)
+        state["nodes_down"] = len(self._down)
+        state["free_nodes"] = sum(
+            1
+            for nd, free in self._free.items()
+            if free == self.gpus_per_node and nd not in self._down
+        )
+        return state
+
     def mark_unhealthy(self, scope) -> list:
         """Take a host node offline (the Philly failure domain); returns
         the alloc_ids of gangs with any GPU on it."""

@@ -605,6 +605,24 @@ class TpuCluster(OverlayMixin, ClusterBase):
     # ------------------------------------------------------------------ #
     # fragmentation / observability
 
+    def _largest_free_box(self, blocked: np.ndarray, cap: int) -> int:
+        """Largest power-of-two slice placeable in one pod's ``blocked``
+        grid, descending from the largest pow2 <= cap (0 if none) — the
+        shared core of global and per-pod fragmentation.  Starting from
+        the pow2 *floor* matters: min(free, pod capacity) itself can be a
+        non-pow2 that skips every real candidate below it."""
+        if cap <= 0:
+            return 0
+        k = 1 << (cap.bit_length() - 1)
+        while k >= 1:
+            if any(
+                self._find_free_box(blocked, shape, None) is not None
+                for shape in valid_slice_shapes(k, self.dims)
+            ):
+                return k
+            k >>= 1
+        return 0
+
     def largest_allocatable(self) -> int:
         """Largest valid allocation grantable right now (0 if none): a
         multislice over every empty pod when more than one is empty, else
@@ -616,14 +634,11 @@ class TpuCluster(OverlayMixin, ClusterBase):
         empty_pods = len(self._empty_pods())
         if empty_pods > 1:
             return empty_pods * self.pod_chips
-        # largest pow2 <= min(free, pod capacity); min() of the raw values
-        # could land on a non-pow2 and skip every real candidate below it
-        k = 1 << (min(self.free_chips, self.pod_chips).bit_length() - 1)
-        while k >= 1:
-            if self.can_allocate(k):
-                return k
-            k >>= 1
-        return 0
+        cap = min(self.free_chips, self.pod_chips)
+        return max(
+            self._largest_free_box(self._blocked(pod), cap)
+            for pod in range(self.num_pods)
+        )
 
     def fragmentation(self) -> float:
         """1 - largest_allocatable/free_chips: 0 = perfectly compact free
@@ -632,6 +647,52 @@ class TpuCluster(OverlayMixin, ClusterBase):
         if free == 0:
             return 0.0
         return 1.0 - self.largest_allocatable() / free
+
+    def pod_fragmentation(self, pod: int) -> float:
+        """One pod's fragmentation: 1 - (largest free box)/(healthy free
+        chips) within that pod alone.  0 when the pod's free space is one
+        compact slice-shaped region; →1 when free chips survive only as
+        shards no valid slice shape can cover."""
+        free = self.pod_free_chips(pod)
+        if free == 0:
+            return 0.0
+        largest = self._largest_free_box(
+            self._blocked(pod), min(free, self.pod_chips)
+        )
+        return 1.0 - largest / free
+
+    def sample_state(self) -> dict:
+        state = super().sample_state()
+        # per-pod physical occupancy and fragmentation: which pods are
+        # shredded matters for multislice placement (only whole empty
+        # pods can join a DCN gang).  One largest-free-box descent per
+        # pod serves both the per-pod values and the global figure —
+        # fragmentation() would re-run the identical descents.
+        pods = []
+        largest = 0
+        for p in range(self.num_pods):
+            free_p = self.pod_free_chips(p)
+            box = (
+                self._largest_free_box(
+                    self._blocked(p), min(free_p, self.pod_chips)
+                )
+                if free_p else 0
+            )
+            pods.append({
+                "used": self.pod_used_chips(p),
+                "frag": 1.0 - box / free_p if free_p else 0.0,
+            })
+            largest = max(largest, box)
+        free = self.free_chips
+        if free == 0:
+            state["frag"] = 0.0
+        else:
+            empty = len(self._empty_pods())
+            if empty > 1:  # the multislice arm of largest_allocatable()
+                largest = empty * self.pod_chips
+            state["frag"] = 1.0 - largest / free
+        state["pods"] = pods
+        return state
 
     def live_slices(self) -> List[SliceGeometry]:
         return list(self._live.values())

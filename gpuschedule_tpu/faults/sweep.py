@@ -79,6 +79,8 @@ def run_cell(
     num_pods: int = 1,
     max_time: Optional[float] = None,
     events_path=None,
+    attribution: bool = False,
+    sample_interval: Optional[float] = None,
 ) -> dict:
     """Run one (policy, MTBF) cell on a fresh cluster + trace + schedule.
 
@@ -91,6 +93,13 @@ def run_cell(
     opened with a schema header (the cell's identity; the config hash
     covers everything but the policy, so two cells at the same seed are
     `compare`-compatible) — the CLI ``faults --events DIR`` path.
+
+    ``attribution`` / ``sample_interval`` arm the causal-attribution and
+    cluster-sampling layers (ISSUE 5): the captured stream then carries
+    blame/sample records and the cell reports ``delay_by_cause``, so a
+    chaos sweep answers not just *how much* goodput each policy lost but
+    *where its jobs' time went* — defaults keep every existing cell
+    byte-identical.
     """
     name, kwargs = POLICY_CONFIGS[policy_key]
     cluster = TpuCluster("v5e", dims=tuple(dims), num_pods=num_pods)
@@ -103,7 +112,7 @@ def run_cell(
         ),
         recovery=RecoveryModel(ckpt_interval=ckpt, restore=restore),
     )
-    metrics = MetricsLog()
+    metrics = MetricsLog(attribution=attribution)
     if events_path is not None:
         from gpuschedule_tpu.obs import config_hash
 
@@ -116,13 +125,14 @@ def run_cell(
         metrics = MetricsLog(events_sink=events_path, run_meta={
             "run_id": f"{policy_key}-s{seed}-{chash}",
             "seed": seed, "policy": policy_key, "config_hash": chash,
-        })
+        }, attribution=attribution)
     with metrics:  # engine exceptions still flush the stream
         res = Simulator(
             cluster, make_policy(name, **kwargs), jobs,
             metrics=metrics,
             faults=plan,
             max_time=max_time if max_time is not None else math.inf,
+            sample_interval=sample_interval,
         ).run()
     cell = {
         "policy": policy_key,
@@ -135,6 +145,8 @@ def run_cell(
         "revocations": int(res.counters.get("fault_revocations", 0)),
         "goodput": dict(res.goodput),
     }
+    if res.delay_by_cause:
+        cell["delay_by_cause"] = dict(res.delay_by_cause)
     if events_path is not None:
         cell["events"] = str(events_path)
     return cell

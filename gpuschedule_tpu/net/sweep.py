@@ -23,6 +23,7 @@ from gpuschedule_tpu.faults.sweep import POLICY_CONFIGS, jsonable  # noqa: F401
 from gpuschedule_tpu.net.model import NetConfig, NetModel
 from gpuschedule_tpu.policies import make_policy
 from gpuschedule_tpu.sim import Simulator
+from gpuschedule_tpu.sim.metrics import MetricsLog
 from gpuschedule_tpu.sim.philly import generate_philly_like_trace
 
 # Default offered-load grid: the multislice share of the job mix.
@@ -56,9 +57,13 @@ def run_cell(
     oversubscription: float = 4.0,
     ingest: float = 0.05,
     max_time: Optional[float] = None,
+    attribution: bool = False,
 ) -> dict:
     """One (policy, multislice-share) cell on a fresh cluster + trace +
-    net model.  Deterministic per argument tuple."""
+    net model.  Deterministic per argument tuple.  ``attribution`` arms
+    the causal layer (ISSUE 5): the cell then reports ``delay_by_cause``
+    — in particular the ``net-degraded`` leg, the seconds the share's
+    jobs lost to fabric contention rather than queueing."""
     if num_pods < 2:
         raise ValueError("the contention sweep needs num_pods >= 2")
     name, kwargs = POLICY_CONFIGS[policy_key]
@@ -70,12 +75,19 @@ def run_cell(
     net = NetModel(NetConfig(
         oversubscription=oversubscription, ingest_gbps_per_chip=ingest,
     ))
+    metrics = MetricsLog(attribution=attribution) if attribution else None
     res = Simulator(
         cluster, make_policy(name, **kwargs), jobs,
+        metrics=metrics,
         net=net,
         max_time=max_time if max_time is not None else math.inf,
     ).run()
+    cell_extra = (
+        {"delay_by_cause": dict(res.delay_by_cause)}
+        if res.delay_by_cause else {}
+    )
     return {
+        **cell_extra,
         "policy": policy_key,
         "multislice_share": multislice_share,
         "avg_jct": res.avg_jct,

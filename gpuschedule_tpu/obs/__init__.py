@@ -14,11 +14,14 @@ ROADMAP's production-scale north star):
   interval), loadable in ui.perfetto.dev;
 - :mod:`gpuschedule_tpu.obs.analyze` — streaming per-job lifecycle
   reconstruction from the JSONL event log: distributions with exact
-  quantiles, utilization/fragmentation series, and a fault-attribution
+  quantiles, utilization/fragmentation series, a fault-attribution
   table that closes bit-exactly against ``SimResult.goodput`` (ISSUE 3
-  tentpole);
+  tentpole), and the causal wait/slowdown decomposition + physical
+  occupancy series that answer "why was this job slow?" (ISSUE 5
+  tentpole, closing against ``SimResult.delay_by_cause``);
 - :mod:`gpuschedule_tpu.obs.compare` — cross-run regression diff with
-  polarity-aware thresholds and CI exit codes;
+  polarity-aware thresholds and CI exit codes, plus the n-way
+  policy x metric matrix (``compare_matrix``);
 - :mod:`gpuschedule_tpu.obs.report` — one self-contained HTML report
   (inline CSS/SVG, zero network fetches).
 
@@ -48,9 +51,12 @@ from gpuschedule_tpu.obs.analyze import (
 )
 from gpuschedule_tpu.obs.compare import (
     CompareResult,
+    MatrixResult,
+    compare_matrix,
     compare_runs,
     parse_thresholds,
     write_compare_json,
+    write_matrix_json,
 )
 from gpuschedule_tpu.obs.report import render_report, write_report
 from gpuschedule_tpu.obs.perfetto import (
@@ -81,9 +87,12 @@ __all__ = [
     "analyze_file",
     "config_hash",
     "CompareResult",
+    "MatrixResult",
+    "compare_matrix",
     "compare_runs",
     "parse_thresholds",
     "write_compare_json",
+    "write_matrix_json",
     "render_report",
     "write_report",
     "export_chrome_trace",

@@ -57,6 +57,14 @@ SCHEMA_VERSION = 1
 QUEUED, RUNNING, SUSPENDED = "queued", "running", "suspended"
 TERMINAL_STATES = ("done", "failed", "killed", "rejected")
 
+# Causal-attribution leg names (ISSUE 5) — the reader's own copy of
+# sim/job.py's WAIT_CAUSES / RUN_LEGS (same no-sim-import rule as
+# SCHEMA_VERSION; tests pin the two equal).  WAIT_CAUSES blame queued/
+# suspended intervals; RUN_LEGS split running time into the work-
+# equivalent and its slowdown stretches.
+WAIT_CAUSES = ("admission", "capacity", "fault-outage", "policy-preempt")
+RUN_LEGS = ("work", "policy-share", "net-degraded", "overhead")
+
 _QUANTS = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
 
 
@@ -158,6 +166,10 @@ class JobRecord:
     lost_service: float = 0.0
     overhead_service: float = 0.0
     lost_work: float = 0.0
+    # causal attribution (ISSUE 5): the engine's exact cumulative per-leg
+    # seconds, adopted from event "blame" snapshots (empty when the run
+    # was captured without attribution)
+    delay_legs: Dict[str, float] = field(default_factory=dict)
 
     def wait(self) -> Optional[float]:
         if self.first_start_t is None:
@@ -182,6 +194,61 @@ class JobRecord:
             return None
         return self.bw_gbps_s / self.run_time
 
+    # ---- causal decompositions (ISSUE 5) ----------------------------- #
+
+    def wait_legs(self) -> Dict[str, float]:
+        """The queued-interval blame legs alone (WAIT_CAUSES keys)."""
+        return {
+            k: self.delay_legs[k]
+            for k in sorted(self.delay_legs)
+            if k in WAIT_CAUSES
+        }
+
+    def run_legs(self) -> Dict[str, float]:
+        """The running-interval slowdown legs alone (RUN_LEGS keys)."""
+        return {
+            k: self.delay_legs[k]
+            for k in sorted(self.delay_legs)
+            if k not in WAIT_CAUSES
+        }
+
+    def attributed_wait(self) -> float:
+        """This job's wait as the decomposition's own arithmetic states
+        it: the ordered (sorted-key) sum of the blame legs.  The per-job
+        closure is definitional — ``sum(wait_legs().values())`` IS this
+        number — while the analyzer's independently integrated
+        ``queue_time + suspended_time`` cross-checks it to float dust
+        (``wait_residual``)."""
+        total = 0.0
+        for k in sorted(self.delay_legs):
+            if k in WAIT_CAUSES:
+                total += self.delay_legs[k]
+        return total
+
+    def attributed_jct(self) -> float:
+        """All legs summed (sorted keys): waits + work + slowdown
+        stretches + overhead — the slowdown decomposition's JCT."""
+        total = 0.0
+        for k in sorted(self.delay_legs):
+            total += self.delay_legs[k]
+        return total
+
+    def wait_residual(self) -> Optional[float]:
+        """Attributed wait minus the analyzer's own state integration
+        (float re-association dust on healthy streams; a large value
+        means the stream is missing a transition)."""
+        if not self.delay_legs:
+            return None
+        return self.attributed_wait() - (self.queue_time + self.suspended_time)
+
+    def jct_residual(self) -> Optional[float]:
+        """Attributed JCT minus ``end_t - submit_t`` for finished jobs
+        (same dust-vs-missing-transition meaning as wait_residual)."""
+        j = self.jct()
+        if j is None or not self.delay_legs:
+            return None
+        return self.attributed_jct() - j
+
     @property
     def finished(self) -> bool:
         return self.end_state in ("done", "failed", "killed")
@@ -202,6 +269,7 @@ class JobRecord:
             "net_updates": self.net_updates,
             "mean_bw_gbps": self.mean_bw_gbps(),
             "demand_gbps": self.demand_gbps,
+            **({"delay_legs": dict(self.delay_legs)} if self.delay_legs else {}),
         }
 
 
@@ -212,6 +280,7 @@ class _Active:
     rec: JobRecord
     state: str = QUEUED
     t_state: float = 0.0       # when the current state was entered
+    cause: Optional[str] = None  # blame of the open queued interval (ISSUE 5)
     chips_alloc: int = 0
     speed: float = 0.0
     locality: float = 1.0
@@ -258,11 +327,23 @@ class RunAnalysis:
     net_links: Dict[str, List[Tuple[float, float, float]]] = field(
         default_factory=dict)
     net_link_means: Dict[str, float] = field(default_factory=dict)
+    # cluster-side sampling (ISSUE 5): periodic ``sample`` events as
+    # (t, physical_used, unhealthy, pending) change points, plus the exact
+    # time-weighted mean *physical* occupancy — the series the report
+    # overlays on the demand series (divergence = overlay packing; the
+    # ROADMAP PR-3 demand-only-occupancy omission, retired)
+    sample_series: List[Tuple[float, int, int, int]] = field(
+        default_factory=list)
+    mean_phys_occupancy: Optional[float] = None
     # memoized derived views (report/compare each read them several times;
     # at Philly scale recomputing means redundant full scans and sorts)
     _goodput_cache: Optional[Dict[str, float]] = field(
         default=None, repr=False, compare=False)
     _dist_cache: Optional[Dict[str, dict]] = field(
+        default=None, repr=False, compare=False)
+    _delay_cache: Optional[Dict[str, float]] = field(
+        default=None, repr=False, compare=False)
+    _attrib_cache: Optional[dict] = field(
         default=None, repr=False, compare=False)
 
     # ------------------------------------------------------------------ #
@@ -301,6 +382,68 @@ class RunAnalysis:
             "fault_count": _stat_block([float(r.faults) for r in fin]),
         }
         return self._dist_cache
+
+    def delay_by_cause(self) -> Dict[str, float]:
+        """The wait/slowdown-decomposition closure (ISSUE 5): per-leg
+        seconds summed over jobs in arrival order with sorted keys per
+        job — the engine's exact floats (adopted from event ``blame``
+        snapshots) added with the same arithmetic ``SimResult`` uses, so
+        this equals ``SimResult.delay_by_cause`` to the last float for
+        all eight policies, with and without faults/net (the golden
+        attribution tests pin it).  Empty for attribution-free runs."""
+        if self._delay_cache is not None:
+            return dict(self._delay_cache)
+        out: Dict[str, float] = {}
+        for r in self.jobs:
+            for k in sorted(r.delay_legs):
+                out[k] = out.get(k, 0.0) + r.delay_legs[k]
+        self._delay_cache = out
+        return dict(out)
+
+    def attribution(self) -> dict:
+        """The cluster lost-time-by-cause table: where the cluster's time
+        went, cause by cause.
+
+        - ``wait_s`` / ``run_s``: the per-leg aggregate in seconds
+          (``delay_by_cause`` split into blame causes vs running legs);
+        - ``chip_demand_wait_s``: blame legs weighted by each job's
+          requested gang (chip-demand-seconds stuck in queue per cause);
+        - ``lost_chip_s`` / ``restart_overhead_chip_s``: the fault and
+          overhead legs in chip-seconds, taken verbatim from
+          :meth:`goodput` — which is exactly ``SimResult.goodput``, so
+          the table *closes against SimResult's own arithmetic*;
+        - residuals: ``max_wait_residual`` / ``max_jct_residual``, the
+          worst per-job gap between the decomposition totals and the
+          independently reconstructed wait/JCT (float dust on healthy
+          streams).
+
+        Memoized like goodput/distributions: report + to_json each read
+        it, and every computation rescans the full job list."""
+        if self._attrib_cache is not None:
+            return dict(self._attrib_cache)
+        legs = self.delay_by_cause()
+        gp = self.goodput()
+        chip_wait: Dict[str, float] = {}
+        for r in self.jobs:
+            for k in sorted(r.delay_legs):
+                if k in WAIT_CAUSES:
+                    chip_wait[k] = chip_wait.get(k, 0.0) + r.chips * r.delay_legs[k]
+        wait_res = [abs(v) for v in (r.wait_residual() for r in self.jobs)
+                    if v is not None]
+        jct_res = [abs(v) for v in (r.jct_residual() for r in self.jobs)
+                   if v is not None]
+        self._attrib_cache = {
+            "wait_s": {k: v for k, v in sorted(legs.items())
+                       if k in WAIT_CAUSES},
+            "run_s": {k: v for k, v in sorted(legs.items())
+                      if k not in WAIT_CAUSES},
+            "chip_demand_wait_s": dict(sorted(chip_wait.items())),
+            "lost_chip_s": gp["lost_chip_s"],
+            "restart_overhead_chip_s": gp["restart_overhead_chip_s"],
+            "max_wait_residual": max(wait_res, default=0.0),
+            "max_jct_residual": max(jct_res, default=0.0),
+        }
+        return dict(self._attrib_cache)
 
     def fault_attribution(self) -> dict:
         """Per-fault-kind attribution plus the exact goodput closure.
@@ -392,6 +535,17 @@ class RunAnalysis:
             "net_reprices": self.counts.get("net", 0),
             "useful_frac": useful_frac,
             **{f"goodput_{k}": v for k, v in gp.items()},
+            # attribution-armed runs only: the same delay_<cause>_s keys
+            # SimResult.summary() emits (closure surface), plus physical
+            # occupancy when the run was sampled
+            **{
+                f"delay_{k.replace('-', '_')}_s": v
+                for k, v in sorted(self.delay_by_cause().items())
+            },
+            **(
+                {"mean_phys_occupancy": self.mean_phys_occupancy}
+                if self.mean_phys_occupancy is not None else {}
+            ),
         }
 
     def to_json(self) -> dict:
@@ -404,6 +558,13 @@ class RunAnalysis:
             "faults": self.fault_attribution(),
             "fault_timeline": list(self.fault_timeline),
             "network": self.network(),
+            "attribution": (
+                self.attribution() if self.delay_by_cause() else None
+            ),
+            "samples": {
+                "n": len(self.sample_series),
+                "mean_phys_occupancy": self.mean_phys_occupancy,
+            },
             "max_progress_drift": self.max_progress_drift,
             "jobs": [r.to_json() for r in self.jobs],
         }
@@ -422,7 +583,10 @@ _LEGAL_FROM = {
     "rebind": (RUNNING,),
     "revoke": (RUNNING,),
     "finish": (RUNNING,),
-    "cutoff": (RUNNING,),
+    # cutoff also reaches queued/suspended jobs: attribution-armed runs
+    # emit a horizon record for every waiting job so the stream provably
+    # extends to max_time (the wait closure depends on it)
+    "cutoff": (RUNNING, QUEUED, SUSPENDED),
     "net": (RUNNING,),
 }
 
@@ -456,6 +620,10 @@ def analyze_events(
     # piecewise-constant utilization integral ([last_t, last_util, area])
     net_links: Dict[str, List[Tuple[float, float, float]]] = {}
     net_acc: Dict[str, List[float]] = {}
+    # cluster samples (ISSUE 5): physical-occupancy series + its exact
+    # piecewise-constant integral ([last_t, last_used, area, first_t])
+    sample_series: List[Tuple[float, int, int, int]] = []
+    samp_acc: Optional[List[float]] = None
 
     used = running_n = pending_n = 0
     last_t: Optional[float] = None
@@ -528,6 +696,15 @@ def analyze_events(
             a.rec.bw_gbps_s += a.bw_gbps * (t - a.t_bw)
         a.t_bw = t
 
+    def adopt_blame(a: _Active, ev: dict) -> None:
+        """Take the engine's exact cumulative attribution legs (ISSUE 5) —
+        the ``blame`` analogue of the ``prog`` adoption above: snapshots
+        replace the analyzer's view wholesale, so every adopted float is
+        the engine's own."""
+        blame = ev.get("blame")
+        if blame is not None:
+            a.rec.delay_legs = dict(blame)
+
     def sample(t: float) -> None:
         """Integrate occupancy/fragmentation/pending exactly (piecewise-
         constant), store a decimation-capped series for the report."""
@@ -586,7 +763,10 @@ def analyze_events(
                 duration=ev.get("duration"), status=ev.get("status"),
             )
             jobs.append(rec)
-            active[rec.job_id] = _Active(rec=rec, state=QUEUED, t_state=t, t_prog=t)
+            active[rec.job_id] = _Active(
+                rec=rec, state=QUEUED, t_state=t, t_prog=t,
+                cause=ev.get("cause"),
+            )
             pending_n += 1
             sample(t)
             continue
@@ -632,6 +812,31 @@ def analyze_events(
                 if series[-1] != last:
                     series.append(last)
             continue
+        if kind == "sample":
+            # periodic cluster-side snapshot (ISSUE 5): PHYSICAL occupancy
+            # — overlay guests consume no extra chips here, unlike the
+            # demand series integrated from start events above; the gap
+            # between the two series is the packing signal
+            used_p = int(ev.get("used", 0))
+            if samp_acc is None:
+                # integral seeded at t=0 with occupancy 0: the cluster is
+                # known-empty at run start (the engine skips the t=0
+                # sample for exactly that reason), so the physical mean
+                # covers the same span as the demand mean instead of
+                # starting at the first sample tick
+                samp_acc = [0.0, 0.0, 0.0, 0.0]
+            samp_acc[2] += samp_acc[1] * (t - samp_acc[0])
+            samp_acc[0], samp_acc[1] = t, float(used_p)
+            sample_series.append((
+                t, used_p, int(ev.get("unhealthy", 0)),
+                int(ev.get("pending", 0)),
+            ))
+            if len(sample_series) > max_util_samples:
+                last_s = sample_series[-1]
+                del sample_series[::2]
+                if sample_series[-1] != last_s:
+                    sample_series.append(last_s)
+            continue
 
         # ---- per-job transitions ------------------------------------- #
         a = active.get(ev.get("job"))
@@ -652,6 +857,8 @@ def analyze_events(
         if kind == "start":
             leave_state(a, t)
             adopt_snapshot(a, ev, t)
+            adopt_blame(a, ev)
+            a.cause = None  # the engine closed the wait interval at start
             a.rec.starts += 1
             if a.rec.first_start_t is None:
                 a.rec.first_start_t = t
@@ -668,6 +875,8 @@ def analyze_events(
         elif kind == "preempt":
             leave_state(a, t)
             adopt_snapshot(a, ev, t)
+            adopt_blame(a, ev)
+            a.cause = ev.get("cause")
             settle_bw(a, t)
             a.bw_gbps = 0.0
             a.rec.preempts += 1
@@ -715,6 +924,8 @@ def analyze_events(
             prev_lost = a.rec.lost_service
             leave_state(a, t)
             adopt_snapshot(a, ev, t, rollback=float(ev.get("lost_work", 0.0)))
+            adopt_blame(a, ev)
+            a.cause = ev.get("cause")
             settle_bw(a, t)
             a.bw_gbps = 0.0
             a.rec.faults += 1
@@ -733,6 +944,7 @@ def analyze_events(
         elif kind == "finish":
             leave_state(a, t)
             adopt_snapshot(a, ev, t)
+            adopt_blame(a, ev)
             settle_bw(a, t)
             a.rec.end_t = t
             a.rec.end_state = str(ev.get("end_state", "done"))
@@ -741,12 +953,18 @@ def analyze_events(
             del active[a.rec.job_id]
             sample(t)
         elif kind == "cutoff":
-            # horizon cutoff: final snapshot for a still-running job; the
-            # job stays unfinished (end_state None) like its jobs.csv row
+            # horizon cutoff: final snapshot for a still-active job; the
+            # job stays unfinished (end_state None) like its jobs.csv row.
+            # For queued/suspended jobs the engine already closed the wait
+            # interval into this record's blame snapshot — clear the open
+            # cause so the end-of-stream close cannot double-charge it.
             leave_state(a, t)
             adopt_snapshot(a, ev, t)
+            adopt_blame(a, ev)
             settle_bw(a, t)
             a.t_state = t
+            if a.state != RUNNING:
+                a.cause = None
 
     if header is None and require_header:
         # zero-record stream: the in-loop guard never saw a first record
@@ -755,11 +973,32 @@ def analyze_events(
             "analyze (pass require_header=False to accept bare streams)"
         )
     sample(end_t)  # close the last integration interval
+    # close open wait intervals (ISSUE 5): a job still queued/suspended
+    # when the stream ends got no closing event, so charge its open
+    # interval to its blame cause here — the engine performs the same
+    # close at the same time with the same floats (_close_attribution),
+    # which is what keeps the aggregate closure exact for unfinished jobs
+    for a in active.values():
+        if a.cause is not None and a.state in (QUEUED, SUSPENDED):
+            dt = end_t - a.t_state
+            if dt > 0.0:
+                a.rec.delay_legs[a.cause] = (
+                    a.rec.delay_legs.get(a.cause, 0.0) + dt
+                )
     net_link_means: Dict[str, float] = {}
     for name, (last_t_l, util, area, first_t) in sorted(net_acc.items()):
         area += util * (end_t - last_t_l)  # hold the last value to the end
         span = end_t - first_t
         net_link_means[name] = area / span if span > 0 else util
+    mean_phys: Optional[float] = None
+    if samp_acc is not None and header and header.total_chips:
+        last_t_s, last_used_s, area_s, first_t_s = samp_acc
+        area_s += last_used_s * (end_t - last_t_s)  # hold last to the end
+        span = end_t - first_t_s  # first_t_s is 0.0: the demand mean's span
+        mean_phys = (
+            (area_s / span) / header.total_chips if span > 0
+            else last_used_s / header.total_chips
+        )
 
     analysis = RunAnalysis(
         header=header,
@@ -776,6 +1015,8 @@ def analyze_events(
         max_progress_drift=max_drift,
         net_links=net_links,
         net_link_means=net_link_means,
+        sample_series=sample_series,
+        mean_phys_occupancy=mean_phys,
     )
     return analysis
 

@@ -29,7 +29,7 @@ from __future__ import annotations
 import json
 import math
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 from gpuschedule_tpu.obs.analyze import RunAnalysis, SchemaError
 
@@ -242,6 +242,147 @@ def compare_runs(
         run_b=b.header.to_json() if b.header else {},
         rows=rows,
     )
+
+
+@dataclass
+class MatrixResult:
+    """An n-way policy x metric comparison (ISSUE 5 satellite — the
+    ROADMAP "compare diffs exactly two runs" omission, retired).
+
+    Unlike the two-run gate, the matrix ranks: for every gated metric the
+    best and worst run are marked (polarity-aware — best avg_jct is the
+    smallest, best num_finished the largest).  Informational metrics are
+    listed unranked.  There is no pass/fail here; gating stays the
+    two-run form's job, so its exit-code contract is untouched."""
+
+    runs: List[dict]                       # header summaries, column order
+    labels: List[str]                      # unique column labels
+    metrics: Dict[str, List[Optional[float]]] = field(default_factory=dict)
+    best: Dict[str, Optional[int]] = field(default_factory=dict)
+    worst: Dict[str, Optional[int]] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "runs": self.runs,
+            "labels": self.labels,
+            "metrics": {
+                m: {
+                    "values": vals,
+                    "best": self.best.get(m),
+                    "worst": self.worst.get(m),
+                    "gated": m in GATED_METRICS,
+                }
+                for m, vals in self.metrics.items()
+            },
+        }
+
+    def format_table(self) -> str:
+        """Text matrix, one column per run: gated metrics first, best
+        value marked ``*`` and worst ``!`` (never color- or
+        position-only; the legend line spells it out)."""
+
+        def fmt(v: Optional[float]) -> str:
+            if v is None:
+                return "-"
+            if v != v:
+                return "nan"
+            return f"{v:.6g}"
+
+        # 14 fits "-1.23457e+06" plus the best/worst mark and a gap
+        width = max(14, max((len(l) for l in self.labels), default=12) + 2)
+        lines = [
+            f"{len(self.labels)}-way compare (* best, ! worst per gated metric)"
+        ]
+        for i, (label, run) in enumerate(zip(self.labels, self.runs)):
+            lines.append(f"  col {i + 1}: {label} — {_ident(run)}")
+        lines.append(
+            "metric".ljust(32)
+            + "".join(label.rjust(width) for label in self.labels)
+        )
+        ordered = sorted(
+            self.metrics, key=lambda m: (m not in GATED_METRICS, m)
+        )
+        for m in ordered:
+            cells = []
+            for i, v in enumerate(self.metrics[m]):
+                mark = (
+                    "*" if self.best.get(m) == i
+                    else ("!" if self.worst.get(m) == i else " ")
+                )
+                cells.append(f"{fmt(v)}{mark}".rjust(width))
+            lines.append(m.ljust(32) + "".join(cells))
+        return "\n".join(lines)
+
+
+def _unique_labels(analyses: Sequence[RunAnalysis]) -> List[str]:
+    """Column labels: the policy name, disambiguated with the run_id when
+    two runs share one (pre-vs-post runs of the same policy), and with
+    the column index when even the run_ids collide (run_id is
+    deterministic, so same-policy same-world captures all share it)."""
+    policies = [
+        (a.header.policy or f"run{i + 1}") if a.header else f"run{i + 1}"
+        for i, a in enumerate(analyses)
+    ]
+    labels = []
+    for i, p in enumerate(policies):
+        if policies.count(p) > 1:
+            rid = analyses[i].header.run_id if analyses[i].header else ""
+            labels.append(f"{p}#{i + 1}" if not rid else f"{p}@{rid[-6:]}")
+        else:
+            labels.append(p)
+    dupes = {label for label in labels if labels.count(label) > 1}
+    return [
+        f"{label}#{i + 1}" if label in dupes else label
+        for i, label in enumerate(labels)
+    ]
+
+
+def compare_matrix(
+    analyses: Sequence[RunAnalysis], *, allow_mismatch: bool = False
+) -> MatrixResult:
+    """Build the n-way policy x metric matrix over ``analyses`` (>= 2).
+
+    Every run must be comparable with the first — same seeded world
+    (seed + config_hash), the exact rule the two-run gate applies —
+    unless ``allow_mismatch``.  Best/worst are only awarded on gated
+    metrics where at least two values exist and they actually differ
+    (an all-equal row has no winner)."""
+    analyses = list(analyses)
+    if len(analyses) < 2:
+        raise ValueError("compare_matrix needs at least two runs")
+    for other in analyses[1:]:
+        check_comparable(analyses[0], other, allow_mismatch=allow_mismatch)
+    flats = [flatten_metrics(a) for a in analyses]
+    names = sorted(set().union(*flats))
+    metrics: Dict[str, List[Optional[float]]] = {}
+    best: Dict[str, Optional[int]] = {}
+    worst: Dict[str, Optional[int]] = {}
+    for m in names:
+        vals = [f.get(m) for f in flats]
+        metrics[m] = vals
+        polarity = GATED_METRICS.get(m)
+        best[m] = worst[m] = None
+        if polarity is None:
+            continue
+        present = [(v, i) for i, v in enumerate(vals)
+                   if v is not None and v == v]
+        if len(present) < 2 or all(v == present[0][0] for v, _ in present):
+            continue
+        # polarity +1: bigger is worse -> best is the minimum
+        ranked = sorted(present, key=lambda p: (polarity * p[0], p[1]))
+        best[m], worst[m] = ranked[0][1], ranked[-1][1]
+    return MatrixResult(
+        runs=[a.header.to_json() if a.header else {} for a in analyses],
+        labels=_unique_labels(analyses),
+        metrics=metrics,
+        best=best,
+        worst=worst,
+    )
+
+
+def write_matrix_json(result: MatrixResult, path) -> None:
+    with open(path, "w") as f:
+        json.dump(result.to_json(), f, indent=2, sort_keys=True)
 
 
 def parse_thresholds(specs) -> tuple:

@@ -234,6 +234,27 @@ def trace_events(events: Iterable[dict]) -> List[dict]:
             track = f"net/{ev.get('link', '?')}"
             close_net(track, t_us)
             open_net[track] = (t_us, extra)
+        elif kind == "sample":
+            # periodic cluster samples (ISSUE 5) become counter tracks
+            # ("ph":"C") under a "cluster" process: physical occupancy
+            # (used + health-masked chips stack) and queue depth — the
+            # two signals ui.perfetto.dev graphs as area charts above
+            # the per-pod occupancy timelines
+            pid, tid = ids.ids("cluster/occupancy")
+            timed.append({
+                "name": "physical chips", "cat": "sample", "ph": "C",
+                "ts": t_us, "pid": pid, "tid": tid,
+                "args": {
+                    "used": ev.get("used", 0),
+                    "unhealthy": ev.get("unhealthy", 0),
+                },
+            })
+            pid, tid = ids.ids("cluster/queue")
+            timed.append({
+                "name": "pending jobs", "cat": "sample", "ph": "C",
+                "ts": t_us, "pid": pid, "tid": tid,
+                "args": {"pending": ev.get("pending", 0)},
+            })
         # arrival / speed / rationale-only events carry no timeline geometry
 
     # horizon cutoff: unfinished occupancies and unrepaired outages extend

@@ -235,17 +235,29 @@ def _multi_step_chart(
     t_max: float,
     v_max: float = 1.0,
     y_fmt=_fmt_pct,
+    cap_line: Optional[float] = None,
 ) -> str:
     """Several step-after series on one axis (the network panel's link-
-    utilization view).  Identity is never color-alone: each line ends in
-    a direct label and carries a native-tooltip ``<title>``."""
+    utilization view; the occupancy panel's demand-vs-physical overlay).
+    Identity is never color-alone: each line ends in a direct label and
+    carries a native-tooltip ``<title>``."""
     series = [(n, pts) for n, pts in series if pts]
     if not series:
         return '<p class="empty">no samples</p>'
+    if cap_line is not None:
+        v_max = max(v_max, cap_line)
     unit_div, unit = _time_axis(t_max)
     parts = ['<svg viewBox="0 0 %d %d" role="img" aria-label="%s">'
              % (_W, _H, _esc(label))]
     parts += _grid_and_axes(t_max, v_max, unit_div, unit, y_fmt=y_fmt)
+    if cap_line is not None:
+        _, cy = _xy(0.0, cap_line, t_max, v_max)
+        parts.append(
+            f'<line class="cap" x1="{_ML}" y1="{cy:.1f}" '
+            f'x2="{_W - _MR}" y2="{cy:.1f}"/>'
+            f'<text class="tick" x="{_ML + 4}" y="{cy - 4:.1f}">'
+            f"capacity {_esc(_fmt_num(cap_line))}</text>"
+        )
     for i, (name, pts) in enumerate(series):
         var = _SERIES_VARS[i % len(_SERIES_VARS)]
         pts = _decimate(pts)
@@ -334,28 +346,44 @@ def _cdf_chart(series: List[Tuple[str, str, List[float]]], label: str) -> str:
     return "".join(parts)
 
 
-def _stacked_goodput_bar(gp: dict) -> str:
-    """Part-to-whole: one horizontal stacked bar of the goodput legs,
-    2px surface gaps between segments, labels inside where they fit."""
-    legs = [
-        ("useful", gp["useful_chip_s"], "--series-1"),
-        ("lost", gp["lost_chip_s"], "--series-2"),
-        ("restart overhead", gp["restart_overhead_chip_s"], "--series-3"),
-    ]
-    total = gp["total_chip_s"]
+def _stacked_bar(
+    legs: List[Tuple[str, float]],
+    *,
+    label: str,
+    unit: str = "chip-s",
+    empty_note: str = "nothing to decompose",
+) -> str:
+    """Part-to-whole: one horizontal stacked bar, 2px surface gaps
+    between segments, labels inside where they fit, legend below so
+    identity is never color-alone.  Negative legs (an elastic-speedup
+    ``policy-share``) cannot be drawn as area; they are skipped in the
+    bar but still listed in the legend with their sign."""
+    total = sum(v for _, v in legs if v > 0)
     if total <= 0:
-        return '<p class="empty">no service accrued</p>'
-    w, h, y0, bh = 860, 64, 8, 24
-    parts = [f'<svg viewBox="0 0 {w} {h}" role="img" aria-label="goodput">']
+        return f'<p class="empty">{_esc(empty_note)}</p>'
+    w, y0, bh = 860, 8, 24
+    colored = [
+        (name, v, _SERIES_VARS[i % len(_SERIES_VARS)])
+        for i, (name, v) in enumerate(legs)
+    ]
+    # legend wraps into rows (the JCT decomposition can carry 8 legs —
+    # a single 860px row would clip entries past the viewBox edge) and
+    # the viewBox grows to fit every row
+    lw = 210
+    per_row = max(1, w // lw)
+    legend_rows = (len(colored) + per_row - 1) // per_row
+    h = y0 + bh + 10 + legend_rows * 16 + 4
+    parts = [f'<svg viewBox="0 0 {w} {h}" role="img" '
+             f'aria-label="{_esc(label)}">']
     x = 0.0
-    for name, v, var in legs:
+    for name, v, var in colored:
         seg = (v / total) * (w - 4)
         if seg <= 0:
             continue
         parts.append(
             f'<rect x="{x:.1f}" y="{y0}" width="{max(0.0, seg - 2):.1f}" '
             f'height="{bh}" rx="4" fill="var({var})">'
-            f"<title>{_esc(name)}: {_esc(_fmt_num(v))} chip-s "
+            f"<title>{_esc(name)}: {_esc(_fmt_num(v))} {_esc(unit)} "
             f"({_esc(_fmt_pct(v / total))})</title></rect>"
         )
         if seg > 150:  # label inside only when it comfortably fits
@@ -364,17 +392,33 @@ def _stacked_goodput_bar(gp: dict) -> str:
                 f"{_esc(name)} {_esc(_fmt_pct(v / total))}</text>"
             )
         x += seg
-    lx = 0.0
-    for name, v, var in legs:  # legend: identity never color-alone
+    for i, (name, v, var) in enumerate(colored):
+        # legend: identity never color-alone; wrapped so every entry
+        # stays inside the viewBox
+        lx = (i % per_row) * lw
+        ly = y0 + bh + 10 + (i // per_row) * 16
         parts.append(
-            f'<rect x="{lx:.1f}" y="{y0 + bh + 10}" width="10" height="10" '
+            f'<rect x="{lx:.1f}" y="{ly}" width="10" height="10" '
             f'rx="2" fill="var({var})"/>'
-            f'<text class="tick" x="{lx + 14:.1f}" y="{y0 + bh + 19}">'
-            f"{_esc(name)} {_esc(_fmt_num(v))} chip-s</text>"
+            f'<text class="tick" x="{lx + 14:.1f}" y="{ly + 9}">'
+            f"{_esc(name)} {_esc(_fmt_num(v))} {_esc(unit)}</text>"
         )
-        lx += 240
     parts.append("</svg>")
     return "".join(parts)
+
+
+def _stacked_goodput_bar(gp: dict) -> str:
+    """The goodput decomposition as a part-to-whole stacked bar."""
+    if gp["total_chip_s"] <= 0:
+        return '<p class="empty">no service accrued</p>'
+    return _stacked_bar(
+        [
+            ("useful", gp["useful_chip_s"]),
+            ("lost", gp["lost_chip_s"]),
+            ("restart overhead", gp["restart_overhead_chip_s"]),
+        ],
+        label="goodput",
+    )
 
 
 # --------------------------------------------------------------------- #
@@ -559,6 +603,37 @@ def _slowest_jobs_table(analysis: RunAnalysis, n: int = 10) -> str:
     )
 
 
+def _occupancy_chart(
+    analysis: RunAnalysis,
+    occ_pts: List[Tuple[float, float]],
+    t_max: float,
+    total_chips: Optional[int],
+) -> str:
+    """The occupancy panel's chart: the demand series alone (historic
+    view), or — when the run carried cluster ``sample`` events — demand
+    overlaid on *physical* occupancy.  Demand above physical is overlay
+    packing made visible (the ROADMAP PR-3 demand-only omission,
+    retired); physical above zero while demand gaps are health holes."""
+    phys_pts = [(t, float(u)) for t, u, _, _ in analysis.sample_series]
+    if not phys_pts:
+        return _step_series_chart(
+            occ_pts, series_var="--series-1", label="chips allocated",
+            t_max=t_max,
+            cap_line=float(total_chips) if total_chips else None,
+        )
+    v_max = max(
+        max((v for _, v in occ_pts), default=1.0),
+        max(v for _, v in phys_pts),
+        1.0,
+    )
+    return _multi_step_chart(
+        [("demand", occ_pts), ("physical", phys_pts)],
+        label="chip occupancy: demand vs physical",
+        t_max=t_max, v_max=v_max, y_fmt=_fmt_num,
+        cap_line=float(total_chips) if total_chips else None,
+    )
+
+
 def render_report(analysis: RunAnalysis, *, title: Optional[str] = None) -> str:
     """The whole report as one HTML string (write it anywhere; it never
     references the network or the filesystem)."""
@@ -599,7 +674,9 @@ def render_report(analysis: RunAnalysis, *, title: Optional[str] = None) -> str:
         _tile("p99 wait", _fmt_dur(dists["wait"]["p99"]),
               f"p50 {_fmt_dur(dists['wait']['p50'])}"),
         _tile("Mean occupancy", _fmt_pct(s["mean_occupancy"]),
-              f"frag {_fmt_pct(s['mean_fragmentation'])}"),
+              (f"physical {_fmt_pct(s['mean_phys_occupancy'])} · "
+               if s.get("mean_phys_occupancy") is not None else "")
+              + f"frag {_fmt_pct(s['mean_fragmentation'])}"),
         _tile("Useful goodput", _fmt_pct(s["useful_frac"]),
               f"{_fmt_num(gp['total_chip_s'])} chip-s total"),
     ]
@@ -633,6 +710,44 @@ def render_report(analysis: RunAnalysis, *, title: Optional[str] = None) -> str:
   {drop_note}
   {_net_links_table(analysis, net)}
   {_net_jobs_table(net)}
+</div>"""
+
+    # Attribution panel (ISSUE 5): where wait and JCT time went, cause by
+    # cause — rendered only for attribution-armed captures.
+    attrib_panel = ""
+    legs = analysis.delay_by_cause()
+    if legs:
+        at = analysis.attribution()
+        wait_total = sum(at["wait_s"].values())
+        cause_rows = "".join(
+            f"<tr><td>{_esc(k)}</td>"
+            f"<td>{_esc(_fmt_dur(v))}</td>"
+            f"<td>{_esc(_fmt_pct(v / wait_total) if wait_total > 0 else '–')}</td>"
+            f"<td>{_esc(_fmt_num(at['chip_demand_wait_s'].get(k)))}</td></tr>"
+            for k, v in at["wait_s"].items()
+        )
+        run_rows = "".join(
+            f"<tr><td>{_esc(k)}</td><td>{_esc(_fmt_dur(v))}</td>"
+            f"<td>–</td><td>–</td></tr>"
+            for k, v in at["run_s"].items()
+        )
+        jct_legs = [(k, v) for k, v in (*at["wait_s"].items(),
+                                        *at["run_s"].items())]
+        attrib_panel = f"""
+<h2>Attribution — why was time lost?</h2>
+<div class="panel">
+  <p class="meta">per-cause wait across all jobs (the blame decomposition;
+  legs sum to the analyzer's wait exactly)</p>
+  {_stacked_bar(list(at['wait_s'].items()), label='wait by cause',
+                unit='s', empty_note='no job ever waited')}
+  <p class="meta">full JCT decomposition: waits + work + slowdown
+  stretches + restart overhead</p>
+  {_stacked_bar(jct_legs, label='time by leg', unit='s')}
+  <table><thead><tr><th>leg</th><th>seconds</th><th>share of wait</th>
+  <th>chip-demand-s</th></tr></thead>
+  <tbody>{cause_rows}{run_rows}</tbody></table>
+  <p class="meta">decomposition residuals: wait
+  {at['max_wait_residual']:.2e} · JCT {at['max_jct_residual']:.2e}</p>
 </div>"""
 
     fault_panel = ""
@@ -671,8 +786,7 @@ def render_report(analysis: RunAnalysis, *, title: Optional[str] = None) -> str:
 
 <h2>Chip occupancy</h2>
 <div class="panel">
-{_step_series_chart(occ_pts, series_var='--series-1', label='chips allocated',
-                    t_max=t_max, cap_line=float(total_chips) if total_chips else None)}
+{_occupancy_chart(analysis, occ_pts, t_max, total_chips)}
 </div>
 
 <h2>Pending queue</h2>
@@ -686,6 +800,7 @@ def render_report(analysis: RunAnalysis, *, title: Optional[str] = None) -> str:
 {_cdf_chart([('wait', '--series-1', waits), ('JCT', '--series-2', jcts)],
             'wait and JCT CDF')}
 </div>
+{attrib_panel}
 {net_panel}
 {fault_panel}
 <h2>Distributions</h2>

@@ -10,13 +10,22 @@ global JOBS/CLUSTER singletons (SURVEY.md §3.1), minus the globals.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional
 
 
 class Policy:
     """Base class for scheduling policies."""
 
     name: str = "base"
+
+    #: Machine-parseable cause codes (ISSUE 5): maps each human-readable
+    #: ``explain()`` rule string to a short stable token.  When a run is
+    #: captured with attribution armed (``MetricsLog(attribution=True)``),
+    #: every rationale record additionally carries
+    #: ``code = "<policy>/<token>"`` — the key the analyzer's blame
+    #: tables group preemptions by.  The tokens are a compatibility
+    #: surface: renaming a rule string must keep its token.
+    rule_codes: Dict[str, str] = {}
 
     def attach(self, sim) -> None:
         """Called once before the run starts; override for setup that needs
@@ -30,15 +39,29 @@ class Policy:
         the structured event stream is on.  Policies hoist this check once
         per ``schedule()`` call so the disabled path never constructs a
         rationale dict (the tools/check_overhead.py zero-overhead
-        contract)."""
+        contract).  Also latches whether this run wants machine-parseable
+        cause codes stamped on rationale records (attribution armed) —
+        off-path streams must stay byte-identical, so ``explain()`` adds
+        the ``code`` field only then."""
+        self._stamp_codes = bool(getattr(sim.metrics, "attribution", False))
         return sim.metrics.record_events
+
+    def cause_code(self, rule: str) -> str:
+        """The stable machine-parseable token for a rule:
+        ``<policy>/<rule_codes[rule]>`` (falling back to the rule string
+        itself for rules without a table entry)."""
+        return f"{self.name}/{self.rule_codes.get(rule, rule)}"
 
     def explain(self, rule: str, **detail) -> dict:
         """One scheduling-rationale record: which rule fired and the numbers
         behind it (queue rank, quantum age, goodput delta, ...).  Passed as
         the ``why=`` argument of the engine's mutation API, which persists
-        it on the corresponding event in the run's event stream."""
+        it on the corresponding event in the run's event stream.  Under
+        attribution the record leads with its ``code`` so blame tables
+        never have to parse the human-readable rule text."""
         d = {"policy": self.name, "rule": rule}
+        if getattr(self, "_stamp_codes", False):
+            d["code"] = self.cause_code(rule)
         d.update(detail)
         return d
 

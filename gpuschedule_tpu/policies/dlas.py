@@ -28,7 +28,11 @@ import bisect
 from typing import Optional, Sequence
 
 from gpuschedule_tpu.policies.base import Policy
-from gpuschedule_tpu.policies.preemptive import active_jobs, apply_priority_schedule
+from gpuschedule_tpu.policies.preemptive import (
+    PRIORITY_RULE_CODES,
+    active_jobs,
+    apply_priority_schedule,
+)
 from gpuschedule_tpu.sim.job import Job, JobState
 
 # Default queue thresholds in chip-seconds: Q0 -> Q1 after one chip-hour,
@@ -38,6 +42,9 @@ DEFAULT_THRESHOLDS = (3600.0, 36000.0)
 
 class DlasPolicy(Policy):
     name = "dlas"
+
+    # shared prefix-preemption cause codes (attribution layer, ISSUE 5)
+    rule_codes = PRIORITY_RULE_CODES
 
     def __init__(
         self,

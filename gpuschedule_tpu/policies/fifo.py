@@ -18,6 +18,12 @@ from gpuschedule_tpu.policies.base import Policy
 class FifoPolicy(Policy):
     name = "fifo"
 
+    # stable cause-code tokens for the attribution layer (ISSUE 5)
+    rule_codes = {
+        "arrival-order-head": "head",
+        "backfill": "backfill",
+    }
+
     def __init__(self, *, backfill: bool = False):
         self.backfill = backfill
 

@@ -47,6 +47,21 @@ DEFAULT_GROWTH_CURVE = GoodputCurve((1.0, 0.0, 1e-4))
 class GandivaPolicy(Policy):
     name = "gandiva"
 
+    # stable cause-code tokens (attribution layer, ISSUE 5): one per
+    # rationale rule this policy emits, grouped by mechanism — time-slice
+    # rotation, overlay packing, and migration
+    rule_codes = {
+        "quantum-expired": "quantum",
+        "longest-waiting": "resume",
+        "pack-low-utilization": "pack",
+        "pack-contention": "pack-net",
+        "pack-dissolved": "unpack",
+        "evacuate-degraded-pod": "evacuate",
+        "defrag-for-blocked-waiter": "defrag",
+        "shrink-for-demand": "shrink",
+        "grow-into-idle": "grow",
+    }
+
     def __init__(
         self,
         *,

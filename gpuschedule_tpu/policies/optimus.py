@@ -41,6 +41,15 @@ DEFAULT_CURVE = GoodputCurve((1.0, 0.0, 1e-4))
 class OptimusPolicy(Policy):
     name = "optimus"
 
+    # stable cause-code tokens (attribution layer, ISSUE 5): the four
+    # moves the marginal-gain planner can make on a job each round
+    rule_codes = {
+        "plan-evicted": "evict",
+        "plan-shrink": "shrink",
+        "plan-grow": "grow",
+        "plan-start": "start",
+    }
+
     def __init__(
         self,
         *,

@@ -21,6 +21,15 @@ from typing import Callable, List, Optional, Sequence
 from gpuschedule_tpu.sim.job import Job, JobState
 from gpuschedule_tpu.sim.overhead import resolve_overhead
 
+# Machine-parseable cause codes (ISSUE 5) for the two rationale rules this
+# shared prefix-preemption step emits; every policy built on it (SRTF /
+# DLAS / Themis) adopts the table so blame analysis sees the same stable
+# tokens whichever priority currency ranked the prefix.
+PRIORITY_RULE_CODES = {
+    "displaced-by-priority-prefix": "displace",
+    "priority-prefix": "start",
+}
+
 
 def apply_priority_schedule(
     sim,

@@ -15,11 +15,18 @@ from __future__ import annotations
 from typing import Optional
 
 from gpuschedule_tpu.policies.base import Policy
-from gpuschedule_tpu.policies.preemptive import active_jobs, apply_priority_schedule
+from gpuschedule_tpu.policies.preemptive import (
+    PRIORITY_RULE_CODES,
+    active_jobs,
+    apply_priority_schedule,
+)
 
 
 class SrtfPolicy(Policy):
     name = "srtf"
+
+    # shared prefix-preemption cause codes (attribution layer, ISSUE 5)
+    rule_codes = PRIORITY_RULE_CODES
 
     def __init__(self, *, restart_overhead: float = 0.0):
         self.restart_overhead = restart_overhead

@@ -40,7 +40,11 @@ from __future__ import annotations
 from typing import Optional
 
 from gpuschedule_tpu.policies.base import Policy
-from gpuschedule_tpu.policies.preemptive import active_jobs, apply_priority_schedule
+from gpuschedule_tpu.policies.preemptive import (
+    PRIORITY_RULE_CODES,
+    active_jobs,
+    apply_priority_schedule,
+)
 from gpuschedule_tpu.sim.job import Job, JobState
 
 _EPS = 1e-9
@@ -70,6 +74,9 @@ def finish_time_rho(job: Job, now: float) -> float:
 
 class ThemisPolicy(Policy):
     name = "themis"
+
+    # shared prefix-preemption cause codes (attribution layer, ISSUE 5)
+    rule_codes = PRIORITY_RULE_CODES
 
     def __init__(
         self,

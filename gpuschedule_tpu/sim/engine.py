@@ -34,8 +34,10 @@ from gpuschedule_tpu.sim.metrics import MetricsLog, SimResult
 # job finishing exactly when its chips fail completed first — nothing to
 # revoke), repairs land after the fault that scheduled them (a zero-length
 # outage still revokes, then heals, within one batch), and the policy runs
-# once after the whole batch.
-_COMPLETION, _ARRIVAL, _TICK, _FAULT, _REPAIR = 0, 1, 2, 3, 4
+# once after the whole batch.  Cluster samples (ISSUE 5) sort last so a
+# sample coinciding with real events snapshots the post-fault/repair state
+# of that instant (though still before the policy pass reacts to it).
+_COMPLETION, _ARRIVAL, _TICK, _FAULT, _REPAIR, _SAMPLE = 0, 1, 2, 3, 4, 5
 
 
 def _prog(job: Job) -> dict:
@@ -74,6 +76,7 @@ class Simulator:
         eps: float = 1e-6,
         faults=None,
         net=None,
+        sample_interval: Optional[float] = None,
     ):
         self.cluster = cluster
         self.policy = policy
@@ -103,6 +106,25 @@ class Simulator:
         self.metrics.attach_jobs(self.jobs)
         self.max_time = max_time
         self.eps = eps
+        # Causal attribution (ISSUE 5 tentpole): armed by the metrics log
+        # (MetricsLog(attribution=True) / CLI --attrib).  Arms each job's
+        # ``attrib`` leg dict; everything else is gated on this flag so
+        # the off path stays byte-identical to the pre-attribution engine.
+        self.attribution = bool(getattr(self.metrics, "attribution", False))
+        if self.attribution:
+            for job in self.jobs:
+                job.attrib = {}
+        # Periodic cluster-side samples (ISSUE 5): every ``sample_interval``
+        # sim seconds a ``sample`` event snapshots physical occupancy,
+        # health-masked chips, fragmentation and queue depth straight from
+        # the cluster flavor.  Samples never mark the batch dirty (no
+        # policy invocation, no replay perturbation) and stop re-arming
+        # once only ticks/samples remain in the heap.
+        if sample_interval is not None and sample_interval <= 0.0:
+            raise ValueError(
+                f"sample_interval must be > 0, got {sample_interval}"
+            )
+        self.sample_interval = sample_interval
         # Observability (obs/): the span tracer is a process singleton whose
         # ``enabled`` flag picks the run loop — the disabled path is the
         # uninstrumented loop verbatim (tools/check_overhead.py guards that
@@ -122,6 +144,10 @@ class Simulator:
 
         for job in self.jobs:
             self._push(job.submit_time, _ARRIVAL, job)
+        if self.sample_interval is not None:
+            # first sample one interval in (a t=0 sample of an empty
+            # cluster carries no information)
+            self._push(self.sample_interval, _SAMPLE)
         # _drain_faults: records remain in the heap after every job has
         # reached an end state (the schedule is generated to a conservative
         # horizon); the run loops discard them by stopping early.  False
@@ -152,7 +178,10 @@ class Simulator:
     # event plumbing
 
     def _push(self, time: float, kind: int, payload=None, epoch: int = 0) -> None:
-        if kind != _TICK:
+        # ticks and samples are excluded from _nonticks: neither can change
+        # scheduler-visible state, so _quiesced()'s "only residue remains"
+        # test (and the sample re-arm cutoff) ignores them
+        if kind != _TICK and kind != _SAMPLE:
             self._nonticks += 1
         heapq.heappush(self._heap, (time, kind, next(self._seq), payload, epoch))
 
@@ -176,6 +205,80 @@ class Simulator:
         dependent field (single site: placement quality feeds progress)."""
         job.allocation = alloc
         job.locality_factor = getattr(alloc.detail, "speed_factor", 1.0)
+
+    # ------------------------------------------------------------------ #
+    # causal attribution (ISSUE 5): blame tagging + cluster sampling
+
+    def _queue_cause(self, job: Job) -> str:
+        """Blame for a queued-at-arrival interval, decided from cluster
+        state at event time: ``capacity`` when not even unhealthy chips
+        would cover the gang, ``fault-outage`` when health-masked chips
+        are what's missing, ``admission`` when enough nominally-free
+        healthy chips exist — the delay is slice geometry or scheduler
+        ordering, not a resource shortage."""
+        free = self.cluster.free_chips
+        if free >= job.num_chips:
+            return "admission"
+        if free + self.cluster.unhealthy_chips >= job.num_chips:
+            return "fault-outage"
+        return "capacity"
+
+    def _open_blame(self, job: Job, cause: str) -> None:
+        job.blame_cause = cause
+        job.blame_since = self.now
+
+    def _close_blame(self, job: Job) -> None:
+        """Charge the open queued/suspended interval to its cause (exact
+        cumulative floats; the analyzer adopts them from event snapshots
+        and SimResult sums them with the same arithmetic)."""
+        cause = job.blame_cause
+        if cause is None:
+            return
+        dt = self.now - job.blame_since
+        if dt > 0.0:
+            job.attrib[cause] = job.attrib.get(cause, 0.0) + dt
+        job.blame_cause = None
+
+    def _close_attribution(self) -> None:
+        """End of run: close the open wait interval of every job still in
+        the pending set (queued or suspended), so SimResult's per-cause
+        aggregate covers the full simulated span.
+
+        Each closed job also gets a terminal ``cutoff`` record carrying
+        the final legs: the run can end *later* than the last lifecycle
+        event (a max_time horizon with nothing running, a stale-
+        completion drain), and without a record at ``self.now`` the
+        analyzer's stream would end early and its end-of-stream close
+        would stop short — silently losing the wait tail (review-
+        confirmed regression, pinned by
+        tests/test_attrib.py::test_closure_holds_at_horizon_with_nothing_running)."""
+        if not self.attribution:
+            return
+        record = self.metrics.record_events
+        for job in self.pending:
+            if job.blame_cause is None:
+                continue
+            self._close_blame(job)
+            if record:
+                self.metrics.event(
+                    "cutoff", self.now, job, chips=0, blame=dict(job.attrib)
+                )
+
+    def _emit_sample(self, t: float) -> None:
+        """One periodic cluster-side ``sample`` event: *physical*
+        occupancy (overlay-packed guests consume no extra chips, unlike
+        the demand series the analyzer derives from start events),
+        health-masked chips, fragmentation, and queue depth — straight
+        from the cluster flavor's :meth:`sample_state`.  A no-op without
+        the event stream, so the sampling-on/events-off path costs only
+        the heap traffic (tools/check_overhead.py gates it)."""
+        if not self.metrics.record_events:
+            return
+        self.metrics.event(
+            "sample", t, None,
+            running=len(self.running), pending=len(self.pending),
+            **self.cluster.sample_state(),
+        )
 
     # ------------------------------------------------------------------ #
     # policy-facing mutation API
@@ -210,6 +313,8 @@ class Simulator:
         if alloc is None:
             return False
         job.advance(self.now)
+        if self.attribution:
+            self._close_blame(job)
         self._bind_allocation(job, alloc)
         job.allocated_chips = chips
         job.state = JobState.RUNNING
@@ -228,6 +333,8 @@ class Simulator:
                      "track": track_label(alloc.detail), "prog": _prog(job)}
             if why is not None:
                 extra["why"] = why
+            if self.attribution:
+                extra["blame"] = dict(job.attrib)
             self.metrics.event("start", self.now, job, **extra)
         return True
 
@@ -254,10 +361,20 @@ class Simulator:
         self.running.remove(job)
         self.pending.append(job)
         self.metrics.count("preemptions")
+        if self.attribution:
+            # the whole wait that follows is blamed on this preemption,
+            # however long capacity later takes to reappear (cause decided
+            # at interval start — docs/events.md)
+            self._open_blame(job, "policy-preempt")
         if record:
             extra = {"suspend": suspend, "track": track, "prog": _prog(job)}
             if why is not None:
                 extra["why"] = why
+            if self.attribution:
+                extra["cause"] = "policy-preempt"
+                if why is not None and "code" in why:
+                    extra["cause_code"] = why["code"]
+                extra["blame"] = dict(job.attrib)
             self.metrics.event("preempt", self.now, job, **extra)
 
     def set_speed(self, job: Job, speed: float, *, why: Optional[dict] = None) -> None:
@@ -408,9 +525,12 @@ class Simulator:
         self.finished.append(job)
         self.metrics.record_job(job)
         if record:
+            extra = {}
+            if self.attribution:
+                extra = {"blame": dict(job.attrib)}
             self.metrics.event(
                 "finish", self.now, job, end_state=job.state.value, track=track,
-                prog=_prog(job),
+                prog=_prog(job), **extra,
             )
 
     # ------------------------------------------------------------------ #
@@ -575,16 +695,21 @@ class Simulator:
         self.running.remove(job)
         self.pending.append(job)
         self.metrics.count("fault_revocations")
+        if self.attribution:
+            self._open_blame(job, "fault-outage")
         if record:
             # exact floats (schema 1): the analyzer attributes this event's
             # lost work to its fault kind and closes the decomposition
             # against SimResult.goodput bit-for-bit — rounding here would
             # break the closure (docs/events.md)
+            extra = {}
+            if self.attribution:
+                extra = {"cause": "fault-outage", "blame": dict(job.attrib)}
             self.metrics.event(
                 "revoke", self.now, job,
                 scope=rec.label, fault=rec.kind,
                 lost_work=lost, restore=restore,
-                track=track, prog=_prog(job),
+                track=track, prog=_prog(job), **extra,
             )
 
     def _drain_batch(self, t: float) -> bool:
@@ -593,8 +718,18 @@ class Simulator:
         dirty = False
         while self._heap and self._heap[0][0] <= t:
             _, kind, _, payload, epoch = heapq.heappop(self._heap)
-            if kind != _TICK:
+            if kind != _TICK and kind != _SAMPLE:
                 self._nonticks -= 1
+            if kind == _SAMPLE:
+                # cluster-side snapshot: emit (when the event stream is on)
+                # and re-arm while real events remain — sampling past the
+                # last arrival/completion/fault would only pad the stream.
+                # Never marks the batch dirty: the sampler observes, the
+                # replay must not feel it.
+                self._emit_sample(t)
+                if self._nonticks:
+                    self._push(t + self.sample_interval, _SAMPLE)
+                continue
             if kind == _ARRIVAL:
                 job: Job = payload
                 job.last_update_time = t
@@ -616,14 +751,20 @@ class Simulator:
                         self.metrics.event("reject", t, job, chips=job.num_chips)
                 else:
                     self.pending.append(job)
+                    cause = None
+                    if self.attribution:
+                        cause = self._queue_cause(job)
+                        self._open_blame(job, cause)
                     if self.metrics.record_events:
                         # duration/status ride along so the analyzer can
                         # derive slowdown and expected end states without
                         # re-reading the trace
-                        self.metrics.event(
-                            "arrival", t, job, chips=job.num_chips,
-                            duration=job.duration, status=job.status,
-                        )
+                        extra = {"chips": job.num_chips,
+                                 "duration": job.duration,
+                                 "status": job.status}
+                        if cause is not None:
+                            extra["cause"] = cause
+                        self.metrics.event("arrival", t, job, **extra)
                 dirty = True
             elif kind == _COMPLETION:
                 job = payload
@@ -684,12 +825,17 @@ class Simulator:
         self._advance_running(self.max_time)
         if self.metrics.record_events:
             for job in self.running:
+                extra = {}
+                if self.attribution:
+                    extra = {"blame": dict(job.attrib)}
                 self.metrics.event(
                     "cutoff", self.now, job,
                     chips=job.allocated_chips,
                     track=track_label(job.allocation.detail),
-                    prog=_prog(job),
+                    prog=_prog(job), **extra,
                 )
+            # waiting jobs get their horizon record from the end-of-run
+            # _close_attribution (which runs at this same self.now)
         self.metrics.sample(
             self.now, self.cluster, len(self.running), len(self.pending)
         )
@@ -734,6 +880,20 @@ class Simulator:
                 self._cutoff_at_horizon()
                 break
             self.now = t
+            if self._heap[0][1] == _SAMPLE:
+                # _SAMPLE sorts last at equal timestamps, so a sample on
+                # top means the whole batch is samples: nothing scheduler-
+                # visible changes and no progress needs integrating.
+                # Skipping the advance keeps every progress float chunked
+                # — and therefore the event stream byte-for-byte — exactly
+                # as in the sampling-free replay (the ISSUE 5 regression
+                # contract extends to sampling-on runs modulo the sample
+                # records themselves).
+                # deliberately no metrics.sample() either: an extra
+                # integration point would re-chunk the utilization
+                # integral and dust its low-order bits
+                self._drain_batch(t)
+                continue
             self._advance_running(t)
             if self._drain_batch(t):
                 wakeup = self.policy.schedule(self)
@@ -744,6 +904,7 @@ class Simulator:
             self.metrics.sample(self.now, self.cluster, len(self.running), len(self.pending))
         if self.net is not None:
             self.net.close(self.now)
+        self._close_attribution()
         return self.metrics.result(self.jobs, self.now)
 
     def _run_traced(self) -> SimResult:
@@ -761,6 +922,12 @@ class Simulator:
                     self._cutoff_at_horizon()
                     break
                 self.now = t
+                if self._heap[0][1] == _SAMPLE:
+                    # pure-sample batch: same skip as the plain loop (no
+                    # advance, no metrics.sample, no policy, no span —
+                    # the sampler observes, the replay must not feel it)
+                    self._drain_batch(t)
+                    continue
                 with tracer.span("sim.batch", cat="sim", sim_now=t) as sp:
                     self._advance_running(t)
                     dirty = self._drain_batch(t)
@@ -787,4 +954,5 @@ class Simulator:
             run_sp.set(batches=n_batches).end_sim(self.now)
         if self.net is not None:
             self.net.close(self.now)
+        self._close_attribution()
         return self.metrics.result(self.jobs, self.now)

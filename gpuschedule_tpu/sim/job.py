@@ -22,7 +22,33 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Any, Dict, Optional
+
+# Causal-attribution leg names (ISSUE 5 tentpole).  A job's whole lifetime
+# decomposes into these legs, each an exact cumulative float in
+# ``Job.attrib`` when attribution is armed:
+#
+# - WAIT_CAUSES blame queued/suspended intervals.  The cause is decided
+#   once, when the interval *starts* (the engine's blame rule at arrival /
+#   preempt / revoke time), and the whole interval is charged to it:
+#   ``capacity`` (not enough healthy chips existed), ``fault-outage``
+#   (enough chips existed but some were health-masked), ``admission``
+#   (enough nominally-free healthy chips existed — the delay is geometry
+#   or scheduler ordering, not resource shortage), ``policy-preempt``
+#   (the interval began with a policy preemption; the preempting rule's
+#   machine-parseable code rides on the event).
+# - RUN_LEGS split every running second: ``work`` (speed x locality — the
+#   reference-speed work-equivalent; sums to ~duration for a finished
+#   job), ``policy-share`` ((1-speed) — time-sliced packing / elastic
+#   shrink; negative when an elastic grow runs the job *faster* than its
+#   trace speed), ``net-degraded`` (speed x (1-locality) — interconnect
+#   stretch: DCN contention, static multislice toll, GPU locality tiers),
+#   ``overhead`` (modeled restart/migration/restore burn).
+#
+# The analyzer (obs/analyze.py) re-declares these names — the obs layer
+# never imports the sim package at module load; tests pin the two equal.
+WAIT_CAUSES = ("admission", "capacity", "fault-outage", "policy-preempt")
+RUN_LEGS = ("work", "policy-share", "net-degraded", "overhead")
 
 
 class JobState(enum.Enum):
@@ -115,6 +141,15 @@ class Job:
     arrival_seq: int = 0                # submit-order index assigned by the engine
                                         # (numeric FIFO tie-break; 'j2' < 'j10')
 
+    # ---- causal attribution (engine-owned, ISSUE 5) ----
+    # None keeps the attribution-off path allocation-free and byte-
+    # identical; the engine sets it to {} when attribution is armed and
+    # legs (WAIT_CAUSES / RUN_LEGS keys, exact cumulative seconds) appear
+    # lazily as they first accrue.
+    attrib: Optional[Dict[str, float]] = None
+    blame_cause: Optional[str] = None   # cause of the open queued interval
+    blame_since: float = 0.0            # when that interval started
+
     # scratch space for policies (queue index, profiling state, ...)
     sched: dict = field(default_factory=dict)
 
@@ -166,10 +201,28 @@ class Job:
             # chips are occupied but produce no work while overhead burns:
             # the restart-overhead leg of the goodput decomposition
             self.overhead_service += self.allocated_chips * burned
+            if self.attrib is not None:
+                self.attrib["overhead"] = self.attrib.get("overhead", 0.0) + burned
             dt -= burned
         if dt > 0.0:
             self.executed_work += self.effective_speed * dt
             self.attained_service += self.allocated_chips * dt
+            if self.attrib is not None:
+                # RUN_LEGS split of this productive interval: work +
+                # policy-share + net-degraded == dt in real arithmetic
+                # (s*l + (1-s) + s*(1-l) == 1); the decomposition's own
+                # ordered sum absorbs the float dust
+                a = self.attrib
+                a["work"] = a.get("work", 0.0) + self.effective_speed * dt
+                if self.speed != 1.0:
+                    a["policy-share"] = (
+                        a.get("policy-share", 0.0) + (1.0 - self.speed) * dt
+                    )
+                if self.locality_factor != 1.0:
+                    a["net-degraded"] = (
+                        a.get("net-degraded", 0.0)
+                        + self.speed * (1.0 - self.locality_factor) * dt
+                    )
 
     def jct(self) -> Optional[float]:
         """Job completion time (end - submit), once finished."""

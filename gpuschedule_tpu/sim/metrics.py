@@ -99,6 +99,13 @@ class SimResult:
     # can exceed physical occupancy — it equals it exactly when nothing
     # is packed.
     goodput: Dict[str, float] = field(default_factory=dict)
+    # Causal attribution (ISSUE 5): per-cause delay/run legs in seconds,
+    # summed over per-job ``Job.attrib`` dicts in arrival order — the same
+    # order and arithmetic obs/analyze.py uses, so the analyzer's
+    # ``delay_by_cause()`` equals this to the last float (the wait-
+    # decomposition closure, like the goodput one).  Empty unless the run
+    # was captured with ``MetricsLog(attribution=True)``.
+    delay_by_cause: Dict[str, float] = field(default_factory=dict)
     jobs: List[Job] = field(repr=False, default_factory=list)
 
     def summary(self) -> Dict[str, float]:
@@ -116,6 +123,12 @@ class SimResult:
             "num_failed": self.num_failed,
             "num_killed": self.num_killed,
             **{f"goodput_{k}": v for k, v in self.goodput.items()},
+            # only attribution-armed runs carry these keys, so the
+            # attribution-off stdout contract stays byte-identical
+            **{
+                f"delay_{k.replace('-', '_')}_s": v
+                for k, v in sorted(self.delay_by_cause.items())
+            },
             **{k: float(v) for k, v in self.counters.items()},
         }
 
@@ -139,8 +152,16 @@ class MetricsLog:
         events_sink: Optional[Union[str, Path, IO]] = None,
         registry=None,
         run_meta: Optional[dict] = None,
+        attribution: bool = False,
     ) -> None:
         self.job_rows: List[dict] = []
+        # Causal attribution (ISSUE 5): when True the engine blames every
+        # queued interval with its cause, splits running time into
+        # slowdown legs (sim/job.py WAIT_CAUSES / RUN_LEGS), and stamps
+        # the cumulative legs onto lifecycle events.  Off by default —
+        # the off path emits byte-identical streams, jobs.csv, and
+        # summaries (the ISSUE 5 regression contract).
+        self.attribution = bool(attribution)
         # Structured event stream (SURVEY.md §5 "Metrics/logging": CSVs plus
         # a structured JSONL event log).  Off by default: at Philly scale the
         # stream is ~10^6 dicts, so it is opt-in (CLI --events).
@@ -433,6 +454,15 @@ class MetricsLog:
             "restart_overhead_chip_s": overhead,
             "total_chip_s": attained + overhead,
         }
+        # Attribution legs summed per cause, jobs in arrival order with
+        # sorted keys per job — obs/analyze.py mirrors this arithmetic
+        # exactly, which is what makes the wait-decomposition closure
+        # bit-exact (same floats, same additions, same order).
+        delay_by_cause: Dict[str, float] = {}
+        for j in jobs:
+            if j.attrib:
+                for k in sorted(j.attrib):
+                    delay_by_cause[k] = delay_by_cause.get(k, 0.0) + j.attrib[k]
         return SimResult(
             avg_jct=sum(jcts) / len(jcts) if jcts else 0.0,
             makespan=makespan,
@@ -449,6 +479,7 @@ class MetricsLog:
             num_failed=states[JobState.FAILED],
             num_killed=states[JobState.KILLED],
             goodput=goodput,
+            delay_by_cause=delay_by_cause,
             jobs=list(jobs),
         )
 

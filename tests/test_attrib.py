@@ -1,0 +1,494 @@
+"""Causal attribution + cluster sampling (ISSUE 5): the blame/decomposition
+closures, the byte-identity regression contract, physical-vs-demand
+occupancy under packing, sample payloads per cluster flavor, Perfetto
+counter tracks, cause codes, and the n-way compare matrix."""
+
+from __future__ import annotations
+
+import json
+import math
+
+import pytest
+
+from gpuschedule_tpu.cli import main as cli_main
+from gpuschedule_tpu.cluster.base import SimpleCluster
+from gpuschedule_tpu.cluster.gpu import GpuCluster
+from gpuschedule_tpu.cluster.tpu import TpuCluster
+from gpuschedule_tpu.faults.recovery import FaultPlan, RecoveryModel
+from gpuschedule_tpu.faults.schedule import (
+    FaultConfig,
+    fault_horizon,
+    generate_fault_schedule,
+)
+from gpuschedule_tpu.faults.sweep import POLICY_CONFIGS
+from gpuschedule_tpu.net import NetModel
+from gpuschedule_tpu.net.sweep import promote_to_multislice
+from gpuschedule_tpu.obs.analyze import (
+    RUN_LEGS as ANALYZE_RUN_LEGS,
+    WAIT_CAUSES as ANALYZE_WAIT_CAUSES,
+    analyze_events,
+)
+from gpuschedule_tpu.obs.compare import compare_matrix
+from gpuschedule_tpu.obs.perfetto import trace_events, validate_chrome_trace
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.sim import Simulator
+from gpuschedule_tpu.sim.job import RUN_LEGS, WAIT_CAUSES, Job
+from gpuschedule_tpu.sim.metrics import MetricsLog
+from gpuschedule_tpu.sim.philly import generate_philly_like_trace
+
+META = {"run_id": "t", "seed": 0, "policy": "x", "config_hash": "c"}
+
+
+def test_leg_names_pin_reader_and_writer_equal():
+    """The analyzer re-declares the leg-name constants (no-sim-import
+    rule); this is the pin that keeps the two in sync."""
+    assert WAIT_CAUSES == ANALYZE_WAIT_CAUSES
+    assert RUN_LEGS == ANALYZE_RUN_LEGS
+    assert not (set(WAIT_CAUSES) & set(RUN_LEGS))
+
+
+# --------------------------------------------------------------------- #
+# the golden closure: all eight policy configs x {plain, faults, net}
+
+
+def _run_attrib_cell(policy_key: str, arm: str):
+    name, kwargs = POLICY_CONFIGS[policy_key]
+    if arm == "net":
+        cluster = TpuCluster("v5e", dims=(4, 4), num_pods=2)
+        jobs = promote_to_multislice(
+            generate_philly_like_trace(40, seed=7),
+            0.1, cluster.pod_chips, seed=7,
+        )
+        net = NetModel()
+    else:
+        cluster = TpuCluster("v5e", dims=(4, 4))
+        jobs = generate_philly_like_trace(40, seed=7)
+        net = None
+    plan = None
+    if arm == "faults":
+        plan = FaultPlan(
+            records=generate_fault_schedule(
+                cluster, FaultConfig(mtbf=6 * 3600.0, repair=1800.0),
+                horizon=fault_horizon(jobs), seed=7,
+            ),
+            recovery=RecoveryModel(ckpt_interval=900.0, restore=30.0),
+        )
+    metrics = MetricsLog(
+        record_events=True, run_meta=dict(META), attribution=True
+    )
+    res = Simulator(
+        cluster, make_policy(name, **kwargs), jobs,
+        metrics=metrics, faults=plan, net=net, sample_interval=600.0,
+    ).run()
+    return res, analyze_events(iter(metrics.events))
+
+
+@pytest.mark.parametrize("policy_key", sorted(POLICY_CONFIGS))
+@pytest.mark.parametrize("arm", ["plain", "faults", "net"])
+def test_wait_and_slowdown_decompositions_close(policy_key, arm):
+    """The ISSUE 5 acceptance criterion: per-job wait legs sum (the
+    decomposition's own arithmetic) to the analyzer's wait, slowdown legs
+    to JCT (residuals at float dust), and the aggregate closes bit-exactly
+    against SimResult.delay_by_cause — for every policy, with and without
+    faults/net."""
+    res, an = _run_attrib_cell(policy_key, arm)
+    # aggregate closure: exact, every cause, every float (the SimResult-
+    # arithmetic contract, like the goodput closure)
+    assert an.delay_by_cause() == res.delay_by_cause
+    assert an.goodput() == res.goodput
+    # every leg key the stream produced is a known name
+    assert set(an.delay_by_cause()) <= set(WAIT_CAUSES) | set(RUN_LEGS)
+    at = an.attribution()
+    # the lost-time table closes against SimResult.goodput verbatim
+    assert at["lost_chip_s"] == res.goodput["lost_chip_s"]
+    assert at["restart_overhead_chip_s"] == res.goodput["restart_overhead_chip_s"]
+    for rec in an.jobs:
+        if not rec.delay_legs:
+            continue
+        # per-job: the wait decomposition sums bit-exactly to the
+        # analyzer's attributed wait (definitional: same floats, same
+        # ordered sum), and the independent state-time integration agrees
+        # to float dust
+        assert sum(rec.wait_legs().values()) == pytest.approx(
+            rec.attributed_wait(), abs=0.0
+        ) or sum(rec.wait_legs().values()) == rec.attributed_wait()
+        r = rec.wait_residual()
+        if rec.finished and r is not None:
+            assert abs(r) < 1e-6, (rec.job_id, r)
+        jr = rec.jct_residual()
+        if jr is not None:
+            assert abs(jr) < 1e-6, (rec.job_id, jr)
+    assert at["max_wait_residual"] < 1e-6
+    assert at["max_jct_residual"] < 1e-6
+    if arm == "faults":
+        assert "fault-outage" in an.delay_by_cause()
+    if arm == "net" and policy_key != "optimus":
+        # optimus legitimately has no contention leg: its elastic planner
+        # shrinks the promoted whales back inside one pod, so no gang ever
+        # runs at a degraded DCN locality
+        assert "net-degraded" in an.delay_by_cause()
+    # sampling rode along: physical series exists and never exceeds capacity
+    assert an.sample_series
+    total = an.header.total_chips
+    for t, used, unhealthy, pending in an.sample_series:
+        assert 0 <= used <= total
+        assert unhealthy >= 0 and pending >= 0
+
+
+# --------------------------------------------------------------------- #
+# the regression contract: attribution/sampling off => byte-identical
+
+
+def _seeded_run(attribution: bool, sample_interval, tmp_path, tag: str):
+    jobs = generate_philly_like_trace(40, seed=7)
+    metrics = MetricsLog(
+        record_events=True, run_meta=dict(META), attribution=attribution
+    )
+    res = Simulator(
+        TpuCluster("v5e", dims=(4, 4)), make_policy("dlas"), jobs,
+        metrics=metrics, sample_interval=sample_interval,
+    ).run()
+    out = tmp_path / tag
+    metrics.write(out)
+    return res, metrics.events, (out / "jobs.csv").read_bytes()
+
+
+def _strip_attribution(events):
+    """Drop everything the attribution/sampling layer adds: sample
+    records, blame/cause payloads, and rationale cause codes."""
+    out = []
+    for e in events:
+        if e.get("event") == "sample":
+            continue
+        e = {
+            k: v for k, v in e.items()
+            if k not in ("blame", "cause", "cause_code")
+        }
+        if isinstance(e.get("why"), dict):
+            e["why"] = {k: v for k, v in e["why"].items() if k != "code"}
+        out.append(e)
+    return out
+
+
+def test_attribution_off_runs_are_byte_identical(tmp_path):
+    """The ISSUE 5 acceptance pin: with attribution+sampling off nothing
+    changes — and the armed run differs from the plain one ONLY by the
+    additive records/fields (strip them and the streams, jobs.csv, header
+    identity, and summary are identical byte for byte)."""
+    res_off, ev_off, jobs_off = _seeded_run(False, None, tmp_path, "off")
+    res_on, ev_on, jobs_on = _seeded_run(True, 600.0, tmp_path, "on")
+    # the armed run really added something...
+    assert any(e.get("event") == "sample" for e in ev_on)
+    assert any("blame" in e for e in ev_on)
+    # ...and stripping it reproduces the plain stream exactly
+    assert [json.dumps(e) for e in ev_off] == [
+        json.dumps(e) for e in _strip_attribution(ev_on)
+    ]
+    # jobs.csv has no attribution columns: identical bytes
+    assert jobs_off == jobs_on
+    # header identity (run_id / config_hash) unchanged by the flags
+    assert ev_off[0] == ev_on[0]
+    # the summary only gains delay_* keys
+    s_off, s_on = res_off.summary(), res_on.summary()
+    assert {k: v for k, v in s_on.items() if not k.startswith("delay_")} == s_off
+    assert any(k.startswith("delay_") for k in s_on)
+    # and the per-job outcomes themselves are float-identical
+    for a, b in zip(res_off.jobs, res_on.jobs):
+        assert (a.job_id, a.end_time, a.executed_work, a.attained_service) \
+            == (b.job_id, b.end_time, b.executed_work, b.attained_service)
+
+
+def test_closure_holds_at_horizon_with_nothing_running():
+    """Review-confirmed regression: a permanent outage revokes the only
+    running job, then the max_time horizon arrives with nothing running —
+    the engine closes the open fault-outage wait at max_time, and the
+    stream must prove it extends that far (waiting jobs get cutoff
+    records) or the analyzer's closure silently loses the whole tail."""
+    from gpuschedule_tpu.faults.schedule import FaultRecord
+
+    jobs = [Job("j", 0.0, num_chips=8, duration=100.0)]
+    plan = FaultPlan(
+        records=[FaultRecord(time=10.0, scope=("chips", 8),
+                             duration=math.inf, kind="mtbf")],
+        recovery=RecoveryModel(ckpt_interval=1000.0, restore=5.0),
+    )
+    m = MetricsLog(record_events=True, run_meta=dict(META), attribution=True)
+    res = Simulator(SimpleCluster(8), make_policy("fifo"), jobs,
+                    metrics=m, faults=plan, max_time=100.0).run()
+    assert res.delay_by_cause.get("fault-outage") == 90.0
+    an = analyze_events(iter(m.events))
+    assert an.delay_by_cause() == res.delay_by_cause
+    assert an.end_t == 100.0
+    # the waiting job's horizon record is what carries the closed legs
+    cut = [e for e in m.events if e.get("event") == "cutoff"]
+    assert cut and cut[-1]["blame"]["fault-outage"] == 90.0
+
+
+def test_attribution_off_emits_no_blame_fields():
+    jobs = generate_philly_like_trace(20, seed=3)
+    m = MetricsLog(record_events=True, run_meta=dict(META))
+    Simulator(TpuCluster("v5e", dims=(4, 4)), make_policy("fifo"), jobs,
+              metrics=m).run()
+    for e in m.events:
+        assert "blame" not in e and "cause" not in e
+        assert e.get("event") != "sample"
+        if isinstance(e.get("why"), dict):
+            assert "code" not in e["why"]
+
+
+# --------------------------------------------------------------------- #
+# physical vs demand occupancy (ROADMAP PR-3 omission, retired)
+
+
+def test_demand_exceeds_physical_under_gandiva_packing():
+    """Overlay packing: two low-utilization 8-chip jobs share one slice,
+    so demand (sum of allocated chips) exceeds the physical occupancy the
+    sample events report — the divergence the report overlay renders."""
+    jobs = [
+        Job("host", 0.0, num_chips=8, duration=4000.0, utilization=0.4),
+        Job("guest", 10.0, num_chips=8, duration=4000.0, utilization=0.4),
+    ]
+    m = MetricsLog(record_events=True, run_meta=dict(META), attribution=True)
+    res = Simulator(
+        SimpleCluster(8), make_policy("gandiva", round_length=100.0), jobs,
+        metrics=m, sample_interval=50.0,
+    ).run()
+    assert res.counters.get("packings", 0) == 1
+    an = analyze_events(iter(m.events))
+    assert an.sample_series
+    # align each sample against the demand series at that instant
+    demand_at = []
+    for ts, used_p, _, _ in an.sample_series:
+        demand = 0
+        for t, used, _, _ in an.util_series:
+            if t <= ts:
+                demand = used
+            else:
+                break
+        demand_at.append((ts, demand, used_p))
+    packed = [(t, d, p) for t, d, p in demand_at if d > p]
+    assert packed, f"no sample saw demand > physical: {demand_at}"
+    # while packed: demand 16 on an 8-chip pool, physically full
+    t, d, p = packed[0]
+    assert d == 16 and p == 8
+    # physical occupancy never exceeds capacity even while packed
+    assert all(p <= 8 for _, _, p in demand_at)
+    assert an.mean_phys_occupancy is not None and an.mean_phys_occupancy <= 1.0
+
+
+# --------------------------------------------------------------------- #
+# sample payloads per cluster flavor
+
+
+def test_tpu_sample_state_reports_pods_and_fragmentation():
+    c = TpuCluster("v5e", dims=(4, 4), num_pods=2)
+    c.allocate(4)
+    s = c.sample_state()
+    assert s["used"] == 4 and s["unhealthy"] == 0
+    assert len(s["pods"]) == 2
+    assert s["pods"][0]["used"] == 4 and s["pods"][1]["used"] == 0
+    assert 0.0 <= s["frag"] <= 1.0
+    assert 0.0 <= s["pods"][0]["frag"] <= 1.0
+    # the one-pass global figure equals the canonical definition
+    assert s["frag"] == c.fragmentation()
+    assert s["pods"][0]["frag"] == c.pod_fragmentation(0)
+    assert s["overlays"] == 0
+    c.mark_unhealthy(("chip", 1, (0, 0)))
+    assert c.sample_state()["unhealthy"] == 1
+
+
+def test_pod_fragmentation_sees_shattered_free_space():
+    c = TpuCluster("v5e", dims=(4, 4))
+    assert c.pod_fragmentation(0) == 0.0  # empty pod: perfectly compact
+    # fill the pod with 1-chip slices, then free a checkerboard half:
+    # 8 free chips survive only as isolated shards
+    allocs = [c.allocate(1) for _ in range(16)]
+    assert all(a is not None for a in allocs)
+    assert c.pod_fragmentation(0) == 0.0  # full pod: nothing free to shard
+    for a in allocs[::2]:
+        c.free(a)
+    # the freed chips form two full columns: the largest free box is a
+    # 4x1 slice (4 chips) against 8 free — fragmentation 0.5
+    assert c.pod_fragmentation(0) == 0.5
+
+
+def test_simple_sample_state_counts_overlays():
+    c = SimpleCluster(8)
+    base = c.allocate(8)
+    c.allocate(8, hint={"overlay": base})
+    s = c.sample_state()
+    assert s["used"] == 8 and s["overlays"] == 1
+
+
+def test_gpu_sample_state_reports_nodes():
+    c = GpuCluster(num_switches=1, nodes_per_switch=2, gpus_per_node=4)
+    s = c.sample_state()
+    assert s["free_nodes"] == 2 and s["nodes_down"] == 0
+    c.allocate(4)
+    c.mark_unhealthy(("node", 0, 1))
+    s = c.sample_state()
+    assert s["free_nodes"] == 0 and s["nodes_down"] == 1
+
+
+# --------------------------------------------------------------------- #
+# Perfetto counter tracks
+
+
+def test_perfetto_counter_tracks_from_samples():
+    jobs = generate_philly_like_trace(20, seed=3)
+    m = MetricsLog(record_events=True, run_meta=dict(META), attribution=True)
+    Simulator(TpuCluster("v5e", dims=(4, 4)), make_policy("fifo"), jobs,
+              metrics=m, sample_interval=600.0).run()
+    evs = trace_events(iter(m.events))
+    counters = [e for e in evs if e["ph"] == "C"]
+    assert counters, "sample events produced no counter track"
+    names = {e["name"] for e in counters}
+    assert names == {"physical chips", "pending jobs"}
+    occ = [e for e in counters if e["name"] == "physical chips"]
+    assert all("used" in e["args"] and "unhealthy" in e["args"] for e in occ)
+    assert validate_chrome_trace({"traceEvents": evs}) == []
+
+
+# --------------------------------------------------------------------- #
+# machine-parseable cause codes
+
+
+def test_explain_codes_stamped_only_under_attribution():
+    jobs = generate_philly_like_trace(30, seed=11)
+    m = MetricsLog(record_events=True, run_meta=dict(META), attribution=True)
+    Simulator(TpuCluster("v5e", dims=(4, 4)), make_policy("dlas"), jobs,
+              metrics=m).run()
+    whys = [e["why"] for e in m.events if isinstance(e.get("why"), dict)]
+    assert whys
+    for why in whys:
+        assert why["code"].startswith("dlas/"), why
+    # the shared prefix-preemption rules map to their stable tokens
+    codes = {w["code"] for w in whys}
+    assert codes <= {"dlas/start", "dlas/displace"}
+
+
+def test_every_policy_rule_has_a_code_table():
+    from gpuschedule_tpu.policies import available
+
+    for name in available():
+        p = make_policy(name)
+        assert isinstance(p.rule_codes, dict) and p.rule_codes, name
+        for rule, token in p.rule_codes.items():
+            assert p.cause_code(rule) == f"{name}/{token}"
+
+
+def test_preempt_events_carry_cause_code():
+    jobs = generate_philly_like_trace(30, seed=11)
+    m = MetricsLog(record_events=True, run_meta=dict(META), attribution=True)
+    Simulator(TpuCluster("v5e", dims=(4, 4)), make_policy("srtf"), jobs,
+              metrics=m).run()
+    pre = [e for e in m.events if e.get("event") == "preempt"]
+    assert pre
+    for e in pre:
+        assert e["cause"] == "policy-preempt"
+        assert e.get("cause_code") == "srtf/displace"
+
+
+# --------------------------------------------------------------------- #
+# n-way compare matrix (ROADMAP PR-3 two-run-only omission, retired)
+
+
+def _capture_stream(tmp_path, policy: str):
+    path = tmp_path / f"{policy}.events.jsonl"
+    rc = cli_main([
+        "run", "--policy", policy, "--cluster", "tpu-v5e", "--dims", "4x4",
+        "--synthetic", "60", "--seed", "9", "--events", str(path),
+    ])
+    assert rc == 0
+    return path
+
+
+def test_compare_matrix_ranks_best_and_worst(tmp_path):
+    paths = [_capture_stream(tmp_path, p) for p in ("fifo", "srtf", "dlas")]
+    from gpuschedule_tpu.obs.analyze import analyze_file
+
+    analyses = [analyze_file(p) for p in paths]
+    matrix = compare_matrix(analyses)
+    assert matrix.labels == ["fifo", "srtf", "dlas"]
+    vals = matrix.metrics["avg_jct"]
+    assert len(vals) == 3 and all(v is not None for v in vals)
+    b, w = matrix.best["avg_jct"], matrix.worst["avg_jct"]
+    assert b is not None and w is not None and b != w
+    assert vals[b] == min(vals) and vals[w] == max(vals)  # polarity +1
+    # bigger-is-better metric ranks the other way
+    nf = matrix.metrics["num_finished"]
+    if matrix.best["num_finished"] is not None:
+        assert nf[matrix.best["num_finished"]] == max(nf)
+    table = matrix.format_table()
+    assert "fifo" in table and "*" in table and "!" in table
+    doc = matrix.to_json()
+    assert doc["metrics"]["avg_jct"]["gated"] is True
+
+
+def test_compare_cli_nway_and_two_run_semantics(tmp_path, capsys):
+    a = _capture_stream(tmp_path, "fifo")
+    b = _capture_stream(tmp_path, "srtf")
+    c = _capture_stream(tmp_path, "dlas")
+    # two-run gate semantics unchanged
+    assert cli_main(["compare", str(a), str(a)]) == 0
+    # n-way renders the matrix, exit 0
+    rc = cli_main(["compare", str(a), str(b), str(c),
+                   "--json", str(tmp_path / "matrix.json")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "3-way compare" in out
+    doc = json.loads((tmp_path / "matrix.json").read_text())
+    assert doc["labels"] == ["fifo", "srtf", "dlas"]
+    # thresholds belong to the gate, not the matrix
+    assert cli_main(["compare", str(a), str(b), str(c),
+                     "--threshold", "0.01"]) == 2
+    # a single stream is a usage error (exit 2), never exit-1 "regressed"
+    assert cli_main(["compare", str(a)]) == 2
+
+
+def test_compare_matrix_refuses_mismatched_worlds(tmp_path):
+    a = _capture_stream(tmp_path, "fifo")
+    b = _capture_stream(tmp_path, "srtf")
+    other = tmp_path / "other.events.jsonl"
+    rc = cli_main([
+        "run", "--policy", "dlas", "--cluster", "tpu-v5e", "--dims", "4x4",
+        "--synthetic", "60", "--seed", "10", "--events", str(other),
+    ])
+    assert rc == 0
+    assert cli_main(["compare", str(a), str(b), str(other)]) == 2
+
+
+# --------------------------------------------------------------------- #
+# report surface
+
+
+def test_report_renders_attribution_panel_and_overlay(tmp_path):
+    from gpuschedule_tpu.obs import write_report
+    from gpuschedule_tpu.obs.analyze import analyze_file
+
+    stream = tmp_path / "ev.jsonl"
+    rc = cli_main([
+        "run", "--policy", "dlas", "--cluster", "tpu-v5e", "--dims", "4x4",
+        "--synthetic", "60", "--seed", "9", "--events", str(stream),
+        "--attrib", "--sample-interval", "600",
+    ])
+    assert rc == 0
+    an = analyze_file(stream)
+    assert an.delay_by_cause() and an.sample_series
+    out = write_report(an, tmp_path / "r.html")
+    doc = out.read_text()
+    assert "Attribution" in doc
+    assert "demand" in doc and "physical" in doc
+    for pattern in ("http://", "https://", "<script", "<link", "src="):
+        assert pattern not in doc
+
+
+def test_run_cli_attrib_summary_keys(tmp_path, capsys):
+    rc = cli_main([
+        "run", "--policy", "fifo", "--cluster", "tpu-v5e", "--dims", "4x4",
+        "--synthetic", "40", "--seed", "3", "--attrib",
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert any(k.startswith("delay_") for k in summary)
+    assert "delay_work_s" in summary

@@ -1,0 +1,25 @@
+"""Slow-marked wrapper around tools/attrib_smoke.py (ISSUE 5 satellite):
+the 200-job faulted+netted causal-attribution acceptance path."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+)
+
+
+@pytest.mark.slow
+def test_attrib_smoke_end_to_end(tmp_path):
+    from attrib_smoke import run_smoke
+
+    res = run_smoke(tmp_path)
+    assert res["ok"]
+    assert res["samples"] > 0
+    assert "fault-outage" in res["delay_by_cause"]
+    assert "net-degraded" in res["delay_by_cause"]
+    assert res["report_bytes"] > 10_000

@@ -135,7 +135,15 @@ def test_cache_roundtrip(tmp_path):
 # harness (CPU mesh measurement)
 
 
+@pytest.mark.slow
 def test_profile_model_on_cpu_mesh(tmp_path):
+    """Live CPU-mesh measurement: the fitted curve's shape depends on
+    wall-clock step times, which invert under parallel-suite load on this
+    1-core box (the step_time(64) < step_time(1) assertion then flakes).
+    Slow-marked so the default tier-1 run stays deterministic; the full
+    suite (-m '') still measures it — alongside the other live-measurement
+    contract, test_holdout_mape_on_measured_points, already slow-marked
+    for the same reason."""
     pytest.importorskip("jax", reason="harness measurement needs the [profiler] extra")
     from gpuschedule_tpu.profiler.harness import profile_model
 

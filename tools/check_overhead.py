@@ -12,6 +12,11 @@ baseline:
   before the telemetry layer existed".
 - **disabled**: the public ``Simulator.run()`` with every telemetry surface
   at its default-off setting — what every existing caller gets.
+- **sampling** (gated like disabled, ISSUE 5 satellite): ``Simulator.run()``
+  with ``sample_interval`` armed but the event stream off.  Periodic sample
+  events then cost only heap traffic (the emit body is skipped without a
+  stream, and pure-sample batches skip the advance entirely), so this path
+  must also stay within the same tolerance.
 - **enabled** (reported, not gated): span tracer on, events streamed to a
   null sink, registry attached.  Observability is allowed to cost something
   when you ask for it; the number is printed so regressions are visible.
@@ -55,7 +60,18 @@ class _NullSink(io.TextIOBase):
         return len(s)
 
 
-def _fresh_sim(num_jobs: int, *, metrics: MetricsLog | None = None) -> Simulator:
+# Sampling cadence for the sampling-on/events-off gate: fine enough that a
+# 1k-job replay (~17 sim hours) crosses it thousands of times — a real
+# stress of the sample-event heap traffic, not a token one.
+SAMPLE_INTERVAL_S = 30.0
+
+
+def _fresh_sim(
+    num_jobs: int,
+    *,
+    metrics: MetricsLog | None = None,
+    sample_interval: float | None = None,
+) -> Simulator:
     # fresh Job objects every run: the engine mutates them in place
     jobs = generate_poisson_trace(num_jobs, seed=1234, mean_duration=900.0)
     return Simulator(
@@ -63,6 +79,7 @@ def _fresh_sim(num_jobs: int, *, metrics: MetricsLog | None = None) -> Simulator
         make_policy("dlas", thresholds=(600.0,)),
         jobs,
         metrics=metrics,
+        sample_interval=sample_interval,
     )
 
 
@@ -75,6 +92,15 @@ def _time_baseline(num_jobs: int) -> float:
 
 def _time_disabled(num_jobs: int) -> float:
     sim = _fresh_sim(num_jobs)
+    t0 = time.perf_counter()
+    sim.run()
+    return time.perf_counter() - t0
+
+
+def _time_sampling(num_jobs: int) -> float:
+    # sampling armed, event stream off: the ISSUE 5 "sampling-enabled-but-
+    # events-off" path — all heap traffic, no payloads
+    sim = _fresh_sim(num_jobs, sample_interval=SAMPLE_INTERVAL_S)
     t0 = time.perf_counter()
     sim.run()
     return time.perf_counter() - t0
@@ -106,22 +132,29 @@ def run_guard(
     attempt_repeats = repeats
     result: dict = {}
     for attempt in range(1, max_attempts + 1):
-        base_times, dis_times = [], []
+        base_times, dis_times, samp_times = [], [], []
         _time_baseline(num_jobs)  # warm allocator/caches off the record
         _time_disabled(num_jobs)
-        for _ in range(attempt_repeats):  # interleaved: drift hits both alike
+        _time_sampling(num_jobs)
+        for _ in range(attempt_repeats):  # interleaved: drift hits all alike
             base_times.append(_time_baseline(num_jobs))
             dis_times.append(_time_disabled(num_jobs))
+            samp_times.append(_time_sampling(num_jobs))
         t_base, t_dis = min(base_times), min(dis_times)
+        t_samp = min(samp_times)
         ratio = t_dis / t_base if t_base > 0 else float("inf")
+        samp_ratio = t_samp / t_base if t_base > 0 else float("inf")
         result = {
-            "ok": ratio <= tolerance,
+            "ok": ratio <= tolerance and samp_ratio <= tolerance,
             "attempt": attempt,
             "repeats": attempt_repeats,
             "num_jobs": num_jobs,
             "baseline_s": round(t_base, 6),
             "disabled_s": round(t_dis, 6),
             "disabled_over_baseline": round(ratio, 4),
+            "sampling_s": round(t_samp, 6),
+            "sampling_over_baseline": round(samp_ratio, 4),
+            "sample_interval_s": SAMPLE_INTERVAL_S,
             "tolerance": tolerance,
         }
         if result["ok"]:
