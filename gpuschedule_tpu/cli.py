@@ -973,11 +973,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                      help="inject hardware faults: k=v pairs, e.g. "
                           "mtbf=86400,repair=3600,ckpt=1800 (keys: mtbf, "
                           "repair, maintenance, maintenance_duration, spot, "
-                          "spot_mtbf, spot_outage, ckpt, restore; seconds, "
-                          "inf ok, restore=auto derives cost from the model "
-                          "size).  The fault schedule derives from --seed "
-                          "via an independent RNG stream, so trace and "
-                          "faults reproduce together")
+                          "spot_mtbf, spot_outage, spot_warning (pre-revoke "
+                          "notice window: emergency checkpoints when it "
+                          "covers the write cost), domain_mtbf / "
+                          "domain_repair (correlated host/rack/pod "
+                          "outages), straggler_mtbf / straggler_repair / "
+                          "straggler_degrade (slow chips pacing their "
+                          "gangs), link_mtbf / link_repair / link_degrade, "
+                          "ckpt, restore, ckpt_write (priced periodic "
+                          "checkpoint writes; 'auto' sizes from model "
+                          "state); seconds, inf ok, restore=auto derives "
+                          "cost from the model size).  The fault schedule "
+                          "derives from --seed via independent RNG "
+                          "streams, so trace and faults reproduce together")
     run.add_argument("--net", nargs="?", const=True, default=None,
                      metavar="SPEC",
                      help="model the shared DCN fabric (net/): multislice "

@@ -81,6 +81,37 @@ class ClusterBase:
             f"{type(self).__name__} has no fault health mask"
         )
 
+    def peek_victims(self, scope) -> list:
+        """The alloc_ids an outage of ``scope`` *would* revoke right now,
+        without mutating anything — the addressee list of a spot
+        pre-revoke warning (faults/).  The default empty list makes
+        warnings inert on flavors without the query."""
+        return []
+
+    def failure_domains(self) -> list:
+        """The correlated-failure hierarchy as ``(level, scope)`` pairs
+        (faults/ ``domain_mtbf``): every host, rack, and pod blast
+        radius this cluster's geometry defines, each a scope
+        :meth:`mark_unhealthy` accepts.  Flavors with topology override;
+        the default empty list disables the domain process."""
+        return []
+
+    # ---- straggler degrade mask (faults/) ----------------------------- #
+
+    def degraded_chips(self) -> dict:
+        """Currently degraded units as ``{unit_id: residual rate
+        fraction}`` — the policy-facing straggler view (Gandiva's
+        evacuation reads it).  Empty on flavors without a degrade mask
+        and whenever nothing is degraded."""
+        return {}
+
+    def alloc_slow_factor(self, allocation) -> float:
+        """The straggler multiplier of one allocation: the min residual
+        rate over its chips (a synchronous gang runs at its slowest
+        chip).  1.0 — and O(1) — whenever nothing is degraded; the
+        engine derives ``Job.slow_factor`` from this on every bind."""
+        return 1.0
+
     def can_allocate(self, num_chips: int) -> bool:
         """Cheap feasibility probe (may be optimistic only for flavors where
         placement can still fail; SimpleCluster's answer is exact)."""
@@ -229,14 +260,30 @@ class SimpleCluster(OverlayMixin, ClusterBase):
         Chips are drawn from the free pool first; only the shortfall
         revokes live allocations (whole gangs, oldest first — deterministic
         and cheap to reason about), plus any overlays packed onto them.
-        """
+        Victim selection is :meth:`peek_victims` (single owner — the spot
+        pre-revoke warning must address exactly the gangs the outage
+        would revoke)."""
+        victims = self.peek_victims(scope)
+        self._unhealthy += int(scope[1])
+        return victims
+
+    def repair(self, scope) -> None:
+        if scope[0] != "chips":
+            raise ValueError(
+                f"SimpleCluster faults take ('chips', n) scopes, got {scope!r}"
+            )
+        self._unhealthy = max(0, self._unhealthy - int(scope[1]))
+
+    def peek_victims(self, scope) -> list:
+        """The gangs :meth:`mark_unhealthy` WOULD revoke for this scope
+        right now — same free-pool-first selection, no mutation (the
+        spot pre-revoke warning's addressee list)."""
         if scope[0] != "chips":
             raise ValueError(
                 f"SimpleCluster faults take ('chips', n) scopes, got {scope!r}"
             )
         n = int(scope[1])
         shortfall = n - max(0, self.total_chips - self._used - self._unhealthy)
-        self._unhealthy += n
         victims: list = []
         if shortfall > 0:
             for aid in sorted(self._live):
@@ -249,12 +296,19 @@ class SimpleCluster(OverlayMixin, ClusterBase):
             victims += sorted(o for o, b in self._overlays.items() if b in bases)
         return victims
 
-    def repair(self, scope) -> None:
-        if scope[0] != "chips":
-            raise ValueError(
-                f"SimpleCluster faults take ('chips', n) scopes, got {scope!r}"
-            )
-        self._unhealthy = max(0, self._unhealthy - int(scope[1]))
+    def failure_domains(self) -> list:
+        """Flat-pool blast radii: 8-chip "hosts" and eighth-of-the-pool
+        "racks" (the same eighth the maintenance rotation uses).  Scopes
+        are fungible counts — the pool has no chip identity — so each
+        domain is an anonymous ``("chips", n)`` block."""
+        domains: list = []
+        host = min(8, self.total_chips)
+        if host > 0:
+            domains += [("host", ("chips", host))] * (self.total_chips // host)
+        rack = self.total_chips // 8
+        if rack > 0:
+            domains += [("rack", ("chips", rack))] * 8
+        return domains
 
     def allocate(self, num_chips: int, *, job=None, hint: Optional[dict] = None):
         overlay = self._try_overlay(num_chips, hint, job)

@@ -26,6 +26,7 @@ that would cross a locality boundary).
 from __future__ import annotations
 
 import itertools
+import math
 import random
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
@@ -79,6 +80,10 @@ class GpuCluster(ClusterBase):
         self._live: Dict[int, GpuPlacement] = {}
         self._rng = random.Random(seed)
         self._down: Dict[NodeId, int] = {}  # node -> overlapping outage count
+        # straggler degrade mask (faults/): node -> stack of residual-rate
+        # fractions.  A degraded node stays allocatable — gangs on it just
+        # run at its rate (the slowest member paces a synchronous gang).
+        self._node_degrade: Dict[NodeId, List[float]] = {}
         self.fragmentation_failures = 0  # topology-strict refusals
 
     # ------------------------------------------------------------------ #
@@ -107,6 +112,17 @@ class GpuCluster(ClusterBase):
             raise ValueError(f"fault node {nd} not in {self!r}")
         return nd
 
+    def _scope_nodes(self, scope) -> List[NodeId]:
+        """Normalize a health-mask scope to its node list: one host node,
+        or — the rack-level correlated failure domain — every node under
+        one switch (``("switch", s)``)."""
+        if scope[0] == "switch":
+            s = int(scope[1])
+            if not 0 <= s < self.num_switches:
+                raise ValueError(f"fault switch {s} not in {self!r}")
+            return [(s, n) for n in range(self.nodes_per_switch)]
+        return [self._node_scope(scope)]
+
     def sample_state(self) -> dict:
         state = super().sample_state()
         # node-granular facts: how many hosts are down, and how many are
@@ -118,28 +134,103 @@ class GpuCluster(ClusterBase):
             for nd, free in self._free.items()
             if free == self.gpus_per_node and nd not in self._down
         )
+        if self._node_degrade:
+            # straggler nodes (faults/): present only while any exist so
+            # straggler-free sample payloads stay byte-identical
+            state["degraded"] = len(self._node_degrade)
         return state
 
     def mark_unhealthy(self, scope) -> list:
-        """Take a host node offline (the Philly failure domain); returns
-        the alloc_ids of gangs with any GPU on it."""
-        nd = self._node_scope(scope)
-        self._down[nd] = self._down.get(nd, 0) + 1
+        """Take a host node — or, for ``("switch", s)`` domain scopes,
+        every node under one switch at once — offline; returns the
+        alloc_ids of gangs with any GPU on the downed nodes.  Victim
+        selection is :meth:`peek_victims` (single owner — the spot
+        pre-revoke warning must address exactly these gangs)."""
+        victims = self.peek_victims(scope)
+        for nd in self._scope_nodes(scope):
+            self._down[nd] = self._down.get(nd, 0) + 1
+        return victims
+
+    def repair(self, scope) -> None:
+        for nd in self._scope_nodes(scope):
+            count = self._down.get(nd, 0)
+            if count <= 0:
+                raise ValueError(f"repair of healthy node {nd}")
+            if count == 1:
+                del self._down[nd]
+            else:
+                self._down[nd] = count - 1
+
+    def peek_victims(self, scope) -> list:
+        """The alloc_ids :meth:`mark_unhealthy` WOULD return, without
+        mutating the mask (the spot pre-revoke warning's addressees)."""
+        downed = set(self._scope_nodes(scope))
         return sorted(
             aid
             for aid, placement in self._live.items()
-            if any(node == nd for node, _ in placement.nodes)
+            if any(node in downed for node, _ in placement.nodes)
         )
 
-    def repair(self, scope) -> None:
+    def failure_domains(self) -> List[tuple]:
+        """The GPU tree's correlated-failure hierarchy (faults/): every
+        host node (the Philly failure domain) and every switch — a
+        switch outage is the rack-level blast radius that takes all its
+        nodes down in one event."""
+        return [
+            ("host", ("node", s, n))
+            for s in range(self.num_switches)
+            for n in range(self.nodes_per_switch)
+        ] + [
+            ("rack", ("switch", s)) for s in range(self.num_switches)
+        ]
+
+    # ------------------------------------------------------------------ #
+    # straggler degrade mask (faults/)
+
+    def mark_degraded(self, scope, factor: float) -> None:
+        """One host node turns straggler: it keeps serving its GPUs at
+        ``factor`` of their rate; gangs on it slow to match (never
+        revoked).  Overlapping degradations stack multiplicatively."""
         nd = self._node_scope(scope)
-        count = self._down.get(nd, 0)
-        if count <= 0:
-            raise ValueError(f"repair of healthy node {nd}")
-        if count == 1:
-            del self._down[nd]
-        else:
-            self._down[nd] = count - 1
+        self._node_degrade.setdefault(nd, []).append(
+            min(1.0, max(0.0, float(factor)))
+        )
+
+    def clear_degraded(self, scope, factor: float) -> None:
+        """Undo one :meth:`mark_degraded` of the same severity."""
+        nd = self._node_scope(scope)
+        stack = self._node_degrade.get(nd)
+        frac = min(1.0, max(0.0, float(factor)))
+        if not stack or frac not in stack:
+            raise ValueError(f"recovery of healthy node {nd}")
+        stack.remove(frac)
+        if not stack:
+            del self._node_degrade[nd]
+
+    def degraded_chips(self) -> Dict[NodeId, float]:
+        """Straggler view for policies: ``(switch, node) -> residual
+        rate`` (stacked degradations multiplied out)."""
+        return {
+            nd: math.prod(stack)
+            for nd, stack in sorted(self._node_degrade.items())
+        }
+
+    def alloc_slow_factor(self, allocation) -> float:
+        """Min residual rate over the gang's nodes (the slowest member
+        paces a synchronous gang); one dict check when nothing is
+        degraded."""
+        if not self._node_degrade or allocation is None:
+            return 1.0
+        placement = allocation.detail
+        nodes = getattr(placement, "nodes", None)
+        if not nodes:
+            return 1.0
+        factor = 1.0
+        for nd, _ in nodes:
+            stack = self._node_degrade.get(nd)
+            if stack:
+                factor = min(factor, math.prod(stack))
+        return factor
 
     def _avail(self) -> Dict[NodeId, int]:
         """Per-node free GPUs the placement schemes may use: ``_free``

@@ -226,6 +226,12 @@ class TpuCluster(OverlayMixin, ClusterBase):
             np.zeros(self.dims, dtype=np.int16) for _ in range(self.num_pods)
         ]
         self._unhealthy_cells = 0
+        # straggler degrade mask (faults/): (pod, coord) -> stack of
+        # residual-rate fractions (overlapping degradations multiply).  A
+        # degraded chip stays allocatable — it is slow, not gone — so this
+        # lives beside the health mask, not inside it.  Empty dict keeps
+        # alloc_slow_factor at a single truthiness check on the hot path.
+        self._chip_degrade: Dict[Tuple[int, Tuple[int, ...]], List[float]] = {}
         self._used = 0
         self._ids = itertools.count()
         self._live: Dict[int, SliceGeometry] = {}
@@ -295,19 +301,15 @@ class TpuCluster(OverlayMixin, ClusterBase):
 
     def mark_unhealthy(self, scope) -> List[int]:
         """Take a chip/box/pod offline; returns overlapping live alloc_ids
-        (plus overlays packed onto them) for the engine to revoke."""
-        victims = set()
+        (plus overlays packed onto them) for the engine to revoke.
+        Victim selection is :meth:`peek_victims` (single owner — the spot
+        pre-revoke warning must address exactly these gangs)."""
+        victims = self.peek_victims(scope)
         for pod, origin, shape in self._fault_boxes(scope):
-            if not 0 <= pod < self.num_pods:
-                raise ValueError(f"fault pod {pod} out of range for {self!r}")
             h = self._box(self._health[pod], origin, shape)
             self._unhealthy_cells += int((h == 0).sum())
             h += 1
-            for aid, geom in self._live.items():
-                if self._geom_overlaps(geom, pod, origin, shape):
-                    victims.add(aid)
-        victims |= {o for o, b in self._overlays.items() if b in victims}
-        return sorted(victims)
+        return victims
 
     def repair(self, scope) -> None:
         for pod, origin, shape in self._fault_boxes(scope):
@@ -316,6 +318,110 @@ class TpuCluster(OverlayMixin, ClusterBase):
                 raise ValueError(f"repair of healthy chips: {scope!r}")
             h -= 1
             self._unhealthy_cells -= int((h == 0).sum())
+
+    def peek_victims(self, scope) -> List[int]:
+        """The alloc_ids :meth:`mark_unhealthy` WOULD return for this
+        scope, without touching the mask — the spot pre-revoke warning's
+        addressee list (faults/)."""
+        victims = set()
+        for pod, origin, shape in self._fault_boxes(scope):
+            if not 0 <= pod < self.num_pods:
+                raise ValueError(f"fault pod {pod} out of range for {self!r}")
+            for aid, geom in self._live.items():
+                if self._geom_overlaps(geom, pod, origin, shape):
+                    victims.add(aid)
+        victims |= {o for o, b in self._overlays.items() if b in victims}
+        return sorted(victims)
+
+    def failure_domains(self) -> List[Tuple[str, Tuple]]:
+        """The correlated-failure hierarchy this torus geometry defines
+        (faults/ ``domain_mtbf``), as ``(level, scope)`` pairs:
+
+        - **host**: one ``chips_per_host`` box per tile position — the
+          squarest valid slice shape for the host size, tiled across the
+          pod (a host's chips are physically adjacent on the torus);
+        - **rack**: four hosts' worth of chips as one larger box (the
+          PDU/rack blast radius), same squarest-shape tiling;
+        - **pod**: the whole pod (power/cooling events).
+
+        Dims that a shape does not tile evenly contribute only the full
+        tiles (the trailing chips simply belong to no rack).  Levels
+        whose size reaches the whole pod collapse into the pod level
+        rather than duplicating it."""
+        domains: List[Tuple[str, Tuple]] = []
+        host = self.spec["chips_per_host"]
+        for level, size in (("host", host), ("rack", 4 * host)):
+            if size >= self.pod_chips:
+                continue
+            shapes = valid_slice_shapes(size, self.dims)
+            if not shapes:
+                continue
+            shape = shapes[0]
+            origins = list(itertools.product(
+                *[range(0, d - s + 1, s) for d, s in zip(self.dims, shape)]
+            ))
+            for pod in range(self.num_pods):
+                domains += [
+                    (level, ("box", pod, origin, shape)) for origin in origins
+                ]
+        domains += [("pod", ("pod", p)) for p in range(self.num_pods)]
+        return domains
+
+    # ------------------------------------------------------------------ #
+    # straggler degrade mask (faults/)
+
+    def mark_degraded(self, scope, factor: float) -> None:
+        """One chip turns straggler: ``("chip", pod, coord)`` drops to
+        ``factor`` of its rate.  Overlapping degradations stack
+        multiplicatively; the chip stays allocatable throughout."""
+        if scope[0] != "chip":
+            raise ValueError(
+                f"TpuCluster stragglers take ('chip', pod, coord) scopes, "
+                f"got {scope!r}"
+            )
+        pod, coord = int(scope[1]), tuple(int(c) for c in scope[2])
+        if not 0 <= pod < self.num_pods or any(
+            not 0 <= c < d for c, d in zip(coord, self.dims)
+        ) or len(coord) != len(self.dims):
+            raise ValueError(f"straggler scope out of range: {scope!r}")
+        self._chip_degrade.setdefault((pod, coord), []).append(
+            min(1.0, max(0.0, float(factor)))
+        )
+
+    def clear_degraded(self, scope, factor: float) -> None:
+        """Undo one :meth:`mark_degraded` of the same severity."""
+        pod, coord = int(scope[1]), tuple(int(c) for c in scope[2])
+        stack = self._chip_degrade.get((pod, coord))
+        frac = min(1.0, max(0.0, float(factor)))
+        if not stack or frac not in stack:
+            raise ValueError(f"recovery of healthy chip: {scope!r}")
+        stack.remove(frac)
+        if not stack:
+            del self._chip_degrade[(pod, coord)]
+
+    def degraded_chips(self) -> Dict[Tuple[int, Tuple[int, ...]], float]:
+        """Straggler view for policies: ``(pod, coord) -> residual rate``
+        (stacked degradations multiplied out)."""
+        return {
+            key: math.prod(stack)
+            for key, stack in sorted(self._chip_degrade.items())
+        }
+
+    def alloc_slow_factor(self, allocation) -> float:
+        """Min residual rate over an allocation's chips: the synchronous
+        gang runs at its slowest chip.  Scans the (tiny) degraded set,
+        not the geometry, so the straggler-free path is one dict check."""
+        if not self._chip_degrade or allocation is None:
+            return 1.0
+        geom = allocation.detail
+        if geom is None:
+            return 1.0
+        one = tuple(1 for _ in self.dims)
+        factor = 1.0
+        for (pod, coord), stack in self._chip_degrade.items():
+            if self._geom_overlaps(geom, pod, coord, one):
+                factor = min(factor, math.prod(stack))
+        return factor
 
     def _blocked(self, pod: int) -> np.ndarray:
         """Grid the slice search scans: occupancy, plus the health mask
@@ -692,6 +798,10 @@ class TpuCluster(OverlayMixin, ClusterBase):
                 largest = empty * self.pod_chips
             state["frag"] = 1.0 - largest / free
         state["pods"] = pods
+        if self._chip_degrade:
+            # straggler chips (faults/): count only while any exist, so
+            # straggler-free sample payloads stay byte-identical
+            state["degraded"] = len(self._chip_degrade)
         return state
 
     def live_slices(self) -> List[SliceGeometry]:

@@ -18,6 +18,22 @@ anchor this repo already models in :mod:`gpuschedule_tpu.sim.overhead`):
   path.  ``restore="auto"`` derives the cost from the job's model size and
   gang via :func:`gpuschedule_tpu.sim.overhead.resolve_overhead`; a float
   is a flat cost in seconds.
+- **checkpoint-write cost** (priced recovery, ISSUE 6): the periodic
+  checkpoints themselves are no longer free.  ``ckpt_write`` is the
+  seconds one write takes (``"auto"`` sizes it from the model's training
+  state streaming out through the slice's hosts,
+  :func:`gpuschedule_tpu.sim.overhead.ckpt_write_seconds`; 0 keeps the
+  historical free-write model).  The engine folds it into
+  ``Job.advance`` as the write-time fraction of every productive
+  interval — charged to the ``overhead`` leg of the goodput and
+  attribution decompositions — so a short ``ckpt_interval`` now trades
+  steady overhead against less lost work per revocation.
+- **emergency checkpoints**: a spot revocation announced
+  ``spot_warning`` seconds ahead lets a victim checkpoint *at the
+  warning* when the window covers the write cost: the engine charges the
+  write as overhead inside the window and the rollback floor rises to
+  the warned watermark (``Job.ckpt_protected``), so only the window's
+  tail of work is lost instead of a full checkpoint interval.
 """
 
 from __future__ import annotations
@@ -31,28 +47,68 @@ from gpuschedule_tpu.faults.schedule import (
     FaultRecord,
     generate_fault_schedule,
 )
-from gpuschedule_tpu.sim.overhead import resolve_overhead
+from gpuschedule_tpu.sim.overhead import (
+    ckpt_write_seconds as _ckpt_write_seconds,
+    cluster_generation,
+    resolve_overhead,
+)
 
 
 @dataclass
 class RecoveryModel:
-    """How a victim job recovers from a revocation."""
+    """How a victim job recovers from a revocation — and what staying
+    recoverable costs while nothing is failing (the checkpoint-write
+    price)."""
 
     ckpt_interval: float = 1800.0           # work-seconds between checkpoints
     restore: Union[float, str] = "auto"     # seconds, or "auto" (sim/overhead.py)
+    ckpt_write: Union[float, str] = 0.0     # seconds per periodic checkpoint
+                                            # write ("auto" sizes it from model
+                                            # state bytes; 0 = free, the PR-2
+                                            # model — the regression default)
 
     def checkpoint_interval(self, job) -> float:
         ji = getattr(job, "ckpt_interval", None)
         return self.ckpt_interval if ji is None else float(ji)
 
-    def lost_progress(self, job) -> float:
-        """Reference-speed seconds of work rolled back by one revocation."""
+    def writes_cost(self) -> bool:
+        """True when checkpoint writes are priced (``ckpt_write`` armed)."""
+        return self.ckpt_write == "auto" or float(self.ckpt_write) > 0.0
+
+    def ckpt_write_seconds(self, job, cluster) -> float:
+        """Seconds one checkpoint write (periodic or emergency) takes for
+        this job: the flat knob, or the modeled state-streaming time."""
+        if self.ckpt_write == "auto":
+            return _ckpt_write_seconds(
+                job.model_name,
+                max(1, job.allocated_chips or job.num_chips),
+                generation=cluster_generation(cluster),
+            )
+        return float(self.ckpt_write)
+
+    def lost_progress(self, job, *, use_emergency: bool = True) -> float:
+        """Reference-speed seconds of work rolled back by one revocation.
+
+        The rollback floor is the newest of the periodic-checkpoint
+        multiple and the emergency watermark a warned spot revocation
+        wrote (``Job.ckpt_protected``); ``use_emergency=False`` reports
+        the unwarned loss, which is how the engine tells warned from
+        unwarned revocations in the event stream."""
         interval = self.checkpoint_interval(job)
         if interval <= 0.0:
             return 0.0
         if math.isinf(interval):
-            return job.executed_work
-        return math.fmod(job.executed_work, interval)
+            lost = job.executed_work
+        else:
+            lost = math.fmod(job.executed_work, interval)
+        if use_emergency:
+            protected = getattr(job, "ckpt_protected", None)
+            if protected is not None:
+                lost = min(
+                    lost,
+                    job.executed_work - min(protected, job.executed_work),
+                )
+        return lost
 
     def restore_overhead(self, job, cluster) -> float:
         """Seconds of modeled restart cost charged to one victim."""

@@ -8,7 +8,8 @@ half of that story: a reproducible schedule of ``FaultRecord(time, scope,
 duration, kind)`` events a :class:`~gpuschedule_tpu.sim.engine.Simulator`
 injects as ``_FAULT``/``_REPAIR`` event pairs.
 
-Three fault processes, each with its own RNG stream:
+Five stochastic fault processes (plus deterministic maintenance), each
+with its own RNG stream:
 
 - **MTBF chip failures** (``kind="mtbf"``): every chip is an independent
   exponential process with mean ``mtbf`` seconds, so the fleet fails as a
@@ -23,15 +24,35 @@ Three fault processes, each with its own RNG stream:
 - **Spot/preemptible revocation** (``kind="spot"``): the last
   ``spot_fraction`` of capacity (whole pods / nodes / a chip block) is
   preemptible; each spot unit is revoked at exponentially distributed
-  intervals with mean ``spot_mtbf`` for a fixed ``spot_outage``.
+  intervals with mean ``spot_mtbf`` for a fixed ``spot_outage``.  With
+  ``spot_warning > 0`` each revocation is preceded by a pre-revoke
+  notice that far ahead: the engine delivers it to the gangs on the spot
+  unit and the recovery model takes an *emergency checkpoint* when the
+  window covers the checkpoint-write cost, shrinking the lost work from
+  a full checkpoint interval to the tail of the warning window.
+- **Correlated domain outages** (``kind="domain"``): real fleets fail by
+  blast radius — a PDU trip takes a rack, a power event takes a pod —
+  not as independent chip coin flips.  Each domain in the cluster's
+  host/rack/pod hierarchy (``cluster.failure_domains()``, derived from
+  the flavor's geometry) is an independent exponential process with mean
+  ``domain_mtbf``; one record takes *every* chip under the domain
+  offline at once (one fault event, one multi-gang revocation batch,
+  one repair).
+- **Straggler chips** (``kind="straggler"``): chips degrade gradually
+  before they die.  Each chip (TPU) or host node (GPU) turns straggler
+  at exponentially distributed intervals with mean ``straggler_mtbf``;
+  while degraded it runs at ``straggler_degrade`` of its rate and the
+  whole synchronous gang on it slows to the straggler's rate
+  (``Job.slow_factor``) — slowed, never revoked, like PR 4's link
+  degradation but on the compute side.
 
 Seed-split rule (the reproducibility contract, shared with ``cli.py``):
 one user-facing ``--seed`` governs every stochastic stream in a run.
 Trace synthesis keeps the bare seed (``random.Random(seed)``, unchanged
 from before faults existed), while each fault process derives its own
 independent stream as ``random.Random(f"{seed}:faults:<process>")`` with
-``<process>`` in ``{"mtbf", "spot", "link"}`` (maintenance is
-deterministic).
+``<process>`` in ``{"mtbf", "spot", "link", "domain", "straggler"}``
+(maintenance is deterministic).
 String seeding hashes stably across runs and platforms, so the same seed
 always yields byte-identical trace *and* fault schedules, and changing
 the fault config never perturbs the trace stream (or vice versa).
@@ -44,9 +65,17 @@ Scope tuples are cluster-flavor specific (the injector hands them back to
 - ``("box", pod, origin, shape)`` — an axis-aligned TPU sub-box;
 - ``("pod", pod)`` — a whole TPU pod;
 - ``("node", switch, node)`` — a whole GPU host node;
+- ``("switch", switch)`` — every node under one GPU switch (the GPU
+  rack-level failure domain);
 - ``("link", pod)`` — a TPU pod's DCN uplink (kind ``"link"``): handled
   by the engine + net/ contention model, never by the health mask —
   multislice jobs *slow down* for the outage instead of being revoked.
+
+Straggler records reuse the per-unit scopes (``("chip", pod, coord)`` /
+``("node", switch, node)``) but are dispatched by ``kind="straggler"``
+to the cluster's *degrade* mask (``mark_degraded``/``clear_degraded``),
+not the health mask: a straggler chip stays allocatable, it is just
+slow.
 """
 
 from __future__ import annotations
@@ -62,17 +91,23 @@ class FaultRecord:
     """One hardware outage: ``scope`` goes down at ``time`` for
     ``duration`` seconds (``inf`` = never repaired).
 
-    ``degrade`` only applies to ``("link", pod)`` scopes: the fraction of
-    the uplink's capacity that *remains* during the outage (0.0 = hard
-    outage).  Link faults slow multislice jobs through the contention
-    model (net/) instead of revoking anything — the first partial-
-    degradation fault kind."""
+    ``degrade`` applies to partial-degradation kinds — ``("link", pod)``
+    scopes (the fraction of the uplink's capacity that *remains* during
+    the outage; 0.0 = hard outage) and ``kind="straggler"`` records (the
+    fraction of the chip's rate that remains).  Both slow jobs instead
+    of revoking anything.
+
+    ``level`` names the hierarchy tier of a ``kind="domain"`` record
+    (``host``/``rack``/``pod``); ``warning`` is the pre-revoke notice
+    lead time of a ``kind="spot"`` record (0 = unannounced)."""
 
     time: float
     scope: Tuple
     duration: float
-    kind: str = "mtbf"  # mtbf | maintenance | spot | link
+    kind: str = "mtbf"  # mtbf | maintenance | spot | link | domain | straggler
     degrade: float = 0.0
+    level: str = ""
+    warning: float = 0.0
 
     @property
     def label(self) -> str:
@@ -91,6 +126,8 @@ class FaultRecord:
             return f"pod{s[1]}"
         if s[0] == "node":
             return f"gpu/s{s[1]}n{s[2]}"
+        if s[0] == "switch":
+            return f"gpu/sw{s[1]}"
         if s[0] == "link":
             return f"dcn/pod{s[1]}"
         return str(s)
@@ -109,6 +146,23 @@ class FaultConfig:
     spot_fraction: float = 0.0          # trailing fraction of capacity that is spot
     spot_mtbf: float = 4 * 3600.0       # mean time between revocations per unit
     spot_outage: float = 1800.0         # fixed outage per revocation
+    spot_warning: float = 0.0           # pre-revoke notice lead time (s, 0 = none):
+                                        # the engine delivers it to the gangs on
+                                        # the spot unit and the recovery model
+                                        # takes an emergency checkpoint when the
+                                        # window covers the write cost
+    # Correlated failure domains (kind="domain"): every domain in the
+    # cluster's host/rack/pod hierarchy (cluster.failure_domains()) is an
+    # independent exponential process; one record takes ALL chips under
+    # the domain offline at once.
+    domain_mtbf: float = math.inf       # per-domain mean time between outages (s)
+    domain_repair: float = 2 * 3600.0   # mean domain repair duration (s)
+    # Straggler chips (kind="straggler"): per-chip (TPU) / per-node (GPU)
+    # gradual degradation — the unit keeps running at straggler_degrade of
+    # its rate and the whole gang on it slows to match (never revoked).
+    straggler_mtbf: float = math.inf    # per-unit mean time between onsets (s)
+    straggler_repair: float = 3600.0    # mean degradation duration (s)
+    straggler_degrade: float = 0.5      # residual rate fraction while degraded
     # DCN-uplink outages (kind="link", TPU fleets only): each pod's uplink
     # is an independent exponential process; an outage *degrades* the link
     # to link_degrade of its capacity instead of killing anything — the
@@ -137,6 +191,28 @@ def fault_horizon(jobs: Sequence, *, slack: float = 2.0) -> float:
     return max(j.submit_time for j in jobs) + slack * sum(
         j.duration for j in jobs
     )
+
+
+def scope_capacity(cluster, scope) -> int:
+    """Chips a fault ``scope`` takes *offline* (the availability
+    accounting input for sweeps).  Degrade-only scopes — uplinks and
+    straggler units never leave the capacity pool — report 0; callers
+    filter by record kind for those."""
+    inner = getattr(cluster, "inner", cluster)
+    kind = scope[0]
+    if kind == "chips":
+        return int(scope[1])
+    if kind == "chip":
+        return 1
+    if kind == "box":
+        return math.prod(scope[3])
+    if kind == "pod":
+        return inner.pod_chips
+    if kind == "node":
+        return inner.gpus_per_node
+    if kind == "switch":
+        return inner.nodes_per_switch * inner.gpus_per_node
+    return 0  # link / unknown: no capacity leaves the pool
 
 
 def _flavor(cluster) -> Tuple[str, object]:
@@ -221,6 +297,77 @@ def generate_fault_schedule(
             k += 1
             t = k * config.maintenance_period
 
+    # -- correlated domain outages (host/rack/pod blast radius) -------- #
+    if (
+        config.domain_mtbf > 0
+        and math.isfinite(config.domain_mtbf)
+        and horizon > 0
+    ):
+        domains = getattr(inner, "failure_domains", lambda: [])()
+        if domains:
+            rng = random.Random(f"{seed}:faults:domain")
+            rate = len(domains) / config.domain_mtbf
+
+            def domain_duration() -> float:
+                if math.isinf(config.domain_repair):
+                    return math.inf
+                if config.domain_repair > 0:
+                    return rng.expovariate(1.0 / config.domain_repair)
+                return 0.0
+
+            t = rng.expovariate(rate)
+            while t <= horizon:
+                # every domain is an independent Poisson process at rate
+                # 1/domain_mtbf; the superposition picks uniformly, so
+                # host outages dominate in aggregate simply because there
+                # are more hosts than racks than pods
+                level, scope = domains[rng.randrange(len(domains))]
+                records.append(FaultRecord(
+                    t, scope, domain_duration(), "domain", level=level,
+                ))
+                t += rng.expovariate(rate)
+
+    # -- straggler chips (degrade, never revoke) ----------------------- #
+    if (
+        flavor in ("tpu", "gpu")
+        and config.straggler_mtbf > 0
+        and math.isfinite(config.straggler_mtbf)
+        and horizon > 0
+    ):
+        rng = random.Random(f"{seed}:faults:straggler")
+        if flavor == "tpu":
+            n_units = inner.total_chips
+        else:
+            n_units = inner.num_switches * inner.nodes_per_switch
+        rate = n_units / config.straggler_mtbf
+
+        def straggler_duration() -> float:
+            if math.isinf(config.straggler_repair):
+                return math.inf
+            if config.straggler_repair > 0:
+                return rng.expovariate(1.0 / config.straggler_repair)
+            return 0.0
+
+        t = rng.expovariate(rate)
+        while t <= horizon:
+            if flavor == "tpu":
+                scope = (
+                    "chip",
+                    rng.randrange(inner.num_pods),
+                    tuple(rng.randrange(d) for d in inner.dims),
+                )
+            else:
+                scope = (
+                    "node",
+                    rng.randrange(inner.num_switches),
+                    rng.randrange(inner.nodes_per_switch),
+                )
+            records.append(FaultRecord(
+                t, scope, straggler_duration(), "straggler",
+                degrade=config.straggler_degrade,
+            ))
+            t += rng.expovariate(rate)
+
     # -- DCN-uplink degradation (TPU fleets; slows, never kills) ------- #
     if (
         flavor == "tpu"
@@ -273,7 +420,10 @@ def generate_fault_schedule(
         for scope in units:
             t = rng.expovariate(1.0 / config.spot_mtbf)
             while t <= horizon:
-                records.append(FaultRecord(t, scope, config.spot_outage, "spot"))
+                records.append(FaultRecord(
+                    t, scope, config.spot_outage, "spot",
+                    warning=config.spot_warning,
+                ))
                 # a unit cannot be revoked again while already revoked
                 t += config.spot_outage + rng.expovariate(1.0 / config.spot_mtbf)
 
@@ -292,11 +442,18 @@ _SPEC_KEYS = {
     "spot": ("config", "spot_fraction"),
     "spot_mtbf": ("config", "spot_mtbf"),
     "spot_outage": ("config", "spot_outage"),
+    "spot_warning": ("config", "spot_warning"),
+    "domain_mtbf": ("config", "domain_mtbf"),
+    "domain_repair": ("config", "domain_repair"),
+    "straggler_mtbf": ("config", "straggler_mtbf"),
+    "straggler_repair": ("config", "straggler_repair"),
+    "straggler_degrade": ("config", "straggler_degrade"),
     "link_mtbf": ("config", "link_mtbf"),
     "link_repair": ("config", "link_repair"),
     "link_degrade": ("config", "link_degrade"),
     "ckpt": ("recovery", "ckpt_interval"),
     "restore": ("recovery", "restore"),
+    "ckpt_write": ("recovery", "ckpt_write"),
 }
 
 
@@ -306,10 +463,15 @@ def parse_fault_spec(spec: str):
 
     Keys: ``mtbf``, ``repair``, ``maintenance`` (period),
     ``maintenance_duration``, ``spot`` (fraction), ``spot_mtbf``,
-    ``spot_outage``, ``link_mtbf``, ``link_repair``, ``link_degrade``
-    (residual capacity fraction), ``ckpt`` (checkpoint interval),
-    ``restore`` (seconds or ``auto``).  Values are seconds unless noted;
-    ``inf`` is accepted.
+    ``spot_outage``, ``spot_warning`` (pre-revoke notice lead time),
+    ``domain_mtbf``, ``domain_repair`` (correlated host/rack/pod
+    outages), ``straggler_mtbf``, ``straggler_repair``,
+    ``straggler_degrade`` (residual chip-rate fraction), ``link_mtbf``,
+    ``link_repair``, ``link_degrade`` (residual capacity fraction),
+    ``ckpt`` (checkpoint interval), ``restore`` (seconds or ``auto``),
+    ``ckpt_write`` (per-checkpoint write cost: seconds, or ``auto`` to
+    size it from the model's training state).  Values are seconds unless
+    noted; ``inf`` is accepted.
     """
     from gpuschedule_tpu.faults.recovery import RecoveryModel
 
@@ -326,11 +488,26 @@ def parse_fault_spec(spec: str):
                 f"bad --faults entry {pair!r}; known keys: {sorted(_SPEC_KEYS)}"
             )
         target, attr = _SPEC_KEYS[key]
-        if key == "restore" and raw.strip() == "auto":
+        if key in ("restore", "ckpt_write") and raw.strip() == "auto":
             value: object = "auto"
         else:
             value = float(raw)
         setattr(config if target == "config" else recovery, attr, value)
+    if not 0.0 <= config.straggler_degrade <= 1.0:
+        raise ValueError(
+            f"straggler_degrade is the residual chip-rate FRACTION in "
+            f"[0, 1], got {config.straggler_degrade}"
+        )
+    if config.spot_warning < 0.0:
+        raise ValueError(
+            f"spot_warning is a lead time in seconds >= 0, got "
+            f"{config.spot_warning}"
+        )
+    if recovery.ckpt_write != "auto" and float(recovery.ckpt_write) < 0.0:
+        raise ValueError(
+            f"ckpt_write is seconds per checkpoint write >= 0 (or "
+            f"'auto'), got {recovery.ckpt_write}"
+        )
     if not 0.0 <= config.link_degrade <= 1.0:
         # a fraction, not seconds: an out-of-range value would be clamped
         # downstream (net/), silently turning every link fault into a

@@ -27,7 +27,12 @@ from gpuschedule_tpu.faults.schedule import (
     FaultConfig,
     fault_horizon,
     generate_fault_schedule,
+    scope_capacity,
 )
+
+# Fault kinds that take capacity out of the pool (availability accounting);
+# link and straggler records only degrade, they never remove chips.
+_CAPACITY_KINDS = ("mtbf", "maintenance", "spot", "domain")
 from gpuschedule_tpu.policies import make_policy
 from gpuschedule_tpu.sim import Simulator
 from gpuschedule_tpu.sim.metrics import MetricsLog
@@ -66,6 +71,39 @@ def jsonable(obj):
     return obj
 
 
+def availability_summary(cluster, records, end_time: float) -> dict:
+    """Availability and MTTR columns for one cell, from the fault
+    schedule the replay actually saw (records past ``end_time`` never
+    fired).
+
+    - ``availability``: 1 - (downed chip-seconds / total chip-seconds),
+      summing each capacity-outage record's scope size times its
+      horizon-capped duration.  Overlapping outages on the same chips
+      are double-counted (the per-record sum is an upper bound on
+      downtime, so this is a lower bound on availability — exact
+      whenever outages don't overlap).
+    - ``mttr_s``: mean repair time over the finite-duration capacity
+      outages that fired (``nan`` when none did — the fault-free control
+      arm; the JSON writers map it through the "inf"/"nan" string
+      convention)."""
+    downtime = 0.0
+    repairs: List[float] = []
+    for rec in records:
+        if rec.time > end_time or rec.kind not in _CAPACITY_KINDS:
+            continue
+        span = max(0.0, min(rec.duration, end_time - rec.time))
+        downtime += scope_capacity(cluster, rec.scope) * span
+        if math.isfinite(rec.duration):
+            repairs.append(rec.duration)
+    cap = cluster.total_chips * end_time
+    return {
+        "availability": (
+            max(0.0, 1.0 - downtime / cap) if cap > 0 else 1.0
+        ),
+        "mttr_s": sum(repairs) / len(repairs) if repairs else float("nan"),
+    }
+
+
 def run_cell(
     policy_key: str,
     *,
@@ -73,6 +111,7 @@ def run_cell(
     repair: float = 3600.0,
     ckpt: float = 1800.0,
     restore="auto",
+    ckpt_write=0.0,
     num_jobs: int = 200,
     seed: int = 0,
     dims: Sequence[int] = (8, 8),
@@ -81,6 +120,15 @@ def run_cell(
     events_path=None,
     attribution: bool = False,
     sample_interval: Optional[float] = None,
+    domain_mtbf: float = math.inf,
+    domain_repair: float = 2 * 3600.0,
+    straggler_mtbf: float = math.inf,
+    straggler_repair: float = 3600.0,
+    straggler_degrade: float = 0.5,
+    spot_fraction: float = 0.0,
+    spot_mtbf: float = 4 * 3600.0,
+    spot_outage: float = 1800.0,
+    spot_warning: float = 0.0,
 ) -> dict:
     """Run one (policy, MTBF) cell on a fresh cluster + trace + schedule.
 
@@ -100,6 +148,13 @@ def run_cell(
     chaos sweep answers not just *how much* goodput each policy lost but
     *where its jobs' time went* — defaults keep every existing cell
     byte-identical.
+
+    ISSUE 6 passthrough: ``domain_*`` (correlated host/rack/pod
+    outages), ``straggler_*`` (slow chips), ``spot_*`` (+ the
+    ``spot_warning`` pre-revoke window), and ``ckpt_write`` (priced
+    checkpoint writes) — all defaulting off, so pre-existing grids stay
+    byte-identical.  Every cell additionally reports ``availability``
+    and ``mttr_s`` next to the goodput decomposition.
     """
     name, kwargs = POLICY_CONFIGS[policy_key]
     cluster = TpuCluster("v5e", dims=tuple(dims), num_pods=num_pods)
@@ -107,20 +162,51 @@ def run_cell(
     horizon = max_time if max_time is not None else fault_horizon(jobs)
     plan = FaultPlan(
         records=generate_fault_schedule(
-            cluster, FaultConfig(mtbf=mtbf, repair=repair),
+            cluster,
+            FaultConfig(
+                mtbf=mtbf, repair=repair,
+                domain_mtbf=domain_mtbf, domain_repair=domain_repair,
+                straggler_mtbf=straggler_mtbf,
+                straggler_repair=straggler_repair,
+                straggler_degrade=straggler_degrade,
+                spot_fraction=spot_fraction, spot_mtbf=spot_mtbf,
+                spot_outage=spot_outage, spot_warning=spot_warning,
+            ),
             horizon=horizon, seed=seed,
         ),
-        recovery=RecoveryModel(ckpt_interval=ckpt, restore=restore),
+        recovery=RecoveryModel(
+            ckpt_interval=ckpt, restore=restore, ckpt_write=ckpt_write,
+        ),
     )
     metrics = MetricsLog(attribution=attribution)
     if events_path is not None:
         from gpuschedule_tpu.obs import config_hash
 
+        # new-knob keys enter the hash only when their process is armed:
+        # knob-off cells keep their PR-5 config hashes (and run_ids, and
+        # events headers) byte for byte
+        extra_cfg: dict = {}
+        # arming predicates mirror generate_fault_schedule's exactly: a
+        # knob value that generates zero records must not perturb the hash
+        if domain_mtbf > 0 and math.isfinite(domain_mtbf):
+            extra_cfg["domain"] = [domain_mtbf, domain_repair]
+        if straggler_mtbf > 0 and math.isfinite(straggler_mtbf):
+            extra_cfg["straggler"] = [
+                straggler_mtbf, straggler_repair, straggler_degrade
+            ]
+        if spot_fraction > 0:
+            extra_cfg["spot"] = [
+                spot_fraction, spot_mtbf, spot_outage, spot_warning
+            ]
+        if ckpt_write == "auto" or (
+            isinstance(ckpt_write, (int, float)) and ckpt_write
+        ):
+            extra_cfg["ckpt_write"] = ckpt_write
         chash = config_hash({
             "cluster": "tpu-v5e", "dims": list(dims), "num_pods": num_pods,
             "trace": f"philly-like:{num_jobs}", "seed": seed,
             "mtbf": mtbf, "repair": repair, "ckpt": ckpt,
-            "restore": restore, "max_time": max_time,
+            "restore": restore, "max_time": max_time, **extra_cfg,
         })
         metrics = MetricsLog(events_sink=events_path, run_meta={
             "run_id": f"{policy_key}-s{seed}-{chash}",
@@ -144,6 +230,10 @@ def run_cell(
         "faults": int(res.counters.get("faults", 0)),
         "revocations": int(res.counters.get("fault_revocations", 0)),
         "goodput": dict(res.goodput),
+        # availability / MTTR summary columns (ISSUE 6 satellite): what
+        # fraction of fleet chip-time stayed in service, and how fast
+        # outages healed, next to the goodput they cost
+        **availability_summary(cluster, plan.records, res.end_time),
     }
     if res.delay_by_cause:
         cell["delay_by_cause"] = dict(res.delay_by_cause)

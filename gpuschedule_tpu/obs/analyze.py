@@ -62,8 +62,10 @@ TERMINAL_STATES = ("done", "failed", "killed", "rejected")
 # SCHEMA_VERSION; tests pin the two equal).  WAIT_CAUSES blame queued/
 # suspended intervals; RUN_LEGS split running time into the work-
 # equivalent and its slowdown stretches.
-WAIT_CAUSES = ("admission", "capacity", "fault-outage", "policy-preempt")
-RUN_LEGS = ("work", "policy-share", "net-degraded", "overhead")
+WAIT_CAUSES = (
+    "admission", "capacity", "fault-outage", "net-outage", "policy-preempt"
+)
+RUN_LEGS = ("work", "policy-share", "net-degraded", "straggler", "overhead")
 
 _QUANTS = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
 
@@ -284,10 +286,16 @@ class _Active:
     chips_alloc: int = 0
     speed: float = 0.0
     locality: float = 1.0
+    slow: float = 1.0          # straggler multiplier (faults/, ISSUE 6)
     overhead_left: float = 0.0
     t_prog: float = 0.0        # time of the last adopted snapshot
     bw_gbps: float = 0.0       # current net/ bandwidth allocation
     t_bw: float = 0.0          # time the current allocation was set
+    # priced checkpoint writes (ISSUE 6): per-job write cost and period
+    # from the arrival record, so the drift guard can mirror the engine's
+    # work/overhead split
+    ckpt_w: float = 0.0
+    ckpt_every: float = math.inf
 
 
 def _stat_block(values: Sequence[float]) -> dict:
@@ -459,7 +467,33 @@ class RunAnalysis:
             "goodput": gp,
             "kinds_lost_chip_s": kinds_lost,
             "closure_residual": kinds_lost - gp["lost_chip_s"],
+            "domains": self.domain_outages(),
         }
+
+    def domain_outages(self) -> Dict[str, dict]:
+        """The per-domain outage table (correlated ``kind="domain"``
+        faults, ISSUE 6): scope label -> hierarchy level, outage count,
+        and total down seconds.  Permanent outages (duration ``"inf"``)
+        and outages still open at the stream's end are capped at the
+        observed horizon, so ``down_s`` is the downtime the replay
+        actually saw."""
+        out: Dict[str, dict] = {}
+        for f in self.fault_timeline:
+            if f.get("kind") != "domain":
+                continue
+            scope = str(f.get("scope"))
+            row = out.setdefault(scope, {
+                "level": f.get("level"), "outages": 0, "down_s": 0.0,
+            })
+            row["outages"] += 1
+            d = f.get("duration")
+            horizon = max(0.0, self.end_t - float(f.get("t", 0.0)))
+            if d is None or d == "inf":
+                dur = horizon
+            else:
+                dur = min(float(d), horizon)
+            row["down_s"] += dur
+        return dict(sorted(out.items()))
 
     def network(self) -> dict:
         """The network panel's data: per-link utilization series/means and
@@ -588,6 +622,11 @@ _LEGAL_FROM = {
     # extends to max_time (the wait closure depends on it)
     "cutoff": (RUNNING, QUEUED, SUSPENDED),
     "net": (RUNNING,),
+    # straggler re-price (faults/, ISSUE 6): the gang's rate changed
+    # because a chip under it degraded or recovered
+    "slow": (RUNNING,),
+    # spot pre-revoke notice: may charge emergency-checkpoint overhead
+    "warn": (RUNNING,),
 }
 
 
@@ -644,6 +683,7 @@ def analyze_events(
             row = fault_kinds[kind] = {
                 "faults": 0, "revocations": 0, "lost_work_s": 0.0,
                 "lost_chip_s": 0.0, "restore_charged_s": 0.0,
+                "warned_revocations": 0, "lost_work_warned_s": 0.0,
             }
         return row
 
@@ -661,7 +701,13 @@ def analyze_events(
         if a.state == RUNNING:
             dt = t - a.t_prog
             burn = min(a.overhead_left, dt)
-            expect = r.work + a.speed * a.locality * (dt - burn) - rollback
+            e = a.speed * a.locality * a.slow
+            run = dt - burn
+            if a.ckpt_w > 0.0 and e > 0.0 and 0.0 < a.ckpt_every < math.inf:
+                # priced checkpoint writes: mirror the engine's steady-
+                # state write-share split (sim/job.py advance)
+                run -= run * (e * a.ckpt_w) / (a.ckpt_every + e * a.ckpt_w)
+            expect = r.work + e * run - rollback
             drift = abs(expect - prog["work"]) / (1.0 + abs(expect))
             if drift > max_drift:
                 max_drift = drift
@@ -766,6 +812,8 @@ def analyze_events(
             active[rec.job_id] = _Active(
                 rec=rec, state=QUEUED, t_state=t, t_prog=t,
                 cause=ev.get("cause"),
+                ckpt_w=float(ev.get("ckpt_write_s", 0.0)),
+                ckpt_every=float(ev.get("ckpt_every", math.inf)),
             )
             pending_n += 1
             sample(t)
@@ -783,10 +831,18 @@ def analyze_events(
         if kind == "fault":
             row = kind_row(str(ev.get("fault", "?")))
             row["faults"] += 1
-            fault_timeline.append({
+            entry = {
                 "t": t, "scope": ev.get("scope"), "kind": ev.get("fault"),
                 "duration": ev.get("duration"), "fid": ev.get("fid"),
-            })
+            }
+            # domain hierarchy tier / degrade fraction ride along only
+            # when the record carries them (domain / straggler / link
+            # kinds), keeping historical timelines byte-identical
+            if "level" in ev:
+                entry["level"] = ev["level"]
+            if "degrade" in ev:
+                entry["degrade"] = ev["degrade"]
+            fault_timeline.append(entry)
             continue
         if kind == "repair":
             continue
@@ -866,6 +922,9 @@ def analyze_events(
             a.chips_alloc = int(ev.get("chips", a.rec.chips))
             a.speed = float(ev.get("speed", 1.0))
             a.locality = float(ev.get("locality", 1.0))
+            # placement-changing events carry slow_factor only when a
+            # straggler chip paces the gang; absence means full rate
+            a.slow = float(ev.get("slow_factor", 1.0))
             used += a.chips_alloc
             running_n += 1
             # queued AND suspended jobs both sit in the engine's pending
@@ -884,6 +943,7 @@ def analyze_events(
             running_n -= 1
             a.chips_alloc = 0
             a.speed = 0.0
+            a.slow = 1.0
             # engine semantics: suspend=True keeps resume intent (Gandiva),
             # suspend=False demotes back to the pending queue — but both
             # land in the engine's pending set, so both count as demand
@@ -894,6 +954,16 @@ def analyze_events(
         elif kind == "speed":
             adopt_snapshot(a, ev, t)
             a.speed = float(ev.get("speed", a.speed))
+        elif kind == "slow":
+            # straggler re-price (faults/): progress up to t accrued at
+            # the OLD slow factor (adopt first), the new factor onward
+            adopt_snapshot(a, ev, t)
+            a.slow = float(ev.get("slow_factor", a.slow))
+        elif kind == "warn":
+            # spot pre-revoke notice: a saved emergency checkpoint
+            # charged write overhead (the snapshot's overhead_left
+            # already includes it); an unsaved notice changes nothing
+            adopt_snapshot(a, ev, t)
         elif kind == "net":
             # contention re-price (net/): progress up to t accrued at the
             # OLD locality (adopt first), the new factor applies onward
@@ -919,6 +989,7 @@ def analyze_events(
             a.chips_alloc = new_chips
             a.speed = float(ev.get("speed", a.speed))
             a.locality = float(ev.get("locality", a.locality))
+            a.slow = float(ev.get("slow_factor", 1.0))
             sample(t)
         elif kind == "revoke":
             prev_lost = a.rec.lost_service
@@ -934,10 +1005,17 @@ def analyze_events(
             row["lost_work_s"] += float(ev.get("lost_work", 0.0))
             row["lost_chip_s"] += a.rec.lost_service - prev_lost
             row["restore_charged_s"] += float(ev.get("restore", 0.0))
+            if ev.get("warned"):
+                # an emergency checkpoint (spot pre-revoke warning)
+                # shrank this rollback: split the lost work so the
+                # report can show warned vs unwarned losses
+                row["warned_revocations"] += 1
+                row["lost_work_warned_s"] += float(ev.get("lost_work", 0.0))
             used -= a.chips_alloc
             running_n -= 1
             a.chips_alloc = 0
             a.speed = 0.0
+            a.slow = 1.0
             a.state, a.t_state = QUEUED, t
             pending_n += 1
             sample(t)

@@ -126,7 +126,8 @@ def trace_events(events: Iterable[dict]) -> List[dict]:
     open_iv: Dict[str, Tuple[str, float, dict]] = {}
     # net/ link -> (start_ts_us, args) for the open utilization slice
     open_net: Dict[str, Tuple[float, dict]] = {}
-    # fault scope label -> open outages as (start_ts_us, args) entries.
+    # fault track (health/<scope> or domain/<scope>) -> open outages as
+    # (start_ts_us, args) entries.
     # Engine-emitted events carry a per-record "fid" so a repair closes ITS
     # outage even when outages of different durations overlap on one scope;
     # fid-less streams (hand-edited) fall back to oldest-first pairing.
@@ -202,14 +203,17 @@ def trace_events(events: Iterable[dict]) -> List[dict]:
             instant("reject", _ADMISSION_TRACK, t_us, extra)
         elif kind in ("fault", "repair"):
             # unhealthy-interval tracks: one thread per fault scope under
-            # the "health" process, an X slice per outage
+            # the "health" process, an X slice per outage.  Correlated
+            # domain outages (ISSUE 6) get their own "domain" process so
+            # the blast-radius hierarchy reads as one track group.
             label = str(ev.get("scope", "?"))
-            track = f"health/{label}"
+            group = "domain" if ev.get("fault") == "domain" else "health"
+            track = f"{group}/{label}"
             instant(kind, track, t_us, extra)
             if kind == "fault":
-                open_health.setdefault(label, []).append((t_us, extra))
+                open_health.setdefault(track, []).append((t_us, extra))
             else:
-                stack = open_health.get(label)
+                stack = open_health.get(track)
                 if stack:
                     fid = extra.get("fid")
                     at = next(
@@ -224,10 +228,11 @@ def trace_events(events: Iterable[dict]) -> List[dict]:
                         "ts": h0, "dur": max(0.0, t_us - h0),
                         "pid": pid, "tid": tid, "args": args,
                     })
-        elif kind == "net":
-            # contention re-price: instant on the job's occupancy track
+        elif kind in ("net", "slow", "warn"):
+            # contention re-price / straggler re-price / spot pre-revoke
+            # notice: instants on the job's occupancy track
             iv = open_iv.get(job)
-            instant("net", iv[0] if iv else f"job/{job}", t_us, extra)
+            instant(kind, iv[0] if iv else f"job/{job}", t_us, extra)
         elif kind == "netlink":
             # per-link utilization slices: one thread per fabric link
             # under the "net" process, a slice per constant-load interval
@@ -263,8 +268,8 @@ def trace_events(events: Iterable[dict]) -> List[dict]:
         close(job, t_last, "horizon")
     for track in list(open_net):
         close_net(track, t_last)
-    for label, stack in open_health.items():
-        pid, tid = ids.ids(f"health/{label}")
+    for track, stack in open_health.items():
+        pid, tid = ids.ids(track)
         for h0, args in stack:
             timed.append({
                 "name": "unhealthy", "cat": "health", "ph": "X",

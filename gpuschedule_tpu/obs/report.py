@@ -531,14 +531,32 @@ def _fault_kind_table(attribution: dict) -> str:
         rows.append(
             f"<tr><td>{_esc(kind)}</td><td>{row['faults']}</td>"
             f"<td>{row['revocations']}</td>"
+            f"<td>{row.get('warned_revocations', 0)}</td>"
             f"<td>{_esc(_fmt_dur(row['lost_work_s']))}</td>"
             f"<td>{_esc(_fmt_num(row['lost_chip_s']))}</td>"
             f"<td>{_esc(_fmt_dur(row['restore_charged_s']))}</td></tr>"
         )
     return (
         "<table><thead><tr><th>fault kind</th><th>outages</th>"
-        "<th>revocations</th><th>work lost</th><th>chip-s lost</th>"
-        "<th>restore charged</th></tr></thead>"
+        "<th>revocations</th><th>warned</th><th>work lost</th>"
+        "<th>chip-s lost</th><th>restore charged</th></tr></thead>"
+        f"<tbody>{''.join(rows)}</tbody></table>"
+    )
+
+
+def _domain_table(domains: dict) -> str:
+    """Per-domain outage table (correlated ``domain`` faults): which
+    hosts/racks/pods went down, how often, and for how long."""
+    rows = []
+    for scope, row in domains.items():
+        rows.append(
+            f"<tr><td>{_esc(scope)}</td><td>{_esc(row.get('level') or '–')}</td>"
+            f"<td>{row['outages']}</td>"
+            f"<td>{_esc(_fmt_dur(row['down_s']))}</td></tr>"
+        )
+    return (
+        "<table><thead><tr><th>failure domain</th><th>level</th>"
+        "<th>outages</th><th>down time</th></tr></thead>"
         f"<tbody>{''.join(rows)}</tbody></table>"
     )
 
@@ -585,20 +603,29 @@ def _slowest_jobs_table(analysis: RunAnalysis, n: int = 10) -> str:
     worst = sorted(fin, key=lambda r: r.jct(), reverse=True)[:n]
     if not worst:
         return '<p class="empty">no finished jobs</p>'
+    # straggler slowdown column (ISSUE 6): only when the run attributed
+    # any time to a degraded chip — fault-free reports keep their shape
+    stragglers = any(r.delay_legs.get("straggler") for r in analysis.jobs)
     rows = []
     for r in worst:
+        straggler_cell = (
+            f"<td>{_esc(_fmt_dur(r.delay_legs.get('straggler', 0.0)))}</td>"
+            if stragglers else ""
+        )
         rows.append(
             f"<tr><td>{_esc(r.job_id)}</td><td>{r.chips}</td>"
             f"<td>{_esc(_fmt_dur(r.wait()))}</td>"
             f"<td>{_esc(_fmt_dur(r.jct()))}</td>"
             f"<td>{'–' if r.slowdown() is None else f'{r.slowdown():.1f}x'}</td>"
             f"<td>{r.preempts}</td><td>{r.faults}</td>"
+            f"{straggler_cell}"
             f"<td>{_esc(r.end_state)}</td></tr>"
         )
+    straggler_head = "<th>straggler</th>" if stragglers else ""
     return (
         "<table><thead><tr><th>job</th><th>chips</th><th>wait</th>"
         "<th>JCT</th><th>slowdown</th><th>preempts</th><th>faults</th>"
-        "<th>end</th></tr></thead>"
+        f"{straggler_head}<th>end</th></tr></thead>"
         f"<tbody>{''.join(rows)}</tbody></table>"
     )
 
@@ -752,14 +779,35 @@ def render_report(analysis: RunAnalysis, *, title: Optional[str] = None) -> str:
 
     fault_panel = ""
     if s["faults"] or s["revocations"] or gp["lost_chip_s"] > 0:
+        kinds = attribution["kinds"]
+        lost_total = sum(k["lost_work_s"] for k in kinds.values())
+        lost_warned = sum(k.get("lost_work_warned_s", 0.0)
+                          for k in kinds.values())
+        n_warned = sum(k.get("warned_revocations", 0) for k in kinds.values())
+        warned_note = ""
+        if n_warned:
+            # priced recovery (ISSUE 6): how much of the rollback an
+            # emergency checkpoint caught vs what unwarned revocations
+            # forfeited
+            warned_note = (
+                f" · {n_warned} warned revocations lost "
+                f"{_esc(_fmt_dur(lost_warned))} vs "
+                f"{_esc(_fmt_dur(lost_total - lost_warned))} unwarned"
+            )
+        domains = attribution.get("domains") or {}
+        domain_table = (
+            f"<p class=\"meta\">correlated domain outages</p>"
+            f"{_domain_table(domains)}" if domains else ""
+        )
         fault_panel = f"""
 <h2>Faults</h2>
 <div class="panel">
   <p class="meta">{s['faults']} outages · {s['revocations']} revocations ·
-  {s['repairs']} repairs · {_esc(_fmt_dur(sum(
-      k['lost_work_s'] for k in attribution['kinds'].values())))} work lost</p>
+  {s['repairs']} repairs · {_esc(_fmt_dur(lost_total))} work
+  lost{warned_note}</p>
   {_stacked_goodput_bar(gp)}
   {_fault_kind_table(attribution)}
+  {domain_table}
 </div>"""
 
     integrity = (

@@ -80,6 +80,21 @@ class Policy:
         Override to react beyond requeueing — e.g. Gandiva migrates running
         jobs away from a degraded pod.  Implementations may use the full
         engine mutation API; ``sim.cluster`` already reflects the outage.
+
+        Straggler onsets (``fault.kind == "straggler"``) also arrive
+        here: nothing is revoked (``victims`` is empty) but gangs on the
+        degraded unit are already slowed — Gandiva migrates them off.
+        """
+
+    def on_warning(self, sim, fault, victims) -> None:
+        """React to a spot pre-revoke notice (faults/) at ``sim.now``.
+
+        ``fault`` is the upcoming revocation record (``fault.time`` is
+        when it lands) and ``victims`` the running jobs that would be
+        revoked right now.  The engine has already taken the emergency
+        checkpoints the recovery model allows; the default is to do
+        nothing more.  Override to act on the notice — e.g. migrate the
+        gang off the spot unit before the revocation lands.
         """
 
     def schedule(self, sim) -> Optional[float]:

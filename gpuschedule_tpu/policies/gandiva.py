@@ -57,6 +57,7 @@ class GandivaPolicy(Policy):
         "pack-contention": "pack-net",
         "pack-dissolved": "unpack",
         "evacuate-degraded-pod": "evacuate",
+        "evacuate-straggler": "evacuate-slow",
         "defrag-for-blocked-waiter": "defrag",
         "shrink-for-demand": "shrink",
         "grow-into-idle": "grow",
@@ -111,6 +112,12 @@ class GandivaPolicy(Policy):
         """
         for v in victims:
             v.sched["g_wait_since"] = sim.now
+        if getattr(fault, "kind", "") == "straggler":
+            # nothing was revoked: gangs on the degraded chip are merely
+            # slowed, and the one policy with a migration mechanism can
+            # move them somewhere fast
+            self._evacuate_stragglers(sim)
+            return
         if fault.scope[0] not in ("chip", "box", "pod"):
             return
         cluster = sim.cluster
@@ -152,6 +159,59 @@ class GandivaPolicy(Policy):
                     why=why,
                 ):
                     sim.metrics.count("fault_evacuations")
+                    budget -= 1
+                    break
+
+    def _evacuate_stragglers(self, sim) -> None:
+        """Migrate slowed gangs off straggler chips.
+
+        A gang whose ``slow_factor`` dropped below 1.0 is paced by a
+        degraded chip somewhere in its slice; moving it to another pod
+        (healthiest first, the evacuate-degraded-pod target order)
+        restores full rate for the usual migration overhead.  Packed
+        groups and multislice gangs stay put, and single-pod fleets have
+        nowhere to go — the slowdown stands (the engine's slow-factor
+        re-derivation heals them on straggler recovery)."""
+        cluster = sim.cluster
+        if getattr(cluster, "num_pods", 1) <= 1 or not hasattr(
+            cluster, "pod_free_chips"
+        ):
+            return
+        budget = self.max_migrations_per_event
+        groups = self._overlay_groups(sim)
+        ex = self.explaining(sim)
+        for job in list(sim.running):
+            if budget == 0:
+                break
+            if job.slow_factor >= 1.0 or self._is_packed(sim, job, groups):
+                continue
+            geom = job.allocation.detail if job.allocation is not None else None
+            pod = getattr(geom, "pod", None)
+            if pod is None:
+                continue  # multislice gangs stay put (whole-pod claims)
+            targets = sorted(
+                (p for p in range(cluster.num_pods) if p != pod),
+                key=lambda p: -cluster.pod_free_chips(p),
+            )
+            for target in targets:
+                if cluster.pod_free_chips(target) < job.allocated_chips:
+                    break  # healthiest pod first: smaller ones won't fit either
+                overhead = resolve_overhead(
+                    self.migration_overhead, job, cluster, migration=True
+                )
+                why = (
+                    self.explain(
+                        "evacuate-straggler",
+                        pod=pod, target=target,
+                        slow=round(job.slow_factor, 4),
+                    )
+                    if ex else None
+                )
+                if sim.migrate(
+                    job, overhead=overhead, placement_hint={"pod": target},
+                    why=why,
+                ):
+                    sim.metrics.count("straggler_evacuations")
                     budget -= 1
                     break
 

@@ -33,11 +33,17 @@ from gpuschedule_tpu.sim.metrics import MetricsLog, SimResult
 # free resources before arrivals are considered, faults land after both (a
 # job finishing exactly when its chips fail completed first — nothing to
 # revoke), repairs land after the fault that scheduled them (a zero-length
-# outage still revokes, then heals, within one batch), and the policy runs
-# once after the whole batch.  Cluster samples (ISSUE 5) sort last so a
-# sample coinciding with real events snapshots the post-fault/repair state
-# of that instant (though still before the policy pass reacts to it).
-_COMPLETION, _ARRIVAL, _TICK, _FAULT, _REPAIR, _SAMPLE = 0, 1, 2, 3, 4, 5
+# outage still revokes, then heals, within one batch), spot pre-revoke
+# warnings (ISSUE 6) land after repairs (they are pushed strictly before
+# their fault's timestamp, so they can never share a batch with it), and
+# the policy runs once after the whole batch.  Cluster samples (ISSUE 5)
+# sort last so a sample coinciding with real events snapshots the
+# post-fault/repair state of that instant (though still before the policy
+# pass reacts to it) — and so the run loops' "sample on top means the
+# whole batch is samples" fast path stays sound.
+_COMPLETION, _ARRIVAL, _TICK, _FAULT, _REPAIR, _WARN, _SAMPLE = (
+    0, 1, 2, 3, 4, 5, 6
+)
 
 
 def _prog(job: Job) -> dict:
@@ -167,11 +173,42 @@ class Simulator:
         # outage even when outages of different durations overlap on one
         # scope (FIFO pairing would mis-attribute the intervals)
         self._fault_ids: Dict[int, int] = {}
+        # spot record identity -> job_ids that took an emergency
+        # checkpoint on ITS warning: the revoke event's "warned" flag
+        # marks only revocations whose own notice protected the victim
+        # (the persistent ckpt_protected watermark still shrinks losses
+        # of later unrelated revocations, but those are not "warned")
+        self._warned_jobs: Dict[int, set] = {}
         if faults is not None and faults.records:
             self._drain_faults = True
             for i, rec in enumerate(faults.records):
                 self._fault_ids[id(rec)] = i
                 self._push(rec.time, _FAULT, rec)
+                # spot pre-revoke notice (ISSUE 6 priced recovery): the
+                # warning lands strictly before its revocation, giving
+                # running gangs on the spot unit a window to take an
+                # emergency checkpoint (faults/recovery.py)
+                if rec.kind == "spot" and rec.warning > 0.0:
+                    t_warn = rec.time - rec.warning
+                    if 0.0 < t_warn < rec.time:
+                        self._push(t_warn, _WARN, rec)
+        # Priced checkpoint writes (ISSUE 6): when the recovery model
+        # charges for writes, size each job's per-write cost from its
+        # model state and gang once, up front — Job.advance folds it into
+        # the overhead leg as the write-time fraction of every productive
+        # interval.  The default (ckpt_write=0) leaves every job's fields
+        # at their dataclass defaults, keeping the advance hot path (and
+        # every replayed float) bit-identical to the unpriced engine.
+        if faults is not None and faults.recovery is not None:
+            recovery = faults.recovery
+            if getattr(recovery, "writes_cost", lambda: False)():
+                for job in self.jobs:
+                    interval = recovery.checkpoint_interval(job)
+                    if 0.0 < interval < math.inf:
+                        job.ckpt_write_s = recovery.ckpt_write_seconds(
+                            job, cluster
+                        )
+                        job.ckpt_every = interval
         policy.attach(self)
 
     # ------------------------------------------------------------------ #
@@ -199,12 +236,15 @@ class Simulator:
         for job in self.running:
             job.advance(t)
 
-    @staticmethod
-    def _bind_allocation(job: Job, alloc) -> None:
+    def _bind_allocation(self, job: Job, alloc) -> None:
         """Attach a granted allocation to a job, deriving every allocation-
-        dependent field (single site: placement quality feeds progress)."""
+        dependent field (single site: placement quality feeds progress).
+        ``slow_factor`` is the straggler multiplier (faults/): the min
+        residual rate over the granted chips — 1.0 (and free to compute)
+        whenever no chip is degraded."""
         job.allocation = alloc
         job.locality_factor = getattr(alloc.detail, "speed_factor", 1.0)
+        job.slow_factor = self.cluster.alloc_slow_factor(alloc)
 
     # ------------------------------------------------------------------ #
     # causal attribution (ISSUE 5): blame tagging + cluster sampling
@@ -213,14 +253,30 @@ class Simulator:
         """Blame for a queued-at-arrival interval, decided from cluster
         state at event time: ``capacity`` when not even unhealthy chips
         would cover the gang, ``fault-outage`` when health-masked chips
-        are what's missing, ``admission`` when enough nominally-free
-        healthy chips exist — the delay is slice geometry or scheduler
-        ordering, not a resource shortage."""
+        are what's missing, ``net-outage`` when the missing chips are
+        held by gangs stalled at rate 0 by hard DCN-uplink outages (the
+        capacity would exist if those gangs could progress and finish —
+        the PR-5 omission that misfiled this under ``capacity``),
+        ``admission`` when enough nominally-free healthy chips exist —
+        the delay is slice geometry or scheduler ordering, not a
+        resource shortage."""
         free = self.cluster.free_chips
         if free >= job.num_chips:
             return "admission"
-        if free + self.cluster.unhealthy_chips >= job.num_chips:
+        unhealthy = self.cluster.unhealthy_chips
+        if free + unhealthy >= job.num_chips:
             return "fault-outage"
+        if self.net is not None:
+            # a locality factor of exactly 0.0 only arises from a fully
+            # degraded uplink (net/model.py): the gang holds its chips
+            # but can never finish until the link heals
+            stalled = sum(
+                j.allocated_chips
+                for j in self.running
+                if j.locality_factor == 0.0
+            )
+            if stalled and free + unhealthy + stalled >= job.num_chips:
+                return "net-outage"
         return "capacity"
 
     def _open_blame(self, job: Job, cause: str) -> None:
@@ -331,6 +387,8 @@ class Simulator:
             extra = {"chips": chips, "speed": speed, "overhead": overhead,
                      "locality": job.locality_factor,
                      "track": track_label(alloc.detail), "prog": _prog(job)}
+            if job.slow_factor != 1.0:
+                extra["slow_factor"] = job.slow_factor
             if why is not None:
                 extra["why"] = why
             if self.attribution:
@@ -355,6 +413,7 @@ class Simulator:
         job.allocated_chips = 0
         job.speed = 0.0
         job.locality_factor = 1.0
+        job.slow_factor = 1.0
         job.epoch += 1
         job.preempt_count += 1
         job.state = JobState.SUSPENDED if suspend else JobState.PENDING
@@ -438,6 +497,8 @@ class Simulator:
         if self.metrics.record_events:
             extra = {"overhead": overhead, "locality": job.locality_factor,
                      "track": track_label(alloc.detail), "prog": _prog(job)}
+            if job.slow_factor != 1.0:
+                extra["slow_factor"] = job.slow_factor
             if why is not None:
                 extra["why"] = why
             self.metrics.event("migrate", self.now, job, **extra)
@@ -483,6 +544,8 @@ class Simulator:
             extra = {"chips": chips, "speed": speed,
                      "locality": job.locality_factor,
                      "track": track_label(alloc.detail), "prog": _prog(job)}
+            if job.slow_factor != 1.0:
+                extra["slow_factor"] = job.slow_factor
             if why is not None:
                 extra["why"] = why
             self.metrics.event("resize", self.now, job, **extra)
@@ -500,11 +563,14 @@ class Simulator:
             return
         if old_detail is not None and alloc.detail == old_detail:
             return
+        extra = {}
+        if job.slow_factor != 1.0:
+            extra["slow_factor"] = job.slow_factor
         self.metrics.event(
             "rebind", self.now, job,
             chips=job.allocated_chips, speed=job.speed,
             locality=job.locality_factor,
-            track=track_label(alloc.detail), prog=_prog(job),
+            track=track_label(alloc.detail), prog=_prog(job), **extra,
         )
 
     # ------------------------------------------------------------------ #
@@ -601,20 +667,32 @@ class Simulator:
 
     def _apply_fault(self, rec) -> None:
         """One hardware outage: mark the scope unhealthy, revoke every
-        running gang on it, schedule the repair, and let the policy react."""
+        running gang on it, schedule the repair, and let the policy react.
+
+        A correlated domain outage (``kind="domain"``) rides this same
+        path: its scope covers every chip under the host/rack/pod at
+        once, so the single ``mark_unhealthy`` returns every overlapping
+        gang and the whole blast radius is one fault event, one
+        revocation batch, one repair — the single-event accounting the
+        per-chip model could not express."""
         if rec.scope and rec.scope[0] == "link":
             self._apply_link_fault(rec)
+            return
+        if rec.kind == "straggler":
+            self._apply_straggler(rec)
             return
         victim_ids = self.cluster.mark_unhealthy(rec.scope)
         self.metrics.count("faults")
         self.metrics.count(f"faults_{rec.kind}")
         if self.metrics.record_events:
+            extra = {"level": rec.level} if rec.level else {}
             self.metrics.event(
                 "fault", self.now, None,
                 scope=rec.label, fault=rec.kind, fid=self._fault_ids[id(rec)],
                 # "inf" (string) keeps events.jsonl strict JSON for
                 # never-repaired outages
                 duration=rec.duration if math.isfinite(rec.duration) else "inf",
+                **extra,
             )
         if math.isfinite(rec.duration):
             # duration <= 0 lands in this same batch (kind order puts the
@@ -658,6 +736,104 @@ class Simulator:
             self._push(self.now + max(0.0, rec.duration), _REPAIR, rec)
         self.policy.on_fault(self, rec, [])
 
+    def _apply_straggler(self, rec) -> None:
+        """A straggler onset (``kind="straggler"``): one chip/node drops
+        to ``rec.degrade`` of its rate.  Nothing is revoked and no chip
+        leaves the health mask — the unit stays allocatable, just slow —
+        but every synchronous gang holding it slows to the straggler's
+        rate (``Job.slow_factor``, the compute-side analogue of PR 4's
+        link degradation).  Clusters without a degrade mask record the
+        fault but cannot slow anyone (``straggler_faults_inert``, the
+        link_faults_inert pattern)."""
+        self.metrics.count("faults")
+        self.metrics.count(f"faults_{rec.kind}")
+        if self.metrics.record_events:
+            self.metrics.event(
+                "fault", self.now, None,
+                scope=rec.label, fault=rec.kind, fid=self._fault_ids[id(rec)],
+                degrade=rec.degrade,
+                duration=rec.duration if math.isfinite(rec.duration) else "inf",
+            )
+        mark = getattr(self.cluster, "mark_degraded", None)
+        if mark is None:
+            self.metrics.count("straggler_faults_inert")
+        else:
+            mark(rec.scope, rec.degrade)
+            self._apply_slow_factors()
+        if math.isfinite(rec.duration):
+            self._push(self.now + max(0.0, rec.duration), _REPAIR, rec)
+        self.policy.on_fault(self, rec, [])
+
+    def _apply_slow_factors(self) -> None:
+        """Re-derive every running gang's straggler multiplier from the
+        cluster's degrade mask after a straggler onset or recovery.
+        Factor changes ride the usual re-predict machinery (advance at
+        the old rate, bind, epoch bump, reschedule) and are emitted as
+        ``slow`` events with the exact progress snapshot, so the
+        analyzer tracks the rate change without replaying the mask."""
+        record = self.metrics.record_events
+        for job in self.running:
+            factor = self.cluster.alloc_slow_factor(job.allocation)
+            if factor == job.slow_factor:
+                continue
+            job.advance(self.now)
+            job.slow_factor = factor
+            job.epoch += 1
+            self._schedule_completion(job)
+            self.metrics.count("straggler_reprices")
+            if record:
+                self.metrics.event(
+                    "slow", self.now, job, slow_factor=factor,
+                    prog=_prog(job),
+                )
+
+    def _apply_warning(self, rec) -> None:
+        """A spot pre-revoke notice, ``rec.warning`` seconds ahead of its
+        revocation: every gang that would be revoked right now gets the
+        chance to take an *emergency checkpoint* (faults/recovery.py) —
+        when the window covers the job's checkpoint-write cost, the
+        write is charged as overhead inside the window and the rollback
+        floor rises to the warned watermark, so the later revocation
+        loses only the window's tail instead of a full checkpoint
+        interval.  Gangs whose write cannot finish in time are notified
+        but unprotected (``spot_warnings_missed``)."""
+        self.metrics.count("spot_warnings")
+        peek = getattr(self.cluster, "peek_victims", None)
+        victim_ids = set(peek(rec.scope)) if peek is not None else set()
+        victims = [
+            j for j in self.running
+            if j.allocation is not None and j.allocation.alloc_id in victim_ids
+        ]
+        record = self.metrics.record_events
+        recovery = self.faults.recovery
+        window = rec.time - self.now
+        for job in victims:
+            write = recovery.ckpt_write_seconds(job, self.cluster)
+            if write > window + self.eps:
+                self.metrics.count("spot_warnings_missed")
+                if record:
+                    self.metrics.event(
+                        "warn", self.now, job, scope=rec.label,
+                        fault=rec.kind, window=window, write=write,
+                        saved=False,
+                    )
+                continue
+            job.advance(self.now)
+            job.ckpt_protected = max(
+                job.ckpt_protected or 0.0, job.executed_work
+            )
+            job.overhead_remaining += write
+            job.epoch += 1
+            self._schedule_completion(job)
+            self._warned_jobs.setdefault(id(rec), set()).add(job.job_id)
+            self.metrics.count("emergency_ckpts")
+            if record:
+                self.metrics.event(
+                    "warn", self.now, job, scope=rec.label, fault=rec.kind,
+                    window=window, write=write, saved=True, prog=_prog(job),
+                )
+        self.policy.on_warning(self, rec, victims)
+
     def _revoke(self, job: Job, rec) -> None:
         """Fault-revoke one running job: progress rolls back to its last
         checkpoint, a restore cost is charged for the next run, and the job
@@ -671,6 +847,14 @@ class Simulator:
         # with the slice's host count in "auto" mode)
         restore = recovery.restore_overhead(job, self.cluster)
         lost = recovery.lost_progress(job)
+        # a warned revocation is one whose OWN pre-revoke notice took the
+        # emergency checkpoint that then shrank the rollback; the
+        # persistent watermark shrinking a later unrelated revocation's
+        # loss does not count (that record gave no warning)
+        warned = (
+            job.job_id in self._warned_jobs.get(id(rec), ())
+            and lost < recovery.lost_progress(job, use_emergency=False)
+        )
         if lost > 0.0 and job.executed_work > 0.0:
             # prorate the rolled-back share of this job's useful chip-time
             # into the lost leg of the goodput decomposition: surviving
@@ -686,6 +870,7 @@ class Simulator:
         job.allocated_chips = 0
         job.speed = 0.0
         job.locality_factor = 1.0
+        job.slow_factor = 1.0
         job.epoch += 1
         job.fault_count += 1
         # the checkpoint restore supersedes any partially burned setup cost
@@ -695,6 +880,8 @@ class Simulator:
         self.running.remove(job)
         self.pending.append(job)
         self.metrics.count("fault_revocations")
+        if warned:
+            self.metrics.count("warned_revocations")
         if self.attribution:
             self._open_blame(job, "fault-outage")
         if record:
@@ -705,6 +892,8 @@ class Simulator:
             extra = {}
             if self.attribution:
                 extra = {"cause": "fault-outage", "blame": dict(job.attrib)}
+            if warned:
+                extra["warned"] = True
             self.metrics.event(
                 "revoke", self.now, job,
                 scope=rec.label, fault=rec.kind,
@@ -762,6 +951,13 @@ class Simulator:
                         extra = {"chips": job.num_chips,
                                  "duration": job.duration,
                                  "status": job.status}
+                        if job.ckpt_write_s > 0.0:
+                            # priced checkpoint writes: the analyzer needs
+                            # the per-job write cost and period to mirror
+                            # the engine's work/overhead split in its
+                            # drift guard
+                            extra["ckpt_write_s"] = job.ckpt_write_s
+                            extra["ckpt_every"] = job.ckpt_every
                         if cause is not None:
                             extra["cause"] = cause
                         self.metrics.event("arrival", t, job, **extra)
@@ -779,6 +975,11 @@ class Simulator:
             elif kind == _FAULT:
                 self._apply_fault(payload)
                 dirty = True
+            elif kind == _WARN:
+                # spot pre-revoke notice (ISSUE 6): may charge emergency
+                # checkpoint overhead, so the policy gets a pass after it
+                self._apply_warning(payload)
+                dirty = True
             elif kind == _REPAIR:
                 if payload.scope and payload.scope[0] == "link":
                     # uplink outages live in the net model, not the chip
@@ -786,6 +987,15 @@ class Simulator:
                     if self.net is not None:
                         self.net.repair_link(int(payload.scope[1]),
                                              payload.degrade)
+                elif payload.kind == "straggler":
+                    # straggler recovery lives in the degrade mask, not
+                    # the health mask; gangs on the healed unit speed
+                    # back up through the same slow-factor re-derivation
+                    if hasattr(self.cluster, "clear_degraded"):
+                        self.cluster.clear_degraded(
+                            payload.scope, payload.degrade
+                        )
+                        self._apply_slow_factors()
                 else:
                     self.cluster.repair(payload.scope)
                 self.metrics.count("repairs")

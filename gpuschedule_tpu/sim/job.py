@@ -21,6 +21,7 @@ policy needs:
 from __future__ import annotations
 
 import enum
+import math
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
@@ -32,23 +33,32 @@ from typing import Any, Dict, Optional
 #   once, when the interval *starts* (the engine's blame rule at arrival /
 #   preempt / revoke time), and the whole interval is charged to it:
 #   ``capacity`` (not enough healthy chips existed), ``fault-outage``
-#   (enough chips existed but some were health-masked), ``admission``
-#   (enough nominally-free healthy chips existed — the delay is geometry
-#   or scheduler ordering, not resource shortage), ``policy-preempt``
+#   (enough chips existed but some were health-masked), ``net-outage``
+#   (enough chips existed but they are held by gangs stalled at rate 0
+#   by hard DCN-uplink outages — the capacity shortage IS the link
+#   outage; distinct from the running ``net-degraded`` leg, which would
+#   collide with it in the shared legs dict), ``admission`` (enough
+#   nominally-free healthy chips existed — the delay is geometry or
+#   scheduler ordering, not resource shortage), ``policy-preempt``
 #   (the interval began with a policy preemption; the preempting rule's
 #   machine-parseable code rides on the event).
-# - RUN_LEGS split every running second: ``work`` (speed x locality — the
-#   reference-speed work-equivalent; sums to ~duration for a finished
-#   job), ``policy-share`` ((1-speed) — time-sliced packing / elastic
-#   shrink; negative when an elastic grow runs the job *faster* than its
-#   trace speed), ``net-degraded`` (speed x (1-locality) — interconnect
-#   stretch: DCN contention, static multislice toll, GPU locality tiers),
-#   ``overhead`` (modeled restart/migration/restore burn).
+# - RUN_LEGS split every running second: ``work`` (speed x locality x
+#   slow — the reference-speed work-equivalent; sums to ~duration for a
+#   finished job), ``policy-share`` ((1-speed) — time-sliced packing /
+#   elastic shrink; negative when an elastic grow runs the job *faster*
+#   than its trace speed), ``net-degraded`` (speed x (1-locality) —
+#   interconnect stretch: DCN contention, static multislice toll, GPU
+#   locality tiers), ``straggler`` (speed x locality x (1-slow) — the
+#   gang running at a degraded chip's rate, faults/), ``overhead``
+#   (modeled restart/migration/restore burn, including priced
+#   checkpoint writes).
 #
 # The analyzer (obs/analyze.py) re-declares these names — the obs layer
 # never imports the sim package at module load; tests pin the two equal.
-WAIT_CAUSES = ("admission", "capacity", "fault-outage", "policy-preempt")
-RUN_LEGS = ("work", "policy-share", "net-degraded", "overhead")
+WAIT_CAUSES = (
+    "admission", "capacity", "fault-outage", "net-outage", "policy-preempt"
+)
+RUN_LEGS = ("work", "policy-share", "net-degraded", "straggler", "overhead")
 
 
 class JobState(enum.Enum):
@@ -107,6 +117,21 @@ class Job:
                                         # multiple (None -> the fault plan's
                                         # RecoveryModel default, faults/)
 
+    # ---- priced recovery (engine-armed from the fault plan, faults/) ----
+    ckpt_write_s: float = 0.0           # seconds one periodic checkpoint write
+                                        # takes (0 = free writes, the historical
+                                        # model; advance() folds the cost into
+                                        # the overhead leg when > 0)
+    ckpt_every: float = math.inf        # work-seconds between priced writes
+                                        # (the resolved checkpoint interval;
+                                        # inf with ckpt_write_s=0 keeps the
+                                        # write branch cold)
+    ckpt_protected: Optional[float] = None
+                                        # emergency-checkpoint watermark: work
+                                        # protected by the newest warned spot
+                                        # checkpoint — the rollback floor rises
+                                        # to max(periodic multiple, this)
+
     # ---- runtime accounting (engine-owned) ----
     state: JobState = JobState.PENDING
     executed_work: float = 0.0          # reference-speed seconds of work done
@@ -117,6 +142,11 @@ class Job:
                                         # TPU slices (contiguous by construction),
                                         # <1.0 for scattered GPU gangs (NVLink vs
                                         # PCIe vs cross-switch, cluster/gpu.py)
+    slow_factor: float = 1.0            # straggler multiplier (faults/): the min
+                                        # residual rate over the gang's chips —
+                                        # a synchronous gang runs at its slowest
+                                        # chip's rate; engine-set from the
+                                        # cluster's degrade mask on every bind
     overhead_remaining: float = 0.0     # modeled restart cost still to burn (s)
     allocation: Optional[Any] = None    # cluster allocation handle when RUNNING
     allocated_chips: int = 0            # chips currently held (elastic != num_chips)
@@ -171,14 +201,23 @@ class Job:
 
     @property
     def effective_speed(self) -> float:
-        """Actual progress rate: policy speed degraded by placement quality."""
-        return self.speed * self.locality_factor
+        """Actual progress rate: policy speed degraded by placement
+        quality and any straggler chip in the gang (x1.0 is exact, so
+        straggler-free replays keep bit-identical floats)."""
+        return self.speed * self.locality_factor * self.slow_factor
 
     def remaining_runtime(self) -> float:
         """Wall-clock seconds to completion at the current speed (inf if idle)."""
         if self.effective_speed <= 0.0:
             return float("inf")
-        return self.overhead_remaining + self.remaining_work / self.effective_speed
+        t = self.overhead_remaining + self.remaining_work / self.effective_speed
+        if self.ckpt_write_s > 0.0 and 0.0 < self.ckpt_every < math.inf:
+            # priced checkpoint writes stretch the remaining wall time by
+            # one write per ckpt_every work-seconds still owed — the same
+            # split advance() integrates, so predictions land on the
+            # completion instant instead of firing early and re-predicting
+            t += self.remaining_work * (self.ckpt_write_s / self.ckpt_every)
+        return t
 
     def advance(self, now: float) -> None:
         """Integrate progress from ``last_update_time`` to ``now``.
@@ -205,13 +244,52 @@ class Job:
                 self.attrib["overhead"] = self.attrib.get("overhead", 0.0) + burned
             dt -= burned
         if dt > 0.0:
+            if self.ckpt_write_s > 0.0 and 0.0 < self.ckpt_every < math.inf:
+                # Priced checkpoint writes (faults/recovery.py): the job
+                # alternates ckpt_every work-seconds of progress with one
+                # ckpt_write_s write, so the steady-state write share of
+                # wall time is e*w / (every + e*w) at effective speed e.
+                # The write share occupies chips without producing work —
+                # the overhead leg — exactly like restore burn.  Gated on
+                # the knob so free-write replays keep the branchless
+                # arithmetic below bit for bit.
+                e = self.effective_speed
+                write = dt * (e * self.ckpt_write_s) / (
+                    self.ckpt_every + e * self.ckpt_write_s
+                )
+                run = dt - write
+                self.executed_work += e * run
+                self.attained_service += self.allocated_chips * run
+                self.overhead_service += self.allocated_chips * write
+                if self.attrib is not None:
+                    a = self.attrib
+                    a["overhead"] = a.get("overhead", 0.0) + write
+                    a["work"] = a.get("work", 0.0) + e * run
+                    if self.speed != 1.0:
+                        a["policy-share"] = (
+                            a.get("policy-share", 0.0)
+                            + (1.0 - self.speed) * run
+                        )
+                    if self.locality_factor != 1.0:
+                        a["net-degraded"] = (
+                            a.get("net-degraded", 0.0)
+                            + self.speed * (1.0 - self.locality_factor) * run
+                        )
+                    if self.slow_factor != 1.0:
+                        a["straggler"] = (
+                            a.get("straggler", 0.0)
+                            + self.speed * self.locality_factor
+                            * (1.0 - self.slow_factor) * run
+                        )
+                return
             self.executed_work += self.effective_speed * dt
             self.attained_service += self.allocated_chips * dt
             if self.attrib is not None:
                 # RUN_LEGS split of this productive interval: work +
-                # policy-share + net-degraded == dt in real arithmetic
-                # (s*l + (1-s) + s*(1-l) == 1); the decomposition's own
-                # ordered sum absorbs the float dust
+                # policy-share + net-degraded + straggler == dt in real
+                # arithmetic (s*l*f + (1-s) + s*(1-l) + s*l*(1-f) == 1);
+                # the decomposition's own ordered sum absorbs the float
+                # dust
                 a = self.attrib
                 a["work"] = a.get("work", 0.0) + self.effective_speed * dt
                 if self.speed != 1.0:
@@ -222,6 +300,12 @@ class Job:
                     a["net-degraded"] = (
                         a.get("net-degraded", 0.0)
                         + self.speed * (1.0 - self.locality_factor) * dt
+                    )
+                if self.slow_factor != 1.0:
+                    a["straggler"] = (
+                        a.get("straggler", 0.0)
+                        + self.speed * self.locality_factor
+                        * (1.0 - self.slow_factor) * dt
                     )
 
     def jct(self) -> Optional[float]:

@@ -83,6 +83,36 @@ def migrate_seconds(model_name: str, chips: int, *, generation: str = "v5e") -> 
     return restore_seconds(model_name, chips, generation=generation, round_trips=2)
 
 
+# Fixed floor of one checkpoint *write*: flushing device buffers and
+# committing the manifest — much smaller than the restore floor because no
+# process restart or compile is on this path.
+CKPT_WRITE_BASE_S = 1.0
+
+
+def ckpt_write_seconds(
+    model_name: str,
+    chips: int,
+    *,
+    generation: str = "v5e",
+    base_s: float = CKPT_WRITE_BASE_S,
+    host_gbps: float = DCN_GBPS,
+) -> float:
+    """Seconds one periodic checkpoint WRITE takes on a ``chips``-chip
+    slice: the same state-streaming transfer as :func:`restore_seconds`
+    (every host pushes its shard in parallel, so bigger slices write
+    faster while bigger models write slower) over a much smaller fixed
+    floor.  This is what ``RecoveryModel.ckpt_write="auto"`` charges the
+    ``overhead`` leg every ``ckpt_interval`` work-seconds — the priced-
+    recovery half of the checkpoint trade (the other half is the lost
+    work a revocation rolls back)."""
+    if chips < 1:
+        raise ValueError(f"chips must be >= 1, got {chips}")
+    spec = GENERATIONS[generation]
+    hosts = max(1, math.ceil(chips / spec["chips_per_host"]))
+    bytes_per_s = hosts * host_gbps * 1e9 / 8.0
+    return base_s + ckpt_bytes(model_name) / bytes_per_s
+
+
 def cluster_generation(cluster) -> str:
     """Best-effort generation lookup for overhead modeling (v5e default)."""
     gen = getattr(cluster, "generation", None)

@@ -1,0 +1,602 @@
+"""Correlated failure domains, straggler chips, and priced recovery
+(ISSUE 6): domain hierarchy enumeration, single-event blast-radius
+accounting, seed-stream independence, straggler slow-factor arithmetic,
+checkpoint-write pricing, spot warning windows, the queued net-outage
+blame cause, and the sweep's availability/MTTR columns.
+"""
+
+import json
+import math
+
+import pytest
+
+from gpuschedule_tpu.cluster import GpuCluster, SimpleCluster, TpuCluster
+from gpuschedule_tpu.faults import (
+    FaultConfig,
+    FaultPlan,
+    FaultRecord,
+    RecoveryModel,
+    generate_fault_schedule,
+    parse_fault_spec,
+)
+from gpuschedule_tpu.faults.sweep import availability_summary, jsonable, run_cell
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.sim import Job, Simulator
+from gpuschedule_tpu.sim.metrics import MetricsLog
+
+
+def goodput_closes(res, tol=1e-6):
+    g = res.goodput
+    total = g["useful_chip_s"] + g["lost_chip_s"] + g["restart_overhead_chip_s"]
+    assert total == pytest.approx(g["total_chip_s"], abs=tol, rel=1e-9)
+
+
+# --------------------------------------------------------------------- #
+# failure-domain enumeration
+
+
+def test_tpu_failure_domains_tile_the_pod():
+    """v5e (16x16, 8 chips/host): 32 host boxes + 8 rack boxes + the pod,
+    hosts disjoint and covering every chip exactly once."""
+    c = TpuCluster("v5e")
+    domains = c.failure_domains()
+    hosts = [d for lvl, d in domains if lvl == "host"]
+    racks = [d for lvl, d in domains if lvl == "rack"]
+    pods = [d for lvl, d in domains if lvl == "pod"]
+    assert len(hosts) == 256 // 8 and len(racks) == 256 // 32
+    assert pods == [("pod", 0)]
+    seen = set()
+    for _, pod, origin, shape in hosts:
+        assert math.prod(shape) == 8
+        for dx in range(shape[0]):
+            for dy in range(shape[1]):
+                chip = (origin[0] + dx, origin[1] + dy)
+                assert chip not in seen  # disjoint
+                seen.add(chip)
+    assert len(seen) == 256  # covering
+
+
+def test_failure_domains_gpu_and_flat():
+    g = GpuCluster(num_switches=2, nodes_per_switch=4, gpus_per_node=8)
+    doms = g.failure_domains()
+    assert sum(1 for lvl, _ in doms if lvl == "host") == 8
+    assert [d for lvl, d in doms if lvl == "rack"] == [
+        ("switch", 0), ("switch", 1)
+    ]
+    s = SimpleCluster(64)
+    doms = s.failure_domains()
+    assert sum(1 for lvl, d in doms if lvl == "host") == 8
+    assert all(d == ("chips", 8) for lvl, d in doms)
+
+
+# --------------------------------------------------------------------- #
+# schedule generation: determinism + seed-stream independence
+
+
+def test_domain_and_straggler_schedules_deterministic():
+    cfg = FaultConfig(domain_mtbf=40000.0, straggler_mtbf=30000.0)
+    mk = lambda: generate_fault_schedule(  # noqa: E731
+        TpuCluster("v5e", dims=(4, 4), num_pods=2), cfg,
+        horizon=400000.0, seed=11,
+    )
+    a, b = mk(), mk()
+    assert a and a == b
+    kinds = {r.kind for r in a}
+    assert kinds == {"domain", "straggler"}
+    assert all(r.level in ("host", "rack", "pod") for r in a
+               if r.kind == "domain")
+    assert all(r.degrade == 0.5 for r in a if r.kind == "straggler")
+
+
+def test_new_streams_independent_of_old_streams():
+    """The seed-split satellite: arming domain/straggler processes must
+    not perturb a single record of the mtbf/maintenance/spot/link
+    streams (and vice versa) — every process draws from its own
+    ``{seed}:faults:<process>`` RNG."""
+    base = dict(mtbf=9000.0, repair=600.0, maintenance_period=50000.0,
+                spot_fraction=0.5, spot_mtbf=20000.0,
+                link_mtbf=80000.0)
+    cluster = lambda: TpuCluster("v5e", dims=(4, 4), num_pods=2)  # noqa: E731
+    old = generate_fault_schedule(
+        cluster(), FaultConfig(**base), horizon=200000.0, seed=5)
+    both = generate_fault_schedule(
+        cluster(),
+        FaultConfig(**base, domain_mtbf=60000.0, straggler_mtbf=50000.0),
+        horizon=200000.0, seed=5)
+    new_kinds = ("domain", "straggler")
+    assert [r for r in both if r.kind not in new_kinds] == old
+    # and the new streams alone reproduce their slice of the combined run
+    only_new = generate_fault_schedule(
+        cluster(),
+        FaultConfig(domain_mtbf=60000.0, straggler_mtbf=50000.0),
+        horizon=200000.0, seed=5)
+    assert [r for r in both if r.kind in new_kinds] == only_new
+    assert only_new  # the processes actually fired
+
+
+def test_spot_records_carry_warning():
+    cfg = FaultConfig(spot_fraction=0.5, spot_mtbf=20000.0,
+                      spot_warning=300.0)
+    recs = generate_fault_schedule(
+        TpuCluster("v5e", dims=(4, 4), num_pods=2), cfg,
+        horizon=100000.0, seed=2)
+    spots = [r for r in recs if r.kind == "spot"]
+    assert spots and all(r.warning == 300.0 for r in spots)
+
+
+# --------------------------------------------------------------------- #
+# correlated domain outages: single-event blast radius
+
+
+def test_domain_outage_revokes_every_gang_under_it_at_once():
+    """A rack box covering two running gangs: ONE fault event, TWO
+    revocations, one repair — the single-event accounting."""
+    cluster = TpuCluster("v5e", dims=(4, 4))
+    jobs = [Job("a", 0.0, num_chips=4, duration=1000.0),
+            Job("b", 0.0, num_chips=4, duration=1000.0)]
+    plan = FaultPlan(
+        records=[FaultRecord(50.0, ("box", 0, (0, 0), (2, 4)), 100.0,
+                             "domain", level="rack")],
+        recovery=RecoveryModel(ckpt_interval=math.inf, restore=0.0),
+    )
+    metrics = MetricsLog(record_events=True)
+    res = Simulator(cluster, make_policy("fifo"), jobs, metrics=metrics,
+                    faults=plan).run()
+    assert res.counters["faults"] == 1
+    assert res.counters["faults_domain"] == 1
+    assert res.counters["fault_revocations"] == 2
+    assert all(j.fault_count == 1 for j in jobs)
+    faults = [e for e in metrics.events if e["event"] == "fault"]
+    assert len(faults) == 1 and faults[0]["level"] == "rack"
+    goodput_closes(res)
+
+
+def test_gpu_switch_scope_marks_every_node():
+    g = GpuCluster(num_switches=2, nodes_per_switch=2, gpus_per_node=4)
+    a = g.allocate(8)  # spans both nodes of switch 0 (consolidated fill)
+    nodes = {nd for nd, _ in a.detail.nodes}
+    sw = next(iter(nodes))[0]
+    assert g.peek_victims(("switch", sw)) == [a.alloc_id]
+    assert g.mark_unhealthy(("switch", sw)) == [a.alloc_id]
+    g.free(a)
+    assert g.unhealthy_chips == 8  # both nodes of the switch
+    g.repair(("switch", sw))
+    assert g.unhealthy_chips == 0
+    with pytest.raises(ValueError, match="healthy node"):
+        g.repair(("switch", sw))
+
+
+def test_permanent_domain_outage_quiesces_tick_policy():
+    """The _quiesced() satellite: a never-repaired domain outage strands
+    every pending gang; Gandiva's tick chain must terminate."""
+    jobs = [Job("a", 0.0, num_chips=4, duration=5000.0),
+            Job("b", 10.0, num_chips=4, duration=5000.0)]
+    plan = FaultPlan(records=[
+        FaultRecord(50.0, ("pod", 0), math.inf, "domain", level="pod"),
+    ])
+    res = Simulator(TpuCluster("v5e", dims=(4, 4)), make_policy("gandiva"),
+                    jobs, faults=plan).run()
+    assert res.num_finished == 0 and res.num_unfinished == 2
+    goodput_closes(res)
+
+
+# --------------------------------------------------------------------- #
+# straggler chips
+
+
+def test_straggler_slows_gang_hand_computed():
+    """One 4-chip gang at (0,0)-(1,1); its chip (0,0) runs at 0.5 for
+    200s.  Work: 100s at 1.0 + 200s at 0.5 = 200 by t=300, remaining 400
+    at 1.0 -> end at 700.  Two slow events (onset + recovery)."""
+    cluster = TpuCluster("v5e", dims=(4, 4))
+    job = Job("s", 0.0, num_chips=4, duration=600.0)
+    plan = FaultPlan(records=[
+        FaultRecord(100.0, ("chip", 0, (0, 0)), 200.0, "straggler",
+                    degrade=0.5),
+    ])
+    metrics = MetricsLog(record_events=True)
+    res = Simulator(cluster, make_policy("fifo"), [job], metrics=metrics,
+                    faults=plan).run()
+    assert job.end_time == pytest.approx(700.0)
+    assert job.fault_count == 0  # slowed, never revoked
+    assert res.counters["faults_straggler"] == 1
+    assert res.counters["straggler_reprices"] == 2
+    slows = [e for e in metrics.events if e["event"] == "slow"]
+    assert [e["slow_factor"] for e in slows] == [0.5, 1.0]
+    goodput_closes(res)
+
+
+def test_straggler_only_slows_overlapping_gang():
+    cluster = TpuCluster("v5e", dims=(4, 4))
+    hit = Job("hit", 0.0, num_chips=4, duration=100.0)
+    miss = Job("miss", 0.0, num_chips=4, duration=100.0)
+    plan = FaultPlan(records=[
+        FaultRecord(10.0, ("chip", 0, (0, 0)), math.inf, "straggler",
+                    degrade=0.5),
+    ])
+    Simulator(cluster, make_policy("fifo"), [hit, miss], faults=plan).run()
+    # first-fit: "hit" owns (0,0)-(1,1), "miss" owns (0,2)-(1,3)
+    assert hit.end_time == pytest.approx(10.0 + 90.0 / 0.5)
+    assert miss.end_time == pytest.approx(100.0)
+
+
+def test_total_straggler_stall_quiesces():
+    """degrade=0 pins the gang at rate 0 forever (permanent straggler):
+    nothing can complete, the engine must quiesce instead of spinning."""
+    job = Job("z", 0.0, num_chips=4, duration=100.0)
+    plan = FaultPlan(records=[
+        FaultRecord(10.0, ("chip", 0, (0, 0)), math.inf, "straggler",
+                    degrade=0.0),
+    ])
+    res = Simulator(TpuCluster("v5e", dims=(4, 4)), make_policy("gandiva"),
+                    [job], faults=plan).run()
+    assert res.num_finished == 0 and res.num_unfinished == 1
+
+
+def test_start_onto_degraded_chip_binds_slow_factor():
+    """A gang placed onto an already-degraded chip starts slow: the
+    engine derives slow_factor at bind time and the start event carries
+    it."""
+    cluster = TpuCluster("v5e", dims=(4, 4))
+    job = Job("late", 50.0, num_chips=16, duration=100.0)  # whole pod
+    plan = FaultPlan(records=[
+        FaultRecord(10.0, ("chip", 0, (3, 3)), math.inf, "straggler",
+                    degrade=0.25),
+    ])
+    metrics = MetricsLog(record_events=True)
+    Simulator(cluster, make_policy("fifo"), [job], metrics=metrics,
+              faults=plan).run()
+    starts = [e for e in metrics.events if e["event"] == "start"]
+    assert starts and starts[0]["slow_factor"] == 0.25
+    assert job.end_time == pytest.approx(50.0 + 100.0 / 0.25)
+
+
+def test_alloc_slow_factor_is_min_over_gang():
+    c = TpuCluster("v5e", dims=(4, 4))
+    a = c.allocate(4)   # (2,2) @ (0,0)
+    b = c.allocate(4)   # (2,2) @ (0,2)
+    c.mark_degraded(("chip", 0, (0, 0)), 0.8)
+    c.mark_degraded(("chip", 0, (1, 1)), 0.5)
+    assert c.alloc_slow_factor(a) == 0.5
+    assert c.alloc_slow_factor(b) == 1.0
+    assert c.degraded_chips() == {(0, (0, 0)): 0.8, (0, (1, 1)): 0.5}
+    # stacked degradations multiply; clearing one restores the other
+    c.mark_degraded(("chip", 0, (0, 0)), 0.5)
+    assert c.degraded_chips()[(0, (0, 0))] == pytest.approx(0.4)
+    c.clear_degraded(("chip", 0, (0, 0)), 0.8)
+    assert c.alloc_slow_factor(a) == 0.5
+    with pytest.raises(ValueError, match="healthy"):
+        c.clear_degraded(("chip", 0, (2, 2)), 0.5)
+
+
+def test_gandiva_evacuates_straggler_gang():
+    """Gandiva migrates a slowed, unpacked gang to another pod: the gang
+    escapes the degraded chip and finishes at full rate."""
+    cluster = TpuCluster("v5e", dims=(4, 4), num_pods=2)
+    job = Job("m", 0.0, num_chips=4, duration=1000.0, utilization=1.0)
+    plan = FaultPlan(records=[
+        FaultRecord(100.0, ("chip", 0, (0, 0)), math.inf, "straggler",
+                    degrade=0.1),
+    ])
+    res = Simulator(
+        cluster,
+        make_policy("gandiva", grow_shrink=False, packing=False),
+        [job], faults=plan,
+    ).run()
+    assert res.counters.get("straggler_evacuations") == 1
+    assert job.migration_count == 1
+    assert job.slow_factor == 0.0 or job.end_time is not None
+    # migrated at 100 paying the 45s default migration overhead:
+    # 100 + 45 + 900 = 1045 (full rate on the clean pod)
+    assert job.end_time == pytest.approx(1045.0)
+
+
+# --------------------------------------------------------------------- #
+# priced recovery: checkpoint writes
+
+
+def test_ckpt_write_cost_hand_computed():
+    """duration 100, a 2s write every 10 work-seconds: 20s of write
+    overhead -> ends at 120, with the writes in the overhead leg."""
+    job = Job("w", 0.0, num_chips=4, duration=100.0)
+    plan = FaultPlan(records=[], recovery=RecoveryModel(
+        ckpt_interval=10.0, restore=0.0, ckpt_write=2.0))
+    res = Simulator(SimpleCluster(4), make_policy("fifo"), [job],
+                    faults=plan).run()
+    assert job.end_time == pytest.approx(120.0)
+    g = res.goodput
+    assert g["useful_chip_s"] == pytest.approx(400.0)
+    assert g["restart_overhead_chip_s"] == pytest.approx(80.0)  # 4 x 20s
+    goodput_closes(res)
+
+
+def test_ckpt_write_attributed_to_overhead_leg():
+    job = Job("w", 0.0, num_chips=4, duration=100.0)
+    plan = FaultPlan(records=[], recovery=RecoveryModel(
+        ckpt_interval=10.0, restore=0.0, ckpt_write=2.0))
+    metrics = MetricsLog(record_events=True, attribution=True)
+    res = Simulator(SimpleCluster(4), make_policy("fifo"), [job],
+                    metrics=metrics, faults=plan).run()
+    assert res.delay_by_cause["overhead"] == pytest.approx(20.0)
+    assert res.delay_by_cause["work"] == pytest.approx(100.0)
+    arrivals = [e for e in metrics.events if e["event"] == "arrival"]
+    assert arrivals[0]["ckpt_write_s"] == 2.0
+    assert arrivals[0]["ckpt_every"] == 10.0
+
+
+def test_ckpt_write_off_keeps_fields_cold():
+    """The regression default: ckpt_write=0 must leave every job's write
+    fields at their dataclass defaults (the advance fast path)."""
+    job = Job("w", 0.0, num_chips=4, duration=100.0)
+    plan = FaultPlan(records=[], recovery=RecoveryModel(ckpt_interval=10.0))
+    Simulator(SimpleCluster(4), make_policy("fifo"), [job],
+              faults=plan).run()
+    assert job.ckpt_write_s == 0.0 and math.isinf(job.ckpt_every)
+    assert job.end_time == pytest.approx(100.0)
+
+
+# --------------------------------------------------------------------- #
+# priced recovery: spot warning windows
+
+
+def _spot_run(warning: float, write: float):
+    job = Job("v", 0.0, num_chips=4, duration=1000.0)
+    plan = FaultPlan(
+        records=[FaultRecord(500.0, ("chips", 4), 100.0, "spot",
+                             warning=warning)],
+        recovery=RecoveryModel(ckpt_interval=math.inf, restore=5.0,
+                               ckpt_write=write),
+    )
+    metrics = MetricsLog(record_events=True)
+    res = Simulator(SimpleCluster(4), make_policy("fifo"), [job],
+                    metrics=metrics, faults=plan).run()
+    return job, res, metrics.events
+
+
+def test_spot_warning_emergency_checkpoint_hand_computed():
+    """Warned 60s ahead with a 10s write and NO periodic checkpoints
+    (interval=inf — unwarned loses everything): the emergency checkpoint
+    at t=440 protects 440 work-seconds; the 10s write burns 440-450, work
+    resumes to 490 by the revocation, so only 50s are lost.  Resume at
+    repair 600 + 5s restore + 560 remaining -> 1165."""
+    job, res, events = _spot_run(warning=60.0, write=10.0)
+    assert res.counters["spot_warnings"] == 1
+    assert res.counters["emergency_ckpts"] == 1
+    assert res.counters["warned_revocations"] == 1
+    assert job.lost_work == pytest.approx(50.0)
+    assert job.end_time == pytest.approx(1165.0)
+    warns = [e for e in events if e["event"] == "warn"]
+    assert len(warns) == 1 and warns[0]["saved"] is True
+    assert warns[0]["window"] == pytest.approx(60.0)
+    revokes = [e for e in events if e["event"] == "revoke"]
+    assert revokes[0]["warned"] is True
+    assert revokes[0]["lost_work"] == pytest.approx(50.0)
+    goodput_closes(res)
+
+
+def test_spot_warning_too_short_loses_everything():
+    """A 5s window cannot cover the 10s write: notified but unprotected —
+    the revocation rolls back all 500 work-seconds (interval=inf)."""
+    job, res, events = _spot_run(warning=5.0, write=10.0)
+    assert res.counters["spot_warnings"] == 1
+    assert res.counters["spot_warnings_missed"] == 1
+    assert "emergency_ckpts" not in res.counters
+    assert "warned_revocations" not in res.counters
+    assert job.lost_work == pytest.approx(500.0)
+    warns = [e for e in events if e["event"] == "warn"]
+    assert len(warns) == 1 and warns[0]["saved"] is False
+    revokes = [e for e in events if e["event"] == "revoke"]
+    assert "warned" not in revokes[0]
+    goodput_closes(res)
+
+
+def test_later_unwarned_revocation_not_labeled_warned():
+    """The emergency watermark persists (it is a real checkpoint, so a
+    later mtbf revocation still rolls back only to it) but the later
+    revocation got no notice — it must NOT carry warned=True (review
+    regression: the flag was derived from the watermark alone)."""
+    job = Job("v", 0.0, num_chips=4, duration=2000.0)
+    plan = FaultPlan(
+        records=[
+            FaultRecord(500.0, ("chips", 4), 100.0, "spot", warning=60.0),
+            FaultRecord(1000.0, ("chips", 4), 50.0, "mtbf"),
+        ],
+        recovery=RecoveryModel(ckpt_interval=math.inf, restore=0.0,
+                               ckpt_write=10.0),
+    )
+    metrics = MetricsLog(record_events=True)
+    res = Simulator(SimpleCluster(4), make_policy("fifo"), [job],
+                    metrics=metrics, faults=plan).run()
+    revokes = [e for e in metrics.events if e["event"] == "revoke"]
+    assert len(revokes) == 2
+    assert revokes[0]["warned"] is True
+    assert revokes[0]["lost_work"] == pytest.approx(50.0)
+    assert "warned" not in revokes[1]  # no notice for the mtbf fault...
+    # ...but the persisted emergency checkpoint still floors the rollback
+    # (resumed at 600 with work=440; 840 by t=1000 -> 400 lost, not 840)
+    assert revokes[1]["lost_work"] == pytest.approx(400.0)
+    assert res.counters["warned_revocations"] == 1
+    goodput_closes(res)
+
+
+def test_unwarned_spot_unchanged():
+    """warning=0 (the PR-2 default): no warn events, no protection —
+    byte-compatible with the unannounced model."""
+    job, res, events = _spot_run(warning=0.0, write=10.0)
+    assert "spot_warnings" not in res.counters
+    assert not [e for e in events if e["event"] == "warn"]
+    assert job.lost_work == pytest.approx(500.0)
+
+
+# --------------------------------------------------------------------- #
+# queued net-outage blame cause (PR-5 omission satellite)
+
+
+def test_queued_net_outage_cause_under_hard_link_outage():
+    """A multislice gang stalled at rate 0 by a dead uplink holds both
+    pods; a later arrival's wait is blamed net-outage, not capacity."""
+    from gpuschedule_tpu.net import NetModel
+
+    cluster = TpuCluster("v5e", dims=(2, 2), num_pods=2)
+    whale = Job("whale", 0.0, num_chips=8, duration=50000.0)
+    waiter = Job("waiter", 20.0, num_chips=4, duration=10.0)
+    plan = FaultPlan(records=[
+        FaultRecord(10.0, ("link", 0), math.inf, "link", degrade=0.0),
+    ])
+    metrics = MetricsLog(record_events=True, attribution=True)
+    res = Simulator(cluster, make_policy("fifo"), [whale, waiter],
+                    metrics=metrics, faults=plan, net=NetModel(),
+                    max_time=100.0).run()
+    arrivals = {e["job"]: e for e in metrics.events
+                if e["event"] == "arrival"}
+    assert arrivals["waiter"]["cause"] == "net-outage"
+    assert res.delay_by_cause["net-outage"] == pytest.approx(80.0)
+
+
+# --------------------------------------------------------------------- #
+# sweep availability / MTTR columns
+
+
+def test_run_cell_reports_availability_and_mttr():
+    cell = run_cell("fifo", mtbf=20000.0, repair=1200.0, num_jobs=20,
+                    seed=1, dims=(4, 4), max_time=150000.0)
+    assert 0.0 <= cell["availability"] <= 1.0
+    assert cell["availability"] < 1.0  # faults actually fired
+    assert math.isfinite(cell["mttr_s"]) and cell["mttr_s"] > 0.0
+
+
+def test_fault_free_cell_availability_is_one_and_mttr_nan():
+    cell = run_cell("fifo", mtbf=math.inf, num_jobs=20, seed=1,
+                    dims=(4, 4), max_time=150000.0)
+    assert cell["availability"] == 1.0
+    assert math.isnan(cell["mttr_s"])
+    # the "inf"/"nan" JSON convention holds for the new columns
+    doc = json.loads(json.dumps(jsonable(cell)))
+    assert doc["mttr_s"] == "nan"
+
+
+def test_availability_summary_hand_computed():
+    """One 100s outage of a 4-chip box on a 16-chip pod over a 1000s
+    replay: 400 downed chip-seconds of 16000 -> availability 0.975."""
+    cluster = TpuCluster("v5e", dims=(4, 4))
+    recs = [
+        FaultRecord(100.0, ("box", 0, (0, 0), (2, 2)), 100.0, "domain",
+                    level="host"),
+        FaultRecord(50.0, ("chip", 0, (3, 3)), math.inf, "straggler",
+                    degrade=0.5),  # degrade-only: no capacity loss
+        FaultRecord(2000.0, ("pod", 0), 100.0),  # past the horizon
+    ]
+    out = availability_summary(cluster, recs, 1000.0)
+    assert out["availability"] == pytest.approx(1.0 - 400.0 / 16000.0)
+    assert out["mttr_s"] == pytest.approx(100.0)
+
+
+# --------------------------------------------------------------------- #
+# spec parsing
+
+
+def test_parse_fault_spec_new_keys():
+    config, recovery = parse_fault_spec(
+        "domain_mtbf=604800,domain_repair=7200,straggler_mtbf=302400,"
+        "straggler_repair=1800,straggler_degrade=0.3,spot=0.25,"
+        "spot_warning=120,ckpt_write=auto"
+    )
+    assert config.domain_mtbf == 604800.0
+    assert config.domain_repair == 7200.0
+    assert config.straggler_mtbf == 302400.0
+    assert config.straggler_degrade == 0.3
+    assert config.spot_warning == 120.0
+    assert recovery.ckpt_write == "auto"
+    config, recovery = parse_fault_spec("ckpt_write=15")
+    assert recovery.ckpt_write == 15.0
+
+
+@pytest.mark.parametrize("spec,msg", [
+    ("straggler_degrade=1.5", "straggler_degrade"),
+    ("spot_warning=-1", "spot_warning"),
+    ("ckpt_write=-2", "ckpt_write"),
+])
+def test_parse_fault_spec_validates_new_keys(spec, msg):
+    with pytest.raises(ValueError, match=msg):
+        parse_fault_spec(spec)
+
+
+def test_default_config_disables_every_new_process():
+    """Knobs-off regression: the default config generates exactly what
+    the pre-ISSUE-6 config generated (no domain/straggler/warn records),
+    keeping replays byte-identical."""
+    cfg = FaultConfig(mtbf=9000.0, repair=600.0)
+    recs = generate_fault_schedule(
+        TpuCluster("v5e", dims=(4, 4), num_pods=2), cfg,
+        horizon=100000.0, seed=5)
+    assert {r.kind for r in recs} == {"mtbf"}
+    assert all(r.warning == 0.0 and r.level == "" for r in recs)
+
+
+# --------------------------------------------------------------------- #
+# analyzer adoption + perfetto domain tracks
+
+
+def test_analyzer_closures_with_everything_on(tmp_path):
+    """Domains + stragglers + warned spot + priced writes, attribution
+    armed: the analyzer's goodput and delay-by-cause equal SimResult's
+    to the last float, per-job straggler legs exist, and the domain
+    outage table materializes."""
+    from gpuschedule_tpu.faults import fault_horizon
+    from gpuschedule_tpu.obs.analyze import analyze_file
+    from gpuschedule_tpu.sim.philly import generate_philly_like_trace
+
+    cfg = FaultConfig(
+        mtbf=80000.0, domain_mtbf=200000.0, straggler_mtbf=100000.0,
+        spot_fraction=0.5, spot_mtbf=30000.0, spot_warning=300.0,
+    )
+    cluster = TpuCluster("v5e", dims=(8, 8), num_pods=2)
+    jobs = generate_philly_like_trace(30, seed=4)
+    plan = FaultPlan(
+        records=generate_fault_schedule(
+            cluster, cfg, horizon=300000.0, seed=4),
+        recovery=RecoveryModel(ckpt_interval=900.0, restore=30.0,
+                               ckpt_write=20.0),
+    )
+    path = tmp_path / "events.jsonl"
+    metrics = MetricsLog(
+        events_sink=path, attribution=True,
+        run_meta={"run_id": "t", "seed": 4, "policy": "gandiva",
+                  "config_hash": "h"},
+    )
+    with metrics:
+        res = Simulator(cluster, make_policy("gandiva"), jobs,
+                        metrics=metrics, faults=plan,
+                        max_time=300000.0).run()
+    an = analyze_file(path)
+    assert an.goodput() == res.goodput
+    assert an.delay_by_cause() == res.delay_by_cause
+    assert "straggler" in res.delay_by_cause
+    assert an.domain_outages()
+    assert any(r.delay_legs.get("straggler") for r in an.jobs)
+    kinds = an.fault_attribution()["kinds"]
+    assert "domain" in kinds and "straggler" in kinds
+
+
+def test_perfetto_domain_tracks_and_slow_instants():
+    from gpuschedule_tpu.obs.perfetto import trace_events, validate_chrome_trace
+
+    cluster = TpuCluster("v5e", dims=(4, 4))
+    jobs = [Job("a", 0.0, num_chips=4, duration=500.0)]
+    plan = FaultPlan(records=[
+        FaultRecord(50.0, ("box", 0, (0, 0), (2, 4)), 100.0, "domain",
+                    level="rack"),
+        # the domain outage relocates the gang to (2,0)-(3,1); the
+        # straggler chip sits inside the NEW placement
+        FaultRecord(300.0, ("chip", 0, (2, 0)), 50.0, "straggler",
+                    degrade=0.5),
+    ], recovery=RecoveryModel(ckpt_interval=math.inf, restore=0.0))
+    metrics = MetricsLog(record_events=True)
+    Simulator(cluster, make_policy("fifo"), jobs, metrics=metrics,
+              faults=plan).run()
+    evs = trace_events(metrics.events)
+    names = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+    assert "domain" in names  # the domain process exists
+    assert any(n.startswith("domain/pod0") for n in names)
+    assert any(e["name"] == "slow" for e in evs if e["ph"] == "i")
+    assert validate_chrome_trace({"traceEvents": evs}) == []

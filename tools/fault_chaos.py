@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""Randomized fault-config chaos smoke: N seeded random fault configs x
+the eight-policy suite, asserting on every cell that
+
+- the replay does not crash (no stranded-event spin, no accounting
+  blow-up — permanent outages, zero-length blips, stacked degradations,
+  warned spot revocations and priced checkpoint writes are all in the
+  draw space), and
+- the analytics closures hold EXACTLY: the analyzer's goodput
+  decomposition equals ``SimResult.goodput`` to the last float, and its
+  ``delay_by_cause`` equals ``SimResult.delay_by_cause`` to the last
+  float, on the captured event stream of that same run.
+
+This is the fault subsystem's property test in tool form: the hand-
+written tests pin specific arithmetic, the chaos sweep pins the
+*contract* over a random walk of the whole knob space (ISSUE 6
+satellite).  Deterministic per --seed: config i draws from
+``random.Random(f"{seed}:chaos:{i}")``, and each cell replays the usual
+seeded Philly-like trace with its usual seed-split fault streams.
+
+    python tools/fault_chaos.py
+    python tools/fault_chaos.py --configs 3 --num-jobs 40 \
+        --policies fifo,gandiva --out /tmp/chaos.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import random
+import sys
+import tempfile
+from pathlib import Path
+
+# runnable directly (`python tools/fault_chaos.py`) without installing
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gpuschedule_tpu.cluster.tpu import TpuCluster  # noqa: E402
+from gpuschedule_tpu.faults.recovery import FaultPlan, RecoveryModel  # noqa: E402
+from gpuschedule_tpu.faults.schedule import (  # noqa: E402
+    FaultConfig,
+    fault_horizon,
+    generate_fault_schedule,
+)
+from gpuschedule_tpu.faults.sweep import POLICY_CONFIGS, jsonable  # noqa: E402
+from gpuschedule_tpu.obs.analyze import analyze_file  # noqa: E402
+from gpuschedule_tpu.policies import make_policy  # noqa: E402
+from gpuschedule_tpu.sim import Simulator  # noqa: E402
+from gpuschedule_tpu.sim.metrics import MetricsLog  # noqa: E402
+from gpuschedule_tpu.sim.philly import generate_philly_like_trace  # noqa: E402
+
+
+def _loguniform(rng: random.Random, lo: float, hi: float) -> float:
+    return math.exp(rng.uniform(math.log(lo), math.log(hi)))
+
+
+def draw_config(rng: random.Random):
+    """One random point in the full fault knob space: every process can
+    be on or off, repairs can be permanent, degradations can be total."""
+    config = FaultConfig(
+        mtbf=(math.inf if rng.random() < 0.25
+              else _loguniform(rng, 3e3, 1e5)),
+        repair=(math.inf if rng.random() < 0.1
+                else rng.uniform(300.0, 7200.0)),
+        maintenance_period=(0.0 if rng.random() < 0.5
+                            else rng.uniform(2e4, 1e5)),
+        maintenance_duration=rng.uniform(1800.0, 14400.0),
+        spot_fraction=(0.0 if rng.random() < 0.5
+                       else rng.uniform(0.1, 0.5)),
+        spot_mtbf=_loguniform(rng, 5e3, 5e4),
+        spot_outage=rng.uniform(600.0, 3600.0),
+        spot_warning=(0.0 if rng.random() < 0.4
+                      else rng.uniform(30.0, 900.0)),
+        domain_mtbf=(math.inf if rng.random() < 0.4
+                     else _loguniform(rng, 2e4, 3e5)),
+        domain_repair=(math.inf if rng.random() < 0.05
+                       else rng.uniform(600.0, 7200.0)),
+        straggler_mtbf=(math.inf if rng.random() < 0.4
+                        else _loguniform(rng, 1e4, 2e5)),
+        straggler_repair=rng.uniform(600.0, 7200.0),
+        straggler_degrade=rng.uniform(0.0, 1.0),
+    )
+    recovery = RecoveryModel(
+        ckpt_interval=rng.uniform(300.0, 3600.0),
+        restore=rng.choice(["auto", rng.uniform(10.0, 120.0)]),
+        ckpt_write=rng.choice([0.0, "auto", rng.uniform(5.0, 120.0)]),
+    )
+    return config, recovery
+
+
+def run_cell(policy_key: str, config, recovery, *, num_jobs: int,
+             seed: int, max_time: float, events_path: Path) -> dict:
+    """One chaos cell: replay, capture, analyze, assert both closures."""
+    name, kwargs = POLICY_CONFIGS[policy_key]
+    cluster = TpuCluster("v5e", dims=(8, 8), num_pods=2)
+    jobs = generate_philly_like_trace(num_jobs, seed=seed)
+    horizon = min(max_time, fault_horizon(jobs))
+    plan = FaultPlan(
+        records=generate_fault_schedule(
+            cluster, config, horizon=horizon, seed=seed,
+        ),
+        recovery=recovery,
+    )
+    metrics = MetricsLog(
+        events_sink=events_path, attribution=True,
+        run_meta={"run_id": f"chaos-{policy_key}", "seed": seed,
+                  "policy": policy_key, "config_hash": "chaos"},
+    )
+    with metrics:
+        res = Simulator(
+            cluster, make_policy(name, **kwargs), jobs,
+            metrics=metrics, faults=plan, max_time=max_time,
+        ).run()
+    analysis = analyze_file(events_path)
+    failures = []
+    if analysis.goodput() != res.goodput:
+        failures.append(
+            f"goodput closure broke: {analysis.goodput()} != {res.goodput}"
+        )
+    if analysis.delay_by_cause() != res.delay_by_cause:
+        failures.append(
+            f"delay_by_cause closure broke: "
+            f"{analysis.delay_by_cause()} != {res.delay_by_cause}"
+        )
+    return {
+        "policy": policy_key,
+        "faults": int(res.counters.get("faults", 0)),
+        "revocations": int(res.counters.get("fault_revocations", 0)),
+        "straggler_reprices": int(
+            res.counters.get("straggler_reprices", 0)
+        ),
+        "spot_warnings": int(res.counters.get("spot_warnings", 0)),
+        "goodput": dict(res.goodput),
+        "failures": failures,
+    }
+
+
+def run_chaos(*, configs: int, num_jobs: int, seed: int,
+              policies, max_time: float = 400_000.0) -> dict:
+    """The full grid; raises nothing — failures are collected so one
+    broken cell doesn't hide the rest."""
+    keys = list(policies) if policies else list(POLICY_CONFIGS)
+    unknown = [k for k in keys if k not in POLICY_CONFIGS]
+    if unknown:
+        raise ValueError(
+            f"unknown policy configs {unknown}; known: {sorted(POLICY_CONFIGS)}"
+        )
+    out = {"seed": seed, "num_jobs": num_jobs, "configs": [], "cells": 0,
+           "failed_cells": 0}
+    with tempfile.TemporaryDirectory(prefix="fault_chaos_") as tmp:
+        for i in range(configs):
+            rng = random.Random(f"{seed}:chaos:{i}")
+            config, recovery = draw_config(rng)
+            entry = {
+                "index": i,
+                "config": dict(config.__dict__),
+                "recovery": {
+                    "ckpt_interval": recovery.ckpt_interval,
+                    "restore": recovery.restore,
+                    "ckpt_write": recovery.ckpt_write,
+                },
+                "cells": [],
+            }
+            for key in keys:
+                cell = run_cell(
+                    key, config, recovery, num_jobs=num_jobs, seed=seed,
+                    max_time=max_time,
+                    events_path=Path(tmp) / f"c{i}-{key}.events.jsonl",
+                )
+                out["cells"] += 1
+                if cell["failures"]:
+                    out["failed_cells"] += 1
+                entry["cells"].append(cell)
+            out["configs"].append(entry)
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--configs", type=int, default=5,
+                   help="random fault configs to draw")
+    p.add_argument("--num-jobs", type=int, default=60,
+                   help="Philly-like trace length per cell")
+    p.add_argument("--seed", type=int, default=0,
+                   help="governs trace, fault streams AND the config draw")
+    p.add_argument("--policies",
+                   help=f"comma list from {sorted(POLICY_CONFIGS)} "
+                        "(default: all eight)")
+    p.add_argument("--max-time", type=float, default=400_000.0,
+                   help="horizon cutoff per cell (bounds both the replay "
+                        "and the schedule size under low-MTBF draws)")
+    p.add_argument("--out", help="also write the JSON document here")
+    args = p.parse_args(argv)
+
+    doc = jsonable(run_chaos(
+        configs=args.configs,
+        num_jobs=args.num_jobs,
+        seed=args.seed,
+        policies=args.policies.split(",") if args.policies else None,
+        max_time=args.max_time,
+    ))
+    summary = {
+        "cells": doc["cells"],
+        "failed_cells": doc["failed_cells"],
+        "configs": args.configs,
+    }
+    print(json.dumps(jsonable(summary), sort_keys=True))
+    if args.out:
+        out = Path(args.out)
+        if out.parent and not out.parent.exists():
+            out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(doc, indent=2, sort_keys=True))
+    if doc["failed_cells"]:
+        for entry in doc["configs"]:
+            for cell in entry["cells"]:
+                for f in cell["failures"]:
+                    print(
+                        f"config {entry['index']} x {cell['policy']}: {f}",
+                        file=sys.stderr,
+                    )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -61,6 +61,26 @@ def main(argv=None) -> int:
     p.add_argument("--ckpt", type=float, default=1800.0)
     p.add_argument("--restore", default="auto",
                    help="seconds per revocation, or 'auto'")
+    p.add_argument("--ckpt-write", default="0",
+                   help="seconds per periodic checkpoint write, or 'auto' "
+                        "to size from model state (0 = free, the "
+                        "historical model)")
+    p.add_argument("--domain-mtbf", type=float, default=float("inf"),
+                   help="per-domain MTBF for correlated host/rack/pod "
+                        "outages (inf = off)")
+    p.add_argument("--domain-repair", type=float, default=2 * 3600.0)
+    p.add_argument("--straggler-mtbf", type=float, default=float("inf"),
+                   help="per-chip straggler-onset MTBF (inf = off)")
+    p.add_argument("--straggler-repair", type=float, default=3600.0)
+    p.add_argument("--straggler-degrade", type=float, default=0.5,
+                   help="residual chip-rate fraction while degraded")
+    p.add_argument("--spot", type=float, default=0.0,
+                   help="trailing fraction of capacity that is spot")
+    p.add_argument("--spot-mtbf", type=float, default=4 * 3600.0)
+    p.add_argument("--spot-outage", type=float, default=1800.0)
+    p.add_argument("--spot-warning", type=float, default=0.0,
+                   help="pre-revoke notice lead time (emergency "
+                        "checkpoints when it covers the write cost)")
     p.add_argument("--dims", default="8x8", help="TPU pod dims per cell")
     p.add_argument("--pods", type=int, default=1)
     p.add_argument("--max-time", type=float,
@@ -80,20 +100,40 @@ def main(argv=None) -> int:
             restore = float(args.restore)
         except ValueError:
             p.error(f"--restore wants seconds or 'auto', got {args.restore!r}")
+    if args.ckpt_write == "auto":
+        ckpt_write = "auto"
+    else:
+        try:
+            ckpt_write = float(args.ckpt_write)
+        except ValueError:
+            p.error(
+                f"--ckpt-write wants seconds or 'auto', got {args.ckpt_write!r}"
+            )
     grid = sweep(
         mtbfs,
         policies,
         repair=args.repair,
         ckpt=args.ckpt,
         restore=restore,
+        ckpt_write=ckpt_write,
         num_jobs=args.num_jobs,
         seed=args.seed,
         dims=_parse_dims(args.dims),
         num_pods=args.pods,
         max_time=args.max_time,
+        domain_mtbf=args.domain_mtbf,
+        domain_repair=args.domain_repair,
+        straggler_mtbf=args.straggler_mtbf,
+        straggler_repair=args.straggler_repair,
+        straggler_degrade=args.straggler_degrade,
+        spot_fraction=args.spot,
+        spot_mtbf=args.spot_mtbf,
+        spot_outage=args.spot_outage,
+        spot_warning=args.spot_warning,
     )
     # jsonable over the WHOLE document: inf can appear in the grid (control
-    # arm) and in params (--repair inf etc.); strict JSON throughout
+    # arm, domain/straggler off values, MTTR of faultless cells) and in
+    # params (--repair inf etc.); strict JSON throughout
     doc = jsonable({
         "grid": grid,
         "params": {
@@ -102,9 +142,19 @@ def main(argv=None) -> int:
             "repair_s": args.repair,
             "ckpt_s": args.ckpt,
             "restore": restore,
+            "ckpt_write": ckpt_write,
             "dims": list(_parse_dims(args.dims)),
             "pods": args.pods,
             "max_time": args.max_time,
+            "domain_mtbf_s": args.domain_mtbf,
+            "domain_repair_s": args.domain_repair,
+            "straggler_mtbf_s": args.straggler_mtbf,
+            "straggler_repair_s": args.straggler_repair,
+            "straggler_degrade": args.straggler_degrade,
+            "spot_fraction": args.spot,
+            "spot_mtbf_s": args.spot_mtbf,
+            "spot_outage_s": args.spot_outage,
+            "spot_warning_s": args.spot_warning,
         },
     })
     out = Path(args.out)
