@@ -233,6 +233,11 @@ class TpuCluster(OverlayMixin, ClusterBase):
         # alloc_slow_factor at a single truthiness check on the hot path.
         self._chip_degrade: Dict[Tuple[int, Tuple[int, ...]], List[float]] = {}
         self._used = 0
+        # per-pod occupied-chip counts, maintained at the four occupancy
+        # writes (grant/free, single + multislice) so pod_used_chips is an
+        # O(1) read instead of a grid sum — the net/ ingest term reads it
+        # once per pod per re-price (ISSUE 7 hot path)
+        self._pod_used: List[int] = [0] * self.num_pods
         self._ids = itertools.count()
         self._live: Dict[int, SliceGeometry] = {}
         self._init_overlays()
@@ -441,8 +446,9 @@ class TpuCluster(OverlayMixin, ClusterBase):
 
     def pod_used_chips(self, pod: int) -> int:
         """Occupied chips in one pod (the net/ ingest-demand input: each
-        running chip pulls training data over its pod's DCN uplink)."""
-        return int(self._occ[pod].sum())
+        running chip pulls training data over its pod's DCN uplink).
+        O(1): the count is maintained at every occupancy write."""
+        return self._pod_used[pod]
 
     def round_up(self, num_chips: int) -> int:
         """Smallest valid allocation size >= num_chips: a power-of-two
@@ -511,7 +517,15 @@ class TpuCluster(OverlayMixin, ClusterBase):
 
         if num_chips > self.free_chips:
             return None
+        # fault-free fast path (ISSUE 7): a pod with fewer free chips than
+        # the request can never fit the box — skip its numpy window scan
+        # outright.  With any chip health-masked the blocked grid differs
+        # from occupancy, so the full search runs (cold path).
+        pod_used = self._pod_used if self._unhealthy_cells == 0 else None
+        pod_cap = self.pod_chips
         for pod in pods:
+            if pod_used is not None and pod_cap - pod_used[pod] < num_chips:
+                continue
             for shape in shapes:
                 origin = self._find_free_box(self._blocked(pod), shape, origin_order)
                 if origin is not None:
@@ -564,6 +578,7 @@ class TpuCluster(OverlayMixin, ClusterBase):
         )
         for s in slices:
             self._occ[s.pod][...] = 1
+            self._pod_used[s.pod] = self.pod_chips
         geom = MultiSliceGeometry(
             slices=slices, speed_factor=self._multislice_speed_factor(m, job)
         )
@@ -612,8 +627,10 @@ class TpuCluster(OverlayMixin, ClusterBase):
         if isinstance(geom, MultiSliceGeometry):
             for s in geom.slices:
                 self._occ[s.pod][...] = 0
+                self._pod_used[s.pod] = 0
         else:
             self._box(self._occ[geom.pod], geom.origin, geom.shape)[...] = 0
+            self._pod_used[geom.pod] -= geom.num_chips
         self._used -= geom.num_chips
 
     def _live_size(self, alloc_id: int) -> Optional[int]:
@@ -701,6 +718,7 @@ class TpuCluster(OverlayMixin, ClusterBase):
 
     def _grant(self, pod: int, origin: Tuple[int, ...], shape: Tuple[int, ...]) -> Allocation:
         self._box(self._occ[pod], origin, shape)[...] = 1
+        self._pod_used[pod] += math.prod(shape)
         wrap = tuple(s == d for s, d in zip(shape, self.dims))
         geom = SliceGeometry(pod=pod, origin=origin, shape=shape, wrap_axes=wrap)
         alloc = Allocation(next(self._ids), geom.num_chips, detail=geom)

@@ -242,14 +242,55 @@ def run_cell(
     return cell
 
 
+def grid_cells(
+    keys: Sequence[str],
+    points: Sequence,
+    run_one,
+    *,
+    workers: int = 1,
+) -> Dict[str, List[dict]]:
+    """Run a (policy x grid-point) matrix of independent seeded cells,
+    serially or process-parallel, reassembling results in deterministic
+    grid order either way (ISSUE 7: each cell regenerates its own trace /
+    cluster / schedule from the seed, so cells are embarrassingly
+    parallel and the parallel artifact is byte-identical to the serial
+    one).  ``run_one(key, point)`` must be picklable (module-level) for
+    ``workers > 1``."""
+    if workers <= 1:
+        return {key: [run_one(key, pt) for pt in points] for key in keys}
+    from concurrent.futures import ProcessPoolExecutor
+
+    with ProcessPoolExecutor(max_workers=workers) as pool:
+        futures = {
+            (key, i): pool.submit(run_one, key, pt)
+            for key in keys
+            for i, pt in enumerate(points)
+        }
+        return {
+            key: [futures[(key, i)].result() for i in range(len(points))]
+            for key in keys
+        }
+
+
+def _mtbf_cell(key: str, mtbf: float, cell_kwargs: dict) -> dict:
+    """Module-level cell thunk (picklable for the process pool)."""
+    return run_cell(key, mtbf=mtbf, **cell_kwargs)
+
+
 def sweep(
     mtbfs: Iterable[float] = DEFAULT_MTBFS,
     policies: Optional[Iterable[str]] = None,
+    *,
+    workers: int = 1,
     **cell_kwargs,
 ) -> dict:
     """The full grid as one JSON-ready artifact:
     ``{"mtbf_s": [...], "policies": {name: [cell, ...]}}`` with each
-    policy's cells ordered like ``mtbf_s``."""
+    policy's cells ordered like ``mtbf_s``.
+
+    ``workers`` > 1 runs the cells across a process pool (each cell is an
+    isolated seeded replay); results come back in grid order, so the
+    artifact is byte-identical to the serial one."""
     mtbfs = list(mtbfs)
     keys = list(policies) if policies is not None else list(POLICY_CONFIGS)
     unknown = [k for k in keys if k not in POLICY_CONFIGS]
@@ -257,7 +298,15 @@ def sweep(
         raise ValueError(
             f"unknown policy configs {unknown}; known: {sorted(POLICY_CONFIGS)}"
         )
-    out: Dict[str, List[dict]] = {}
-    for key in keys:
-        out[key] = [run_cell(key, mtbf=m, **cell_kwargs) for m in mtbfs]
+    if workers > 1 and cell_kwargs.get("events_path") is not None:
+        raise ValueError(
+            "workers > 1 cannot share one events_path; capture streams "
+            "per-cell (cli `faults --events DIR`) or run serially"
+        )
+    from functools import partial
+
+    out = grid_cells(
+        keys, mtbfs, partial(_mtbf_cell, cell_kwargs=cell_kwargs),
+        workers=workers,
+    )
     return {"mtbf_s": mtbfs, "policies": out}

@@ -41,23 +41,34 @@ class Flow:
 
 
 def maxmin_allocate(
-    flows: Iterable[Flow], capacity_gbps: Dict[str, float]
+    flows: Iterable[Flow],
+    capacity_gbps: Dict[str, float],
+    *,
+    validate: bool = True,
 ) -> Dict[str, float]:
     """Max-min fair rates for ``flows`` under ``capacity_gbps``.
 
     Every flow's links must exist in ``capacity_gbps``; capacities may be
     zero (flows crossing a dead link get rate 0).  Returns ``{flow.key:
     rate}`` for every input flow.
+
+    ``validate=False`` skips the well-formedness sweep (duplicate keys,
+    unknown links, non-positive weights) for callers that construct the
+    flow set themselves and re-solve it repeatedly (the contention
+    model's hot path, ISSUE 7); the arithmetic is identical either way.
     """
     flows = sorted(flows, key=lambda f: f.key)
-    if len({f.key for f in flows}) != len(flows):
-        raise ValueError("duplicate flow keys")
-    for f in flows:
-        for link, w in f.links:
-            if link not in capacity_gbps:
-                raise ValueError(f"flow {f.key!r} crosses unknown link {link!r}")
-            if w <= 0:
-                raise ValueError(f"flow {f.key!r} has non-positive weight on {link!r}")
+    if validate:
+        if len({f.key for f in flows}) != len(flows):
+            raise ValueError("duplicate flow keys")
+        for f in flows:
+            for link, w in f.links:
+                if link not in capacity_gbps:
+                    raise ValueError(
+                        f"flow {f.key!r} crosses unknown link {link!r}")
+                if w <= 0:
+                    raise ValueError(
+                        f"flow {f.key!r} has non-positive weight on {link!r}")
     rate: Dict[str, float] = {f.key: 0.0 for f in flows}
     headroom = {k: max(0.0, float(v)) for k, v in capacity_gbps.items()}
     sat_floor = {k: _EPS * (1.0 + headroom[k]) for k in headroom}

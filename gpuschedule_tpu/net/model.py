@@ -42,8 +42,13 @@ import math
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from gpuschedule_tpu.models.config import resolve_model_config
 from gpuschedule_tpu.net.fabric import CORE, FabricTopology, uplink
 from gpuschedule_tpu.net.maxmin import Flow, maxmin_allocate
+from gpuschedule_tpu.profiler.ici import (
+    cross_pod_allreduce_seconds,
+    dp_gradient_bytes,
+)
 
 
 @dataclass
@@ -131,11 +136,15 @@ class NetState:
 class NetModel:
     """Engine-facing contention model over one fleet's shared fabric.
 
-    The engine calls :meth:`attach` once, :meth:`recompute` after every
-    event batch that may have changed the running set, and
-    :meth:`degrade_link` / :meth:`repair_link` from ``("link", pod)``
-    fault records.  Placement (the ``contention`` scheme) reads
-    :meth:`residual_gbps` between recomputes.
+    The engine calls :meth:`attach` once, :meth:`mark_dirty` on every
+    allocation mutation, :meth:`poll` / :meth:`recompute` after every
+    event batch that may have changed the running set (poll returns the
+    cached state when the dirty set is empty — the ISSUE 7 incremental
+    fast path), and :meth:`degrade_link` / :meth:`repair_link` from
+    ``("link", pod)`` fault records.  Placement (the ``contention``
+    scheme) reads :meth:`residual_gbps` between recomputes.
+    :meth:`recompute` alone is always a correct full pass — direct
+    callers need no marking discipline.
     """
 
     def __init__(self, config: Optional[NetConfig] = None):
@@ -148,6 +157,42 @@ class NetModel:
         # last recompute's elastic usage per link (residual_gbps reads it)
         self._elastic_used: Dict[str, float] = {}
         self.recomputes = 0
+        # Incremental re-pricing (ISSUE 7 tentpole): the progressive-
+        # filling pass is a pure function of (flow set, pod occupancy,
+        # link health), so the engine marks this model dirty on every
+        # mutation of those inputs (mark_dirty / degrade_link /
+        # repair_link) and skips the whole pass via poll() when nothing
+        # changed since the cached state was derived.  recompute() itself
+        # is always a full pass — direct callers (tests, tools) need no
+        # marking discipline to stay correct.
+        self._dirty = True
+        self._state = NetState()
+        self.cache_hits = 0
+        # flow-set cache (second dirty tier): the flow list only changes
+        # when a *multislice* allocation is bound or released, which is
+        # rare next to single-pod churn — occupancy-only invalidations
+        # (the ingest term) reuse the cached flows and skip the whole
+        # running-set scan.  Only the engine's reuse_flows=True path
+        # consults it; direct recompute() callers always rebuild.
+        self._flows_dirty = True
+        self._flows: List[Flow] = []
+        self._flow_meta: Dict[str, Tuple[int, ...]] = {}
+        self._flow_jobs: Dict[str, object] = {}
+        # per-(model, tp) gradient payload cache: the resolved config and
+        # payload never change for a given job, so the per-flow model
+        # lookup happens once per distinct model instead of per recompute
+        self._grad_bytes: Dict[Tuple[Optional[str], int], float] = {}
+        # per-pods-tuple weighted link path (topo.path validates and
+        # rebuilds the tuple on every call; flows reuse a handful of
+        # distinct pod sets for the whole replay)
+        self._paths: Dict[Tuple[int, ...], Tuple] = {}
+        # attach()-time link metadata: sorted names and the name -> pod
+        # parse, so recompute stops re-sorting and re-splitting per pass
+        self._sorted_links: Tuple[str, ...] = ()
+        self._uplinks: Tuple[str, ...] = ()
+        self._link_pod: Dict[str, Optional[int]] = {}
+        self._base_caps: Dict[str, float] = {}
+        self._t_step = 1.0
         # time-weighted utilization integrals (tools/net_sweep.py and the
         # compare-topology contention column read the means)
         self._last_t: Optional[float] = None
@@ -162,6 +207,13 @@ class NetModel:
         the engine and the CLI may both attach the same cluster."""
         inner = getattr(cluster, "inner", cluster)
         if self._cluster is inner:
+            # same fleet, but drop the pricing cache: a NetModel reused
+            # for a second Simulator over the same cluster must start
+            # from a full recompute (pre-incremental semantics), not
+            # serve the previous run's final state from poll()
+            self._dirty = True
+            self._flows_dirty = True
+            self._state = NetState()
             return
         self.topology = FabricTopology.from_cluster(
             inner, oversubscription=self.config.oversubscription
@@ -169,6 +221,21 @@ class NetModel:
         self._cluster = inner
         self._elastic_used = {}
         self._degraded = {}
+        self._dirty = True
+        self._flows_dirty = True
+        self._state = NetState()
+        self._paths = {}
+        topo = self.topology
+        self._base_caps = {
+            name: link.capacity_gbps for name, link in topo.links.items()
+        }
+        self._sorted_links = tuple(sorted(topo.links))
+        self._uplinks = tuple(uplink(p) for p in range(topo.num_pods))
+        self._link_pod = {
+            name: (None if name == CORE else int(name.rsplit("pod", 1)[1]))
+            for name in topo.links
+        }
+        self._t_step = float(getattr(inner, "dcn_step_seconds", 1.0))
 
     def _require_attached(self) -> FabricTopology:
         if self.topology is None:
@@ -188,6 +255,7 @@ class NetModel:
         self._degraded.setdefault(pod, []).append(
             min(1.0, max(0.0, float(residual_frac)))
         )
+        self._dirty = True
 
     def repair_link(self, pod: int, residual_frac: float) -> None:
         """Undo one :meth:`degrade_link` of the same severity."""
@@ -198,13 +266,14 @@ class NetModel:
         stack.remove(frac)
         if not stack:
             del self._degraded[pod]
+        self._dirty = True
 
     def _capacity(self, link: str) -> float:
         """Current (post-degrade) capacity of one link."""
         topo = self._require_attached()
         cap = topo.links[link].capacity_gbps
         if link != CORE:
-            pod = int(link.rsplit("pod", 1)[1])
+            pod = self._link_pod[link]
             for frac in self._degraded.get(pod, ()):
                 cap *= frac
         return cap
@@ -231,26 +300,38 @@ class NetModel:
         topo = self._require_attached()
         return topo.uplink_gbps
 
-    def _grad_bytes(self, job) -> float:
-        from gpuschedule_tpu.models.config import resolve_model_config
-        from gpuschedule_tpu.profiler.ici import dp_gradient_bytes
-
-        cfg = resolve_model_config(getattr(job, "model_name", None))
+    def _job_grad_bytes(self, job) -> float:
+        """Gradient payload for one job's allreduce flow, cached per
+        (model, tp): the resolved config never changes for a job, so the
+        zoo lookup runs once per distinct model instead of per recompute
+        (ISSUE 7 hot-path satellite)."""
+        model = getattr(job, "model_name", None)
         tp = max(1, int(getattr(job, "tp", 1) or 1))
-        return dp_gradient_bytes(cfg.param_count // tp)
+        key = (model, tp)
+        cached = self._grad_bytes.get(key)
+        if cached is None:
+            cfg = resolve_model_config(model)
+            cached = dp_gradient_bytes(cfg.param_count // tp)
+            self._grad_bytes[key] = cached
+        return cached
 
     def _factor(self, job, m: int, per_host_gbps: float) -> float:
         """The dynamic locality factor: the static formula with the job's
         actual per-host share in place of the nominal DCN_GBPS."""
-        from gpuschedule_tpu.profiler.ici import cross_pod_allreduce_seconds
-
-        t_step = float(getattr(self._cluster, "dcn_step_seconds", 1.0))
         t_dcn = cross_pod_allreduce_seconds(
-            self._grad_bytes(job), m, dcn_gbps=per_host_gbps
+            self._job_grad_bytes(job), m, dcn_gbps=per_host_gbps
         )
         if math.isinf(t_dcn):
             return 0.0
-        return t_step / (t_step + t_dcn)
+        return self._t_step / (self._t_step + t_dcn)
+
+    def _path(self, pods: Tuple[int, ...]):
+        """Weighted link set for one (already sorted, de-duplicated) pods
+        tuple, cached — topo.path re-validates and rebuilds per call."""
+        path = self._paths.get(pods)
+        if path is None:
+            path = self._paths[pods] = self.topology.path(pods)
+        return path
 
     def _ingest_gbps(self, pod: int) -> float:
         """Inelastic input-pipeline draw on one pod's uplink, clamped to
@@ -259,67 +340,175 @@ class NetModel:
         if rate <= 0.0 or self._cluster is None:
             return 0.0
         used = self._cluster.pod_used_chips(pod)
-        return min(used * rate, self._capacity(uplink(pod)))
+        return min(used * rate, self._capacity(self._uplinks[pod]))
 
     # ------------------------------------------------------------------ #
+    # the dirty set (ISSUE 7 tentpole): what invalidates the cached state
 
-    def recompute(self, now: float, running_jobs: Iterable) -> NetState:
+    def mark_dirty(self, job=None) -> None:
+        """Engine-facing: a scheduler-visible mutation touched this job's
+        allocation (bind or imminent free).  Two invalidation tiers:
+
+        - a **multislice** bind/release (the job is in the current flow
+          set, or its attached allocation spans pods) invalidates the
+          flow cache too — the next recompute rebuilds flows from the
+          running set;
+        - any other allocation change matters only through the ingest
+          term: with ingest armed it invalidates the cached *state*
+          (capacities moved) but the flow set is reused; with ingest off
+          it provably cannot perturb the fabric and the cache survives.
+
+        Call with the allocation still attached; ``job=None`` marks
+        everything unconditionally."""
+        if self._dirty and self._flows_dirty:
+            return
+        if (
+            job is not None
+            and job.job_id not in self._state.shares
+            and self._multislice_pods(job) is None
+        ):
+            if self.config.ingest_gbps_per_chip > 0.0:
+                self._dirty = True
+            return
+        self._dirty = True
+        self._flows_dirty = True
+
+    def poll(self, now: float) -> Optional[NetState]:
+        """Engine fast path: the cached state when nothing marked the
+        model dirty since it was derived, else None (run
+        :meth:`recompute`).  Integrates the utilization means either way,
+        at the same instants a full pass would — the integral's float
+        chunking is part of the byte-identity contract."""
+        if self._dirty:
+            return None
+        self._integrate(now)
+        self.cache_hits += 1
+        return self._state
+
+    def recompute(
+        self, now: float, running_jobs: Iterable, *, reuse_flows: bool = False
+    ) -> NetState:
         """Progressive-filling pass over the active flow set: derive every
         running multislice job's max-min fair share, its dynamic locality
         factor, and each link's load.  Deterministic — same running set,
-        occupancy, and link health give identical floats."""
+        occupancy, and link health give identical floats.
+
+        ``reuse_flows`` is the engine's second-tier fast path: when the
+        flow cache is clean (no multislice bind/release since the last
+        rebuild — see :meth:`mark_dirty`), the flow list a running-set
+        scan would produce is the cached one verbatim, so the scan is
+        skipped and only capacities/rates/factors re-derive.  Direct
+        callers keep the default (False): a full rebuild every time, no
+        marking discipline required."""
         topo = self._require_attached()
         self._integrate(now)
         self.recomputes += 1
 
         demand = self._demand_gbps()
-        flows: List[Flow] = []
-        meta: Dict[str, Tuple[int, ...]] = {}
-        job_by_id: Dict[str, object] = {}
-        for job in running_jobs:
-            pods = self._multislice_pods(job)
-            if pods is None:
-                continue
-            flows.append(Flow(job.job_id, topo.path(pods), demand))
-            meta[job.job_id] = pods
-            job_by_id[job.job_id] = job
+        reused = reuse_flows and not self._flows_dirty
+        if reused:
+            flows = self._flows
+            meta = self._flow_meta
+            job_by_id = self._flow_jobs
+        else:
+            flows = []
+            meta = {}
+            job_by_id = {}
+            for job in running_jobs:
+                pods = self._multislice_pods(job)
+                if pods is None:
+                    continue
+                flows.append(Flow(job.job_id, self._path(pods), demand))
+                meta[job.job_id] = pods
+                job_by_id[job.job_id] = job
+            if reuse_flows:
+                # only the engine's marked path caches the rebuild — a
+                # direct caller's ad-hoc running list must never leak
+                # into a later engine reuse
+                self._flows, self._flow_meta, self._flow_jobs = (
+                    flows, meta, job_by_id
+                )
+                self._flows_dirty = False
 
-        ingest = {p: self._ingest_gbps(p) for p in range(topo.num_pods)}
-        capacity: Dict[str, float] = {}
-        for name in topo.links:
-            cap = self._capacity(name)
-            if name == CORE:
-                capacity[name] = max(0.0, cap - sum(ingest.values()))
-            else:
-                pod = int(name.rsplit("pod", 1)[1])
-                capacity[name] = max(0.0, cap - ingest[pod])
-        rates = maxmin_allocate(flows, capacity)
+        # effective (post-degrade) capacities, one map per pass: the
+        # degradation stack is almost always empty, so start from the
+        # attach-time base capacities and only touch degraded uplinks
+        # (same multiplication order as _capacity — identical floats)
+        link_pod = self._link_pod
+        caps = dict(self._base_caps)
+        for pod, stack in self._degraded.items():
+            cap = caps[self._uplinks[pod]]
+            for frac in stack:
+                cap *= frac
+            caps[self._uplinks[pod]] = cap
 
+        rate = self.config.ingest_gbps_per_chip
+        if rate > 0.0:
+            cluster = self._cluster
+            ingest = {
+                p: min(cluster.pod_used_chips(p) * rate, caps[up])
+                for p, up in enumerate(self._uplinks)
+            }
+            ingest_total = sum(ingest.values())
+            capacity: Dict[str, float] = {}
+            for name in topo.links:
+                cap = caps[name]
+                if name == CORE:
+                    capacity[name] = max(0.0, cap - ingest_total)
+                else:
+                    capacity[name] = max(0.0, cap - ingest[link_pod[name]])
+        else:
+            ingest = dict.fromkeys(range(topo.num_pods), 0.0)
+            ingest_total = 0.0
+            capacity = {name: max(0.0, cap) for name, cap in caps.items()}
+        # a reused flow list was validated when it was built; skip the
+        # well-formedness sweep (keys/links/weights), not any arithmetic
+        rates = maxmin_allocate(flows, capacity, validate=not reused)
+
+        prev = self._state
         state = NetState()
-        elastic: Dict[str, float] = {name: 0.0 for name in topo.links}
+        elastic: Dict[str, float] = dict.fromkeys(topo.links, 0.0)
+        hosts_per_pod = topo.hosts_per_pod
+        prev_shares = prev.shares
         for flow in flows:
-            r = rates[flow.key]
-            pods = meta[flow.key]
+            key = flow.key
+            r = rates[key]
             for link, w in flow.links:
                 elastic[link] += w * r
-            per_host = r / topo.hosts_per_pod
-            job = job_by_id[flow.key]
-            state.shares[flow.key] = JobShare(
-                gbps=r,
-                demand_gbps=demand,
-                factor=self._factor(job, len(pods), per_host),
-                pods=pods,
-            )
-        for name in sorted(topo.links):
-            cap = self._capacity(name)
+            share = prev_shares.get(key)
+            if share is None or share.gbps != r or share.pods != meta[key]:
+                # the factor is a pure function of (job model/tp, pod
+                # set, share): an unchanged (rate, pods) pair reuses the
+                # previous JobShare outright and skips the allreduce-term
+                # call — same key with different pods (a rebind between
+                # passes) re-derives
+                pods = meta[key]
+                share = JobShare(
+                    gbps=r,
+                    demand_gbps=demand,
+                    factor=self._factor(
+                        job_by_id[key], len(pods), r / hosts_per_pod
+                    ),
+                    pods=pods,
+                )
+            state.shares[key] = share
+        prev_links = prev.links
+        for name in self._sorted_links:
+            cap = caps[name]
             if name == CORE:
-                used = sum(ingest.values()) + elastic[name]
+                used = ingest_total + elastic[name]
             else:
-                pod = int(name.rsplit("pod", 1)[1])
-                used = ingest[pod] + elastic[name]
-            state.links[name] = LinkSample(used_gbps=used, capacity_gbps=cap)
+                used = ingest[link_pod[name]] + elastic[name]
+            sample = prev_links.get(name)
+            if sample is None or (
+                sample.used_gbps != used or sample.capacity_gbps != cap
+            ):
+                sample = LinkSample(used_gbps=used, capacity_gbps=cap)
+            state.links[name] = sample
         self._elastic_used = elastic
         self._last_util = {n: s.util for n, s in state.links.items()}
+        self._state = state
+        self._dirty = False
         return state
 
     def residual_gbps(self, pod: int) -> float:

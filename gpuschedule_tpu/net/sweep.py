@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import math
 import random
-from typing import Dict, Iterable, List, Optional, Sequence
+from typing import Iterable, Optional, Sequence
 
 from gpuschedule_tpu.cluster.tpu import TpuCluster
 from gpuschedule_tpu.faults.sweep import POLICY_CONFIGS, jsonable  # noqa: F401
@@ -101,13 +101,24 @@ def run_cell(
     }
 
 
+def _share_cell(key: str, share: float, cell_kwargs: dict) -> dict:
+    """Module-level cell thunk (picklable for the process pool)."""
+    return run_cell(key, multislice_share=share, **cell_kwargs)
+
+
 def sweep(
     shares: Iterable[float] = DEFAULT_SHARES,
     policies: Optional[Iterable[str]] = None,
+    *,
+    workers: int = 1,
     **cell_kwargs,
 ) -> dict:
     """The full grid: ``{"multislice_share": [...], "policies": {name:
-    [cell, ...]}}`` with each policy's cells ordered like the shares."""
+    [cell, ...]}}`` with each policy's cells ordered like the shares.
+
+    ``workers`` > 1 fans the cells across a process pool (each cell is an
+    isolated seeded replay — the faults/sweep.py grid_cells machinery);
+    the reassembled artifact is byte-identical to the serial one."""
     shares = list(shares)
     keys = list(policies) if policies is not None else list(POLICY_CONFIGS)
     unknown = [k for k in keys if k not in POLICY_CONFIGS]
@@ -115,9 +126,12 @@ def sweep(
         raise ValueError(
             f"unknown policy configs {unknown}; known: {sorted(POLICY_CONFIGS)}"
         )
-    out: Dict[str, List[dict]] = {}
-    for key in keys:
-        out[key] = [
-            run_cell(key, multislice_share=s, **cell_kwargs) for s in shares
-        ]
+    from functools import partial
+
+    from gpuschedule_tpu.faults.sweep import grid_cells
+
+    out = grid_cells(
+        keys, shares, partial(_share_cell, cell_kwargs=cell_kwargs),
+        workers=workers,
+    )
     return {"multislice_share": shares, "policies": out}

@@ -245,6 +245,17 @@ class Simulator:
         job.allocation = alloc
         job.locality_factor = getattr(alloc.detail, "speed_factor", 1.0)
         job.slow_factor = self.cluster.alloc_slow_factor(alloc)
+        if self.net is not None:
+            # the flow set / pod occupancy changed: invalidate the cached
+            # fabric pricing (ISSUE 7 incremental re-pricing)
+            self.net.mark_dirty(job)
+
+    def _net_release(self, job: Job) -> None:
+        """Invalidate the cached fabric pricing for a job about to lose
+        its allocation — called while the allocation is still attached so
+        the dirty test can see which pods it loaded."""
+        if self.net is not None:
+            self.net.mark_dirty(job)
 
     # ------------------------------------------------------------------ #
     # causal attribution (ISSUE 5): blame tagging + cluster sampling
@@ -408,6 +419,7 @@ class Simulator:
         record = self.metrics.record_events
         track = track_label(job.allocation.detail) if record else None
         job.advance(self.now)
+        self._net_release(job)
         self.cluster.free(job.allocation)
         job.allocation = None
         job.allocated_chips = 0
@@ -580,6 +592,7 @@ class Simulator:
         track = track_label(job.allocation.detail) if record else None
         job.advance(self.now)
         job.executed_work = job.duration  # absorb float residue
+        self._net_release(job)
         self.cluster.free(job.allocation)
         job.allocation = None
         job.allocated_chips = 0
@@ -613,8 +626,18 @@ class Simulator:
         change is emitted as a ``net`` event (with the exact progress
         snapshot) and changed link loads as ``netlink`` events, so the
         analyzer reconstructs bandwidth shares and link utilization from
-        the stream alone."""
-        state = self.net.recompute(self.now, self.running)
+        the stream alone.
+
+        Incremental fast path (ISSUE 7): when no allocation mutation or
+        link-health change marked the model dirty since the last pass,
+        ``poll`` hands back the cached state and the whole running-set
+        scan is skipped — nothing could have changed, so no event would
+        have been emitted anyway (the pre-incremental engine would have
+        re-derived identical shares and fallen through every emit
+        branch)."""
+        if self.net.poll(self.now) is not None:
+            return
+        state = self.net.recompute(self.now, self.running, reuse_flows=True)
         record = self.metrics.record_events
         priced, self._net_priced = self._net_priced, {}
         for job in self.running:
@@ -865,6 +888,7 @@ class Simulator:
             )
             job.executed_work -= lost
             job.lost_work += lost
+        self._net_release(job)
         self.cluster.free(job.allocation)
         job.allocation = None
         job.allocated_chips = 0
@@ -905,8 +929,11 @@ class Simulator:
         """Pop and apply every event at or before ``t``; True if any event
         changed scheduler-visible state (the policy must then run)."""
         dirty = False
-        while self._heap and self._heap[0][0] <= t:
-            _, kind, _, payload, epoch = heapq.heappop(self._heap)
+        heap = self._heap
+        heappop = heapq.heappop
+        metrics = self.metrics
+        while heap and heap[0][0] <= t:
+            _, kind, _, payload, epoch = heappop(heap)
             if kind != _TICK and kind != _SAMPLE:
                 self._nonticks -= 1
             if kind == _SAMPLE:
@@ -922,7 +949,7 @@ class Simulator:
             if kind == _ARRIVAL:
                 job: Job = payload
                 job.last_update_time = t
-                self.metrics.count("arrivals")
+                metrics.count("arrivals")
                 if not self.cluster.is_satisfiable(job.num_chips):
                     # Admission control: this gang size can never be
                     # granted here (non-slice size, bigger than a pod).
@@ -934,17 +961,17 @@ class Simulator:
                     job.state = JobState.REJECTED
                     job.end_time = t
                     self.finished.append(job)
-                    self.metrics.record_job(job)
-                    self.metrics.count("rejected_unsatisfiable")
-                    if self.metrics.record_events:
-                        self.metrics.event("reject", t, job, chips=job.num_chips)
+                    metrics.record_job(job)
+                    metrics.count("rejected_unsatisfiable")
+                    if metrics.record_events:
+                        metrics.event("reject", t, job, chips=job.num_chips)
                 else:
                     self.pending.append(job)
                     cause = None
                     if self.attribution:
                         cause = self._queue_cause(job)
                         self._open_blame(job, cause)
-                    if self.metrics.record_events:
+                    if metrics.record_events:
                         # duration/status ride along so the analyzer can
                         # derive slowdown and expected end states without
                         # re-reading the trace
@@ -960,7 +987,7 @@ class Simulator:
                             extra["ckpt_every"] = job.ckpt_every
                         if cause is not None:
                             extra["cause"] = cause
-                        self.metrics.event("arrival", t, job, **extra)
+                        metrics.event("arrival", t, job, **extra)
                 dirty = True
             elif kind == _COMPLETION:
                 job = payload
@@ -1082,15 +1109,26 @@ class Simulator:
         )
 
     def _run_plain(self) -> SimResult:
-        while self._heap:
+        # Hot loop (ISSUE 7): every attribute below is fixed for the whole
+        # run, so bind once — at Philly scale this loop turns over millions
+        # of times and the repeated self.* lookups are measurable.
+        heap = self._heap
+        max_time = self.max_time
+        net = self.net
+        cluster = self.cluster
+        running, pending = self.running, self.pending
+        policy_schedule = self.policy.schedule
+        metrics_sample = self.metrics.sample
+        while heap:
             if self._quiesced():
                 break  # only fault/repair/tick residue past the last job
-            t = self._heap[0][0]
-            if t > self.max_time:
+            head = heap[0]
+            t = head[0]
+            if t > max_time:
                 self._cutoff_at_horizon()
                 break
             self.now = t
-            if self._heap[0][1] == _SAMPLE:
+            if head[1] == _SAMPLE:
                 # _SAMPLE sorts last at equal timestamps, so a sample on
                 # top means the whole batch is samples: nothing scheduler-
                 # visible changes and no progress needs integrating.
@@ -1106,12 +1144,12 @@ class Simulator:
                 continue
             self._advance_running(t)
             if self._drain_batch(t):
-                wakeup = self.policy.schedule(self)
+                wakeup = policy_schedule(self)
                 if wakeup is not None:
                     self.request_wakeup(wakeup)
-                if self.net is not None:
+                if net is not None:
                     self._net_update()
-            self.metrics.sample(self.now, self.cluster, len(self.running), len(self.pending))
+            metrics_sample(self.now, cluster, len(running), len(pending))
         if self.net is not None:
             self.net.close(self.now)
         self._close_attribution()

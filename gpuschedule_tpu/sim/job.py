@@ -75,6 +75,10 @@ class JobState(enum.Enum):
 
 END_STATES = (JobState.DONE, JobState.FAILED, JobState.KILLED, JobState.REJECTED)
 
+# Hoisted for Job.advance's hot path: the enum attribute lookup is not free
+# at millions of calls per replay.
+_RUNNING = JobState.RUNNING
+
 # Map of trace-declared completion statuses (Philly schema, SURVEY.md §5
 # "Failure detection": a faithful replayer must handle failed/killed jobs) to
 # the terminal JobState a job enters once its trace duration has elapsed.
@@ -225,6 +229,12 @@ class Job:
         Overhead (modeled suspend/resume or migration cost) is burned first at
         wall-clock rate; only the remainder of the interval accrues work and
         attained service.
+
+        This is the engine's hottest method (every running job, every
+        event batch): the running-state constant is hoisted and the
+        effective-speed product inlined (same expression as the property,
+        so every float is bit-identical) to keep the per-call overhead
+        down at Philly scale.
         """
         dt = now - self.last_update_time
         if dt < 0:
@@ -232,7 +242,7 @@ class Job:
                 f"time went backwards for {self.job_id}: {self.last_update_time} -> {now}"
             )
         self.last_update_time = now
-        if self.state is not JobState.RUNNING or dt == 0.0:
+        if self.state is not _RUNNING or dt == 0.0:
             return
         if self.overhead_remaining > 0.0:
             burned = min(self.overhead_remaining, dt)
@@ -282,7 +292,8 @@ class Job:
                             * (1.0 - self.slow_factor) * run
                         )
                 return
-            self.executed_work += self.effective_speed * dt
+            e = self.speed * self.locality_factor * self.slow_factor
+            self.executed_work += e * dt
             self.attained_service += self.allocated_chips * dt
             if self.attrib is not None:
                 # RUN_LEGS split of this productive interval: work +
@@ -291,7 +302,7 @@ class Job:
                 # the decomposition's own ordered sum absorbs the float
                 # dust
                 a = self.attrib
-                a["work"] = a.get("work", 0.0) + self.effective_speed * dt
+                a["work"] = a.get("work", 0.0) + e * dt
                 if self.speed != 1.0:
                     a["policy-share"] = (
                         a.get("policy-share", 0.0) + (1.0 - self.speed) * dt

@@ -25,6 +25,15 @@ _DELAY_BUCKETS = (
     float("inf"),
 )
 
+# Streamed-sink write batching (ISSUE 7 satellite): events accumulate in
+# an in-process buffer and hit the sink in one write() per this many
+# records, instead of one write() per event.  The flush contract is
+# explicit: flush_events() / close_events() / write() force the buffer
+# down (the MetricsLog context manager guarantees it on engine crashes —
+# the pinned crash-flush regression); until then the tail of the stream
+# may sit in the buffer.
+_SINK_BUFFER_RECORDS = 512
+
 # Version of the JSONL event-stream schema.  The stream's first record is a
 # header ``{"schema": EVENT_SCHEMA, "run_id", "seed", "policy",
 # "config_hash", ...}`` when the run supplies ``run_meta``; readers
@@ -185,6 +194,7 @@ class MetricsLog:
         self._sink_fh: Optional[IO] = None
         self._owns_sink = False
         self._sink_opened = False
+        self._sink_buf: List[str] = []  # pending JSONL lines (flush contract)
         if events_sink is not None:
             if hasattr(events_sink, "write"):
                 self._sink_fh = events_sink
@@ -275,11 +285,29 @@ class MetricsLog:
         self.run_meta.update(fields)
 
     def _emit_record(self, rec: dict) -> None:
-        sink = self._sink()
-        if sink is not None:
-            sink.write(json.dumps(rec) + "\n")
+        if self._sink_fh is not None or self._sink_path is not None:
+            # buffered streaming (ISSUE 7 satellite): one write() per
+            # _SINK_BUFFER_RECORDS events instead of one per event; the
+            # explicit flush contract (flush_events/close_events/write)
+            # forces the tail down
+            buf = self._sink_buf
+            buf.append(json.dumps(rec) + "\n")
+            if len(buf) >= _SINK_BUFFER_RECORDS:
+                self.flush_events()
         else:
             self.events.append(rec)
+
+    def flush_events(self) -> None:
+        """Push buffered event lines to the sink in a single write().
+        Part of the explicit flush contract: callers that need the stream
+        durable mid-run (tailing a live replay, handing the file to a
+        reader) call this; :meth:`close_events` and :meth:`write` call it
+        for you."""
+        if self._sink_buf:
+            sink = self._sink()
+            if sink is not None:
+                sink.write("".join(self._sink_buf))
+            self._sink_buf.clear()
 
     def _emit_header(self) -> None:
         """Write the schema-versioned header record ahead of the first
@@ -306,8 +334,10 @@ class MetricsLog:
         self._emit_record(rec)
 
     def close_events(self) -> None:
-        """Flush and (when this log opened it) close the JSONL sink.  Safe
-        to call repeatedly; :meth:`write` calls it for you."""
+        """Flush (buffer included) and — when this log opened it — close
+        the JSONL sink.  Safe to call repeatedly; :meth:`write` calls it
+        for you."""
+        self.flush_events()
         if self._sink_fh is not None:
             self._sink_fh.flush()
             if self._owns_sink:

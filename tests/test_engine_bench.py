@@ -1,0 +1,81 @@
+"""Engine replay-speed ladder smoke (ISSUE 7 satellite): one small
+tools/engine_bench.py cell end-to-end, plus the budget-gate exit-code
+contract (0 within budget, 1 regressed) — the tools/check_overhead.py
+pattern applied to jobs/sec."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+sys.path.insert(0, os.path.join(str(REPO), "tools"))
+
+
+def test_apply_gate_floor_semantics():
+    from engine_bench import apply_gate
+
+    rungs = [
+        {"config": "plain", "num_jobs": 100, "jobs_per_s": 500.0},
+        {"config": "net", "num_jobs": 100, "jobs_per_s": 50.0},
+        {"config": "mystery", "num_jobs": 100, "jobs_per_s": 0.1},
+    ]
+    floors = {"plain": 100.0, "net": 100.0}
+    gate = apply_gate(rungs, floors=floors)
+    assert not gate["ok"]
+    by_config = {c["config"]: c for c in gate["checked"]}
+    assert by_config["plain"]["ok"] and not by_config["net"]["ok"]
+    assert "mystery" not in by_config  # unfloored configs are reported-only
+    # floor_scale rescales the budget: scaled down far enough, both pass
+    assert apply_gate(rungs, floors=floors, floor_scale=1e-3)["ok"]
+
+
+def test_build_sim_rejects_unknown_config():
+    from engine_bench import build_sim
+
+    with pytest.raises(ValueError, match="unknown config"):
+        build_sim("bogus", 10)
+
+
+@pytest.mark.slow
+def test_engine_bench_tool_gate_exit_codes(tmp_path):
+    """Drive one small ladder cell through the CLI twice: a vanishing
+    floor passes (exit 0, artifact written), an impossible floor fails
+    (exit 1) — the budget-gate contract."""
+    out = tmp_path / "bench.json"
+    base = [
+        sys.executable, str(REPO / "tools" / "engine_bench.py"),
+        "--sizes", "200", "--configs", "plain,net", "--seed", "1",
+    ]
+    ok = subprocess.run(
+        [*base, "--floor-scale", "1e-6", "--out", str(out)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert ok.returncode == 0, ok.stderr
+    doc = json.loads(out.read_text())
+    assert doc["gate"]["ok"]
+    assert {r["config"] for r in doc["ladder"]} == {"plain", "net"}
+    for rung in doc["ladder"]:
+        assert rung["num_jobs"] == 200
+        assert rung["jobs_per_s"] > 0
+        assert rung["events_per_s"] > 0
+        assert rung["finished"] + rung["unfinished"] == 200
+    net_rung = next(r for r in doc["ladder"] if r["config"] == "net")
+    # the incremental cache must be engaging on the contended rung
+    assert net_rung["cache_hits"] > 0
+    summary = json.loads(ok.stdout.strip().splitlines()[-1])
+    assert summary["ok"] is True
+
+    regressed = subprocess.run(
+        [*base, "--floor-scale", "1e9"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert regressed.returncode == 1, regressed.stderr
+    summary = json.loads(regressed.stdout.strip().splitlines()[-1])
+    assert summary["ok"] is False

@@ -1,0 +1,296 @@
+"""Incremental fabric re-pricing equivalence suite (ISSUE 7 tentpole).
+
+The regression contract: the dirty-set fast path (NetModel.poll +
+engine mark_dirty discipline) must be *observably absent* — every float,
+every emitted ``net``/``netlink`` event, every jobs.csv byte identical to
+the always-full-recompute engine.  ``_FullRecompute`` disables the cache
+(poll never hits), which reproduces the pre-incremental engine exactly;
+each scenario runs both ways and the streams are compared byte for byte.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import pytest
+
+from gpuschedule_tpu.cluster.tpu import TpuCluster
+from gpuschedule_tpu.faults import FaultPlan, FaultRecord, RecoveryModel
+from gpuschedule_tpu.faults.schedule import FaultConfig, generate_fault_schedule
+from gpuschedule_tpu.net.model import NetConfig, NetModel
+from gpuschedule_tpu.net.sweep import promote_to_multislice
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.sim import Job, Simulator
+from gpuschedule_tpu.sim.metrics import MetricsLog
+from gpuschedule_tpu.sim.philly import generate_philly_like_trace
+
+
+class _FullRecompute(NetModel):
+    """The pre-incremental model: the cache never hits and the flow set
+    is rebuilt from the running set on every pass — every dirty or clean
+    batch pays the full progressive-filling pipeline."""
+
+    def poll(self, now):
+        return None
+
+    def recompute(self, now, running_jobs, *, reuse_flows=False):
+        return super().recompute(now, running_jobs, reuse_flows=False)
+
+
+def _fleet(pods=4, dims=(4, 4)):
+    return TpuCluster("v5e", dims=dims, num_pods=pods)
+
+
+def _whale(name, submit, duration, model="transformer-base", chips=32):
+    return Job(name, submit, num_chips=chips, duration=duration,
+               model_name=model)
+
+
+def _run(scenario, incremental: bool, tmp_path, tag: str):
+    """Run one scenario with the given model class; returns (SimResult,
+    events bytes, jobs.csv bytes, NetModel)."""
+    cls = NetModel if incremental else _FullRecompute
+    sink = tmp_path / f"{tag}.jsonl"
+    out = tmp_path / tag
+    res, net = scenario(cls, sink, out)
+    return res, sink.read_bytes(), (out / "jobs.csv").read_bytes(), net
+
+
+def _pair(scenario, tmp_path):
+    """Run a scenario incremental and full; assert byte identity of the
+    event stream and jobs.csv, float identity of goodput/summary/mean
+    link utilization, and that the cache actually engaged (hits > 0 and
+    strictly fewer full passes) so the equivalence is non-vacuous."""
+    res_inc, ev_inc, csv_inc, net_inc = _run(scenario, True, tmp_path, "inc")
+    res_full, ev_full, csv_full, net_full = _run(scenario, False, tmp_path, "full")
+    assert ev_inc == ev_full
+    assert csv_inc == csv_full
+    assert res_inc.goodput == res_full.goodput
+    assert res_inc.summary() == res_full.summary()
+    assert net_inc.mean_utilization() == net_full.mean_utilization()
+    assert net_inc.cache_hits > 0
+    assert net_inc.recomputes < net_full.recomputes
+    return res_inc
+
+
+def _scenario_contend(cls, sink, out):
+    """The PR-4 acceptance scenario plus single-pod churn: two 2-pod
+    whales share the core while small jobs come and go (ingest on, so
+    every start/finish re-prices)."""
+    c = _fleet(pods=4)
+    net = cls(NetConfig(oversubscription=4.0, ingest_gbps_per_chip=0.05))
+    jobs = [
+        _whale("a", 0.0, 100.0),
+        _whale("b", 0.0, 300.0),
+        *[Job(f"s{i}", 5.0 * i, num_chips=8, duration=40.0)
+          for i in range(12)],
+    ]
+    ml = MetricsLog(events_sink=sink)
+    with ml:
+        res = Simulator(c, make_policy("fifo", backfill=True), jobs,
+                        metrics=ml, net=net).run()
+    ml.write(out)
+    return res, net
+
+
+def _scenario_link_faults(cls, sink, out):
+    """Link degradation/repair: the fault path must dirty the cache (a
+    degraded uplink re-prices with no allocation change at all)."""
+    c = _fleet(pods=2)
+    net = cls(NetConfig(oversubscription=1.0, ingest_gbps_per_chip=0.0))
+    jobs = [_whale("w", 0.0, 200.0, model="transformer-tiny"),
+            Job("s", 0.0, num_chips=8, duration=500.0)]
+    plan = FaultPlan(records=[
+        FaultRecord(10.0, ("link", 0), 20.0, "link", degrade=0.5),
+        FaultRecord(60.0, ("link", 0), 15.0, "link", degrade=0.0),
+    ])
+    ml = MetricsLog(events_sink=sink)
+    with ml:
+        res = Simulator(c, make_policy("fifo", backfill=True), jobs,
+                        metrics=ml, net=net, faults=plan).run()
+    ml.write(out)
+    return res, net
+
+
+def _scenario_ingest_free_churn(cls, sink, out):
+    """ingest=0: single-pod churn must NOT dirty the cache (the sharpest
+    mark_dirty test), while multislice starts/stops still re-price."""
+    c = _fleet(pods=4)
+    net = cls(NetConfig(oversubscription=4.0, ingest_gbps_per_chip=0.0))
+    jobs = [
+        _whale("a", 0.0, 300.0),
+        _whale("b", 50.0, 200.0),
+        *[Job(f"s{i}", 3.0 * i, num_chips=4, duration=25.0)
+          for i in range(20)],
+    ]
+    ml = MetricsLog(events_sink=sink)
+    with ml:
+        res = Simulator(c, make_policy("fifo", backfill=True), jobs,
+                        metrics=ml, net=net).run()
+    ml.write(out)
+    return res, net
+
+
+def _scenario_randomized_churn(cls, sink, out):
+    """Seeded randomized churn across the full feature load: preemptive
+    policy, promoted multislice share, chip + link faults, attribution —
+    the widest surface the cache must be invisible under."""
+    c = _fleet(pods=4, dims=(4, 4))
+    net = cls(NetConfig(oversubscription=4.0, ingest_gbps_per_chip=0.05))
+    jobs = promote_to_multislice(
+        generate_philly_like_trace(120, seed=11), 0.2, c.pod_chips, seed=11)
+    plan = FaultPlan(
+        records=generate_fault_schedule(
+            c,
+            FaultConfig(mtbf=40_000.0, repair=1800.0,
+                        link_mtbf=30_000.0, link_repair=1200.0,
+                        link_degrade=0.3),
+            horizon=600_000.0, seed=11,
+        ),
+        recovery=RecoveryModel(ckpt_interval=1800.0, restore="auto"),
+    )
+    ml = MetricsLog(events_sink=sink, attribution=True, run_meta={
+        "run_id": "churn", "seed": 11, "policy": "dlas",
+        "config_hash": "x"})
+    with ml:
+        res = Simulator(c, make_policy("dlas", thresholds=(600.0,)), jobs,
+                        metrics=ml, net=net, faults=plan,
+                        max_time=600_000.0).run()
+    ml.write(out)
+    return res, net
+
+
+def test_incremental_matches_full_contention(tmp_path):
+    _pair(_scenario_contend, tmp_path)
+
+
+def test_incremental_matches_full_under_link_faults(tmp_path):
+    _pair(_scenario_link_faults, tmp_path)
+
+
+def test_incremental_matches_full_ingest_free(tmp_path):
+    _pair(_scenario_ingest_free_churn, tmp_path)
+
+
+def test_incremental_matches_full_randomized_churn(tmp_path):
+    res = _pair(_scenario_randomized_churn, tmp_path)
+    assert res.num_finished > 0
+    # attribution closures stay exact through the cache
+    assert res.delay_by_cause
+
+
+def test_single_pod_churn_keeps_cache_clean_when_ingest_off(tmp_path):
+    """With ingest off, a single-pod start/finish cannot perturb the
+    fabric: the cache must keep hitting through pure single-pod churn."""
+    c = _fleet(pods=2)
+    net = NetModel(NetConfig(oversubscription=1.0, ingest_gbps_per_chip=0.0))
+    jobs = [Job(f"s{i}", 10.0 * i, num_chips=4, duration=35.0)
+            for i in range(10)]
+    Simulator(c, make_policy("fifo"), jobs, net=net).run()
+    # one full pass (the armed initial state), everything after is cached
+    assert net.recomputes == 1
+    assert net.cache_hits > 0
+
+
+def test_direct_recompute_needs_no_marking():
+    """The public API contract: recompute() is always a full pass, so
+    direct callers (tests, tools) stay correct without mark_dirty."""
+    c = _fleet(pods=2)
+    net = NetModel(NetConfig(oversubscription=1.0, ingest_gbps_per_chip=0.5))
+    net.attach(c)
+    state0 = net.recompute(0.0, [])
+    assert state0.links["uplink/pod0"].used_gbps == 0.0
+    c.allocate(8, hint={"pod": 0})  # direct mutation, no mark_dirty
+    state1 = net.recompute(1.0, [])
+    assert state1.links["uplink/pod0"].used_gbps == pytest.approx(4.0)
+
+
+def test_reattach_same_cluster_drops_the_cache():
+    """A NetModel reused for a second Simulator over the same cluster
+    must start from a full recompute, not serve the previous run's final
+    state from poll() (review-found regression: attach()'s idempotent
+    early-return used to preserve the cache)."""
+    c = _fleet(pods=2)
+    net = NetModel(NetConfig(oversubscription=1.0, ingest_gbps_per_chip=0.0))
+    res1 = Simulator(c, make_policy("fifo"),
+                     [_whale("w", 0.0, 50.0, model="transformer-tiny")],
+                     net=net).run()
+    assert res1.num_finished == 1
+    assert net.poll(res1.end_time) is not None  # cache warm after run 1
+    net.attach(c)  # what Simulator #2's construction does
+    assert net.poll(res1.end_time) is None  # cache dropped: full pass next
+    res2 = Simulator(c, make_policy("fifo"),
+                     [_whale("w2", 0.0, 50.0, model="transformer-tiny")],
+                     net=net).run()
+    assert res2.num_finished == 1
+    assert res2.jobs[0].locality_factor == res1.jobs[0].locality_factor
+
+
+def test_degrade_and_repair_dirty_the_cache():
+    c = _fleet(pods=2)
+    net = NetModel(NetConfig(oversubscription=1.0, ingest_gbps_per_chip=0.0))
+    net.attach(c)
+    net.recompute(0.0, [])
+    assert net.poll(0.0) is not None
+    net.degrade_link(0, 0.5)
+    assert net.poll(0.0) is None
+    net.recompute(0.0, [])
+    assert net.poll(0.0) is not None
+    net.repair_link(0, 0.5)
+    assert net.poll(0.0) is None
+
+
+def test_pod_used_counter_tracks_grid_sums():
+    """pod_used_chips is now an O(1) maintained count (the ingest term
+    reads it per pod per re-price): it must equal the occupancy-grid sum
+    after every grant/free, across single-slice, multislice, and overlay
+    traffic."""
+    c = _fleet(pods=3)
+
+    def check():
+        for p in range(c.num_pods):
+            assert c.pod_used_chips(p) == int(c._occ[p].sum())
+
+    a = c.allocate(8, hint={"pod": 0})
+    b = c.allocate(4, hint={"pod": 0})
+    check()
+    ms = c.allocate(32, job=_whale("m", 0.0, 1.0))  # pods 1+2, whole pods
+    check()
+    guest = c.allocate(32, job=_whale("g", 0.0, 1.0), hint={"overlay": ms})
+    check()  # overlay shares the base's chips: no physical change
+    c.free(guest)
+    check()
+    c.free(a)
+    check()
+    c.free(ms)
+    check()
+    c.free(b)
+    check()
+    assert c.used_chips == 0
+    assert all(c.pod_used_chips(p) == 0 for p in range(c.num_pods))
+
+
+def test_poll_keeps_utilization_integral_chunking():
+    """poll() must integrate the utilization means at the same instants a
+    full pass would — mean_utilization is part of the sweep artifact's
+    byte-identity."""
+    c = _fleet(pods=2)
+
+    net_a = NetModel(NetConfig(oversubscription=1.0, ingest_gbps_per_chip=0.0))
+    net_a.attach(c)
+    net_a.recompute(0.0, [])
+    assert net_a.poll(10.0) is not None     # cached, still integrates
+    assert net_a.poll(25.0) is not None
+    net_a.close(40.0)
+
+    net_b = _FullRecompute(
+        NetConfig(oversubscription=1.0, ingest_gbps_per_chip=0.0))
+    net_b.attach(c)
+    net_b.recompute(0.0, [])
+    net_b.recompute(10.0, [])
+    net_b.recompute(25.0, [])
+    net_b.close(40.0)
+
+    assert net_a.mean_utilization() == net_b.mean_utilization()
+    assert net_a.recomputes == 1 and net_b.recomputes == 3

@@ -137,10 +137,33 @@ def run_cell(policy_key: str, config, recovery, *, num_jobs: int,
     }
 
 
+def _chaos_cell(key: str, point, *, tmp: str, num_jobs: int, seed: int,
+                max_time: float) -> dict:
+    """Module-level cell thunk (picklable for the process pool): one
+    (config index, policy) chaos cell writing/analyzing its own stream."""
+    i, config, recovery = point
+    return run_cell(
+        key, config, recovery, num_jobs=num_jobs, seed=seed,
+        max_time=max_time,
+        events_path=Path(tmp) / f"c{i}-{key}.events.jsonl",
+    )
+
+
 def run_chaos(*, configs: int, num_jobs: int, seed: int,
-              policies, max_time: float = 400_000.0) -> dict:
+              policies, max_time: float = 400_000.0,
+              workers: int = 1) -> dict:
     """The full grid; raises nothing — failures are collected so one
-    broken cell doesn't hide the rest."""
+    broken cell doesn't hide the rest.
+
+    ``workers`` > 1 fans the (config x policy) cells across a process
+    pool (the faults/sweep.py grid_cells machinery): every cell is an
+    isolated seeded replay writing (and analyzing) its own stream file,
+    and the configs are all drawn up front in the parent, so the
+    assembled document is byte-identical to the serial run."""
+    from functools import partial
+
+    from gpuschedule_tpu.faults.sweep import grid_cells
+
     keys = list(policies) if policies else list(POLICY_CONFIGS)
     unknown = [k for k in keys if k not in POLICY_CONFIGS]
     if unknown:
@@ -149,31 +172,37 @@ def run_chaos(*, configs: int, num_jobs: int, seed: int,
         )
     out = {"seed": seed, "num_jobs": num_jobs, "configs": [], "cells": 0,
            "failed_cells": 0}
+    drawn = []
+    for i in range(configs):
+        rng = random.Random(f"{seed}:chaos:{i}")
+        drawn.append(draw_config(rng))
+    points = [(i, config, recovery)
+              for i, (config, recovery) in enumerate(drawn)]
     with tempfile.TemporaryDirectory(prefix="fault_chaos_") as tmp:
-        for i in range(configs):
-            rng = random.Random(f"{seed}:chaos:{i}")
-            config, recovery = draw_config(rng)
-            entry = {
-                "index": i,
-                "config": dict(config.__dict__),
-                "recovery": {
-                    "ckpt_interval": recovery.ckpt_interval,
-                    "restore": recovery.restore,
-                    "ckpt_write": recovery.ckpt_write,
-                },
-                "cells": [],
-            }
-            for key in keys:
-                cell = run_cell(
-                    key, config, recovery, num_jobs=num_jobs, seed=seed,
-                    max_time=max_time,
-                    events_path=Path(tmp) / f"c{i}-{key}.events.jsonl",
-                )
-                out["cells"] += 1
-                if cell["failures"]:
-                    out["failed_cells"] += 1
-                entry["cells"].append(cell)
-            out["configs"].append(entry)
+        cells = grid_cells(
+            keys, points,
+            partial(_chaos_cell, tmp=tmp, num_jobs=num_jobs, seed=seed,
+                    max_time=max_time),
+            workers=workers,
+        )
+    for i, (config, recovery) in enumerate(drawn):
+        entry = {
+            "index": i,
+            "config": dict(config.__dict__),
+            "recovery": {
+                "ckpt_interval": recovery.ckpt_interval,
+                "restore": recovery.restore,
+                "ckpt_write": recovery.ckpt_write,
+            },
+            "cells": [],
+        }
+        for key in keys:
+            cell = cells[key][i]
+            out["cells"] += 1
+            if cell["failures"]:
+                out["failed_cells"] += 1
+            entry["cells"].append(cell)
+        out["configs"].append(entry)
     return out
 
 
@@ -191,6 +220,10 @@ def main(argv=None) -> int:
     p.add_argument("--max-time", type=float, default=400_000.0,
                    help="horizon cutoff per cell (bounds both the replay "
                         "and the schedule size under low-MTBF draws)")
+    p.add_argument("--workers", type=int, default=1,
+                   help="process-parallel chaos cells (isolated seeded "
+                        "replays; the document is byte-identical to "
+                        "--workers 1, the serial default)")
     p.add_argument("--out", help="also write the JSON document here")
     args = p.parse_args(argv)
 
@@ -200,6 +233,7 @@ def main(argv=None) -> int:
         seed=args.seed,
         policies=args.policies.split(",") if args.policies else None,
         max_time=args.max_time,
+        workers=args.workers,
     ))
     summary = {
         "cells": doc["cells"],

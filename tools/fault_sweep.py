@@ -85,6 +85,11 @@ def main(argv=None) -> int:
     p.add_argument("--pods", type=int, default=1)
     p.add_argument("--max-time", type=float,
                    help="horizon cutoff per cell (bounds schedule size)")
+    p.add_argument("--workers", type=int, default=1,
+                   help="process-parallel sweep cells (each cell is an "
+                        "isolated seeded replay; results reassemble in "
+                        "grid order, so the artifact is byte-identical "
+                        "to --workers 1, the serial default)")
     p.add_argument("--out", required=True, help="JSON artifact path")
     args = p.parse_args(argv)
 
@@ -112,6 +117,7 @@ def main(argv=None) -> int:
     grid = sweep(
         mtbfs,
         policies,
+        workers=args.workers,
         repair=args.repair,
         ckpt=args.ckpt,
         restore=restore,

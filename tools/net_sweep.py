@@ -63,6 +63,10 @@ def main(argv=None) -> int:
                    help="inelastic ingest Gbps per occupied chip")
     p.add_argument("--max-time", type=float,
                    help="horizon cutoff per cell")
+    p.add_argument("--workers", type=int, default=1,
+                   help="process-parallel sweep cells (isolated seeded "
+                        "replays reassembled in grid order: byte-identical "
+                        "to --workers 1, the serial default)")
     p.add_argument("--out", required=True, help="JSON artifact path")
     args = p.parse_args(argv)
 
@@ -74,6 +78,7 @@ def main(argv=None) -> int:
     grid = sweep(
         shares,
         policies,
+        workers=args.workers,
         num_jobs=args.num_jobs,
         seed=args.seed,
         dims=_parse_dims(args.dims),
