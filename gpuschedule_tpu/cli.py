@@ -206,9 +206,15 @@ def cmd_run(args) -> int:
         except ValueError as e:
             raise SystemExit(str(e)) from None
         horizon = args.max_time if args.max_time else fault_horizon(jobs)
-        fault_plan = make_fault_plan(
-            cluster, fconfig, frecovery, horizon=horizon, seed=args.seed
-        )
+        try:
+            fault_plan = make_fault_plan(
+                cluster, fconfig, frecovery, horizon=horizon, seed=args.seed
+            )
+        except ValueError as e:
+            # config-vs-cluster mismatches (e.g. a domain weight naming a
+            # level this topology has no domains for) are user errors,
+            # not tracebacks
+            raise SystemExit(str(e)) from None
     # With --events the stream goes straight to its JSONL sink (constant
     # memory at Philly scale): to the given PATH, or events.jsonl under
     # --out for the bare flag; --perfetto alone buffers events in RAM just
@@ -934,7 +940,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                      help="switches x nodes x gpus for --cluster gpu")
     run.add_argument("--placement", default="consolidated",
                      help="consolidated|random|greedy|topology (gpu) / "
-                          "consolidated|random|spread (tpu)")
+                          "consolidated|random|spread|contention|health "
+                          "(tpu; contention needs --net, health steers "
+                          "away from degraded/high-hazard chips)")
     run.add_argument("--placement-seed", type=int, default=0)
     run.add_argument("--philly", help="Philly-schema trace CSV")
     run.add_argument("--trace", help="native-schema trace CSV")
@@ -977,7 +985,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                           "notice window: emergency checkpoints when it "
                           "covers the write cost), domain_mtbf / "
                           "domain_repair (correlated host/rack/pod "
-                          "outages), straggler_mtbf / straggler_repair / "
+                          "outages), domain_host / domain_rack / "
+                          "domain_pod (per-level outage-rate multipliers), "
+                          "hazard_shape (Weibull shape; 1 = memoryless), "
+                          "hazard_util (wear-driven aging weight), "
+                          "migrate_threshold (proactive checkpoint-and-"
+                          "migrate trigger), straggler_mtbf / "
+                          "straggler_repair / "
                           "straggler_degrade (slow chips pacing their "
                           "gangs), link_mtbf / link_repair / link_degrade, "
                           "ckpt, restore, ckpt_write (priced periodic "
@@ -994,7 +1008,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                           "re-priced on every running-set change.  SPEC is "
                           "k=v pairs: os (core oversubscription ratio, "
                           "default 4), ingest (Gbps per occupied chip, "
-                          "default 0.05).  TPU clusters only; enables the "
+                          "default 0.05), uplinks (redundant sibling "
+                          "uplinks per pod, default 1; >1 arms adaptive "
+                          "routing around degraded links).  TPU clusters "
+                          "only; enables the "
                           "'contention' placement scheme's residual-"
                           "bandwidth scoring and ('link', pod) fault "
                           "degradation")

@@ -96,6 +96,25 @@ class ClusterBase:
         the default empty list disables the domain process."""
         return []
 
+    # ---- failure hazard (faults/hazard.py, ISSUE 8) ------------------- #
+
+    def bind_hazard(self, model) -> None:
+        """Attach a runtime :class:`~gpuschedule_tpu.faults.hazard.
+        HazardModel` (the engine does this when the fault plan arms any
+        hazard knob).  Unbound clusters score every scope 0.0."""
+        self._hazard_model = model
+
+    def hazard_score(self, scope) -> float:
+        """Failure-hazard signal for a fault ``scope``: expected failure
+        arrivals per hour over its chips at their effective (wear-
+        inflated) age, from the bound hazard model, plus the flavor's
+        degrade-mask penalty (each known-slow chip adds its lost rate
+        fraction — flavors with a degrade mask override and add it).
+        0.0 with no model bound and nothing degraded — the knob-off
+        answer, free to compute."""
+        model = getattr(self, "_hazard_model", None)
+        return 0.0 if model is None else model.score(self, scope)
+
     # ---- straggler degrade mask (faults/) ----------------------------- #
 
     def degraded_chips(self) -> dict:

@@ -232,6 +232,19 @@ class GpuCluster(ClusterBase):
                 factor = min(factor, math.prod(stack))
         return factor
 
+    def hazard_score(self, scope) -> float:
+        """Hazard signal for a node/switch scope (faults/hazard.py): the
+        bound model's age/wear term plus this tree's degrade-mask
+        penalty — every known-slow node in the scope adds its lost rate
+        fraction.  0.0 when nothing is armed or degraded."""
+        score = super().hazard_score(scope)
+        if self._node_degrade:
+            nodes = set(self._scope_nodes(scope))
+            for nd, stack in self._node_degrade.items():
+                if nd in nodes:
+                    score += 1.0 - math.prod(stack)
+        return score
+
     def _avail(self) -> Dict[NodeId, int]:
         """Per-node free GPUs the placement schemes may use: ``_free``
         itself on a healthy fleet (zero-copy fault-free path), down nodes
@@ -255,7 +268,22 @@ class GpuCluster(ClusterBase):
         if num_chips <= 0 or num_chips > self.free_chips:
             return None
         scheme = (hint or {}).get("scheme", self.scheme)
-        sel = self._select(num_chips, scheme)
+        sel = None
+        # Avoid-mask (ISSUE 8): prefer nodes without straggler
+        # degradation — soft (True) falls back to the full pool, "strict"
+        # refuses rather than land on a known-slow node.  Free when
+        # nothing is degraded.
+        avoid = (hint or {}).get("avoid_degraded") if self._node_degrade else None
+        if avoid:
+            clean = {
+                nd: (0 if nd in self._node_degrade else f)
+                for nd, f in self._avail().items()
+            }
+            sel = self._select(num_chips, scheme, avail=clean)
+            if sel is None and avoid == "strict":
+                return None
+        if sel is None:
+            sel = self._select(num_chips, scheme)
         if sel is None:
             # enough chips in aggregate (guarded above), placement refused:
             # a locality/fragmentation failure by definition
@@ -296,8 +324,11 @@ class GpuCluster(ClusterBase):
             speed_factor=self.locality_speed[locality],
         )
 
-    def _select(self, n: int, scheme: str) -> Optional[List[Tuple[NodeId, int]]]:
-        avail = self._avail()  # schemes never see GPUs on down nodes
+    def _select(
+        self, n: int, scheme: str, avail: Optional[Dict[NodeId, int]] = None
+    ) -> Optional[List[Tuple[NodeId, int]]]:
+        if avail is None:
+            avail = self._avail()  # schemes never see GPUs on down nodes
         if scheme == "consolidated":
             return self._select_consolidated(n, avail)
         if scheme == "random":

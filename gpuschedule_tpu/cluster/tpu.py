@@ -428,6 +428,25 @@ class TpuCluster(OverlayMixin, ClusterBase):
                 factor = min(factor, math.prod(stack))
         return factor
 
+    def hazard_score(self, scope) -> float:
+        """Hazard signal for a chip/box/pod scope (faults/hazard.py):
+        the bound model's age/wear term plus this torus's degrade-mask
+        penalty — every known-slow chip inside the scope adds its lost
+        rate fraction, so a pod carrying stragglers outranks a clean pod
+        of the same age.  Free (0.0) when nothing is armed or degraded."""
+        score = super().hazard_score(scope)
+        if self._chip_degrade:
+            boxes = self._fault_boxes(scope)
+            for (pod, coord), stack in self._chip_degrade.items():
+                for b_pod, origin, shape in boxes:
+                    if b_pod == pod and all(
+                        o <= c < o + s
+                        for c, o, s in zip(coord, origin, shape)
+                    ):
+                        score += 1.0 - math.prod(stack)
+                        break
+        return score
+
     def _blocked(self, pod: int) -> np.ndarray:
         """Grid the slice search scans: occupancy, plus the health mask
         when any chip is down (the fault-free path returns ``_occ``
@@ -436,6 +455,21 @@ class TpuCluster(OverlayMixin, ClusterBase):
         if self._unhealthy_cells == 0:
             return occ
         return occ + (self._health[pod] > 0)
+
+    def _blocked_avoiding(self, pod: int) -> np.ndarray:
+        """The blocked grid with this pod's degraded (straggler) chips
+        additionally masked — the avoid-pass search grid of an
+        ``avoid_degraded`` allocation hint.  Only called while the
+        degrade set is non-empty; the grid is tiny, so the copy is
+        cheap."""
+        blocked = self._blocked(pod)
+        coords = [c for (p, c) in self._chip_degrade if p == pod]
+        if not coords:
+            return blocked
+        grid = blocked.copy()
+        for coord in coords:
+            grid[coord] = 1
+        return grid
 
     def pod_free_chips(self, pod: int) -> int:
         """Healthy free chips in one pod (fault-evacuation planning)."""
@@ -517,19 +551,41 @@ class TpuCluster(OverlayMixin, ClusterBase):
 
         if num_chips > self.free_chips:
             return None
+        # Avoid-mask (ISSUE 8): an ``avoid_degraded`` hint first searches
+        # with known-slow (straggler) chips masked out, so a gang never
+        # lands on degraded hardware while a clean box exists.  The soft
+        # form (True) falls back to the unrestricted search; "strict"
+        # returns None instead (proactive migration must not re-grant the
+        # degraded slice it is fleeing).  Free when nothing is degraded.
+        avoid = hint.get("avoid_degraded") if self._chip_degrade else None
+        if avoid == "strict":
+            avoid_passes: Tuple[bool, ...] = (True,)
+        elif avoid:
+            avoid_passes = (True, False)
+        else:
+            avoid_passes = (False,)
         # fault-free fast path (ISSUE 7): a pod with fewer free chips than
         # the request can never fit the box — skip its numpy window scan
         # outright.  With any chip health-masked the blocked grid differs
         # from occupancy, so the full search runs (cold path).
         pod_used = self._pod_used if self._unhealthy_cells == 0 else None
         pod_cap = self.pod_chips
-        for pod in pods:
-            if pod_used is not None and pod_cap - pod_used[pod] < num_chips:
-                continue
-            for shape in shapes:
-                origin = self._find_free_box(self._blocked(pod), shape, origin_order)
-                if origin is not None:
-                    return self._grant(pod, origin, shape)
+        for avoiding in avoid_passes:
+            for pod in pods:
+                if pod_used is not None and pod_cap - pod_used[pod] < num_chips:
+                    continue
+                blocked = (
+                    self._blocked_avoiding(pod) if avoiding
+                    else self._blocked(pod)
+                )
+                for shape in shapes:
+                    origin = self._find_free_box(blocked, shape, origin_order)
+                    if origin is not None:
+                        return self._grant(pod, origin, shape)
+        if avoid == "strict":
+            # an avoid refusal, not geometric fragmentation: the
+            # unrestricted search was never run
+            return None
         if "pod" not in hint and "shape" not in hint:
             # enough chips in aggregate, full search space, still no box:
             # that is geometric fragmentation by definition
@@ -561,10 +617,23 @@ class TpuCluster(OverlayMixin, ClusterBase):
         if num_chips > self.free_chips:
             return None
         empty = self._empty_pods()
-        pod_order = (hint or {}).get("pod_order")
+        hint = hint or {}
+        pod_order = hint.get("pod_order")
         if pod_order is not None:
             allowed = set(empty)
             empty = [p for p in pod_order(list(empty)) if p in allowed]
+        avoid = hint.get("avoid_degraded") if self._chip_degrade else None
+        if avoid:
+            # a multislice claims whole pods, so any degraded chip taints
+            # the pod: clean pods first (soft), or clean pods only (strict)
+            dirty = {p for p, _ in self._chip_degrade}
+            clean = [p for p in empty if p not in dirty]
+            if avoid == "strict":
+                if len(clean) < m:
+                    return None  # avoid refusal, not fragmentation
+                empty = clean
+            else:
+                empty = clean + [p for p in empty if p in dirty]
         if len(empty) < m:
             # enough chips in aggregate but not enough whole pods free:
             # cross-pod fragmentation

@@ -21,6 +21,7 @@ in :mod:`gpuschedule_tpu.cluster`).  Like the sim core, this package is
 deliberately JAX-free.
 """
 
+from gpuschedule_tpu.faults.hazard import HazardConfig, HazardModel, hazard_config
 from gpuschedule_tpu.faults.recovery import FaultPlan, RecoveryModel, make_fault_plan
 from gpuschedule_tpu.faults.schedule import (
     FaultConfig,
@@ -35,8 +36,11 @@ __all__ = [
     "FaultRecord",
     "FaultPlan",
     "RecoveryModel",
+    "HazardConfig",
+    "HazardModel",
     "fault_horizon",
     "generate_fault_schedule",
+    "hazard_config",
     "make_fault_plan",
     "parse_fault_spec",
 ]

@@ -42,6 +42,7 @@ import math
 from dataclasses import dataclass, field
 from typing import List, Optional, Union
 
+from gpuschedule_tpu.faults.hazard import HazardConfig, hazard_config
 from gpuschedule_tpu.faults.schedule import (
     FaultConfig,
     FaultRecord,
@@ -120,10 +121,18 @@ class FaultPlan:
     """Everything the engine needs to run a faulty replay: the (already
     generated, time-sorted) fault schedule plus the recovery model applied
     to every victim.  An empty ``records`` list is a valid plan — the
-    fault path is armed but never fires (the ``mtbf=inf`` case)."""
+    fault path is armed but never fires (the ``mtbf=inf`` case).
+
+    ``hazard`` (ISSUE 8) is the armed hazard knobs when any is set: the
+    engine builds a runtime :class:`~gpuschedule_tpu.faults.hazard.
+    HazardModel` from it, binds it to the cluster (so
+    ``cluster.hazard_score`` answers), and arms the proactive
+    checkpoint-and-migrate trigger.  None — the default — keeps the
+    hazard machinery entirely out of the run."""
 
     records: List[FaultRecord] = field(default_factory=list)
     recovery: RecoveryModel = field(default_factory=RecoveryModel)
+    hazard: Optional["HazardConfig"] = None
 
 
 def make_fault_plan(
@@ -135,10 +144,14 @@ def make_fault_plan(
     seed: int = 0,
 ) -> FaultPlan:
     """Convenience constructor: generate the schedule and bundle it with a
-    recovery model (both defaulted) into one plan."""
+    recovery model (both defaulted) into one plan.  Hazard knobs on the
+    config (``hazard_shape`` / ``hazard_util_weight`` /
+    ``migrate_threshold``) ride along as ``plan.hazard``."""
+    config = config or FaultConfig()
     return FaultPlan(
         records=generate_fault_schedule(
-            cluster, config or FaultConfig(), horizon=horizon, seed=seed
+            cluster, config, horizon=horizon, seed=seed
         ),
         recovery=recovery or RecoveryModel(),
+        hazard=hazard_config(config),
     )

@@ -83,7 +83,7 @@ from __future__ import annotations
 import math
 import random
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 
 @dataclass(frozen=True)
@@ -141,6 +141,17 @@ class FaultConfig:
 
     mtbf: float = math.inf              # per-chip mean time between failures (s)
     repair: float = 3600.0              # mean repair duration (s)
+    # Hazard model (faults/hazard.py, ISSUE 8): hazard_shape is the
+    # Weibull shape of the MTBF process — 1.0 is the memoryless default
+    # (byte-identical schedules); >1 wear-out (failures cluster late),
+    # <1 infant mortality.  hazard_util_weight folds runtime wear (busy
+    # chip-seconds per chip) into the effective age the runtime hazard
+    # SCORE uses (schedules are generated up front and cannot see
+    # utilization); migrate_threshold arms the engine's proactive
+    # checkpoint-and-migrate offer (inf = never).
+    hazard_shape: float = 1.0
+    hazard_util_weight: float = 0.0
+    migrate_threshold: float = math.inf
     maintenance_period: float = 0.0     # seconds between planned windows (0 = off)
     maintenance_duration: float = 7200.0
     spot_fraction: float = 0.0          # trailing fraction of capacity that is spot
@@ -157,6 +168,14 @@ class FaultConfig:
     # the domain offline at once.
     domain_mtbf: float = math.inf       # per-domain mean time between outages (s)
     domain_repair: float = 2 * 3600.0   # mean domain repair duration (s)
+    # Per-level domain rate weighting (ISSUE 8 satellite): multiplies the
+    # outage rate of every domain at that hierarchy level, so pod-scale
+    # blast radii can be made (realistically) rarer than host blips
+    # without touching the aggregate knob.  None keeps the historical
+    # uniform pick byte-identical (the single-knob form is hash-pinned);
+    # a dict like {"host": 4.0, "rack": 1.0, "pod": 0.25} re-weights the
+    # superposition (per-domain rate = weight / domain_mtbf).
+    domain_weights: Optional[Dict[str, float]] = None
     # Straggler chips (kind="straggler"): per-chip (TPU) / per-node (GPU)
     # gradual degradation — the unit keeps running at straggler_degrade of
     # its rate and the whole gang on it slows to match (never revoked).
@@ -258,24 +277,47 @@ def generate_fault_schedule(
                 return rng.expovariate(1.0 / config.repair)
             return 0.0
 
-        t = rng.expovariate(rate)
-        while t <= horizon:
+        def mtbf_scope() -> Tuple:
             if flavor == "tpu":
                 pod = rng.randrange(inner.num_pods)
                 coord = tuple(rng.randrange(d) for d in inner.dims)
-                scope: Tuple = ("chip", pod, coord)
-            elif flavor == "gpu":
+                return ("chip", pod, coord)
+            if flavor == "gpu":
                 # a GPU failure takes its host node offline (the Philly
                 # failure domain is the machine, not the device)
-                scope = (
+                return (
                     "node",
                     rng.randrange(inner.num_switches),
                     rng.randrange(inner.nodes_per_switch),
                 )
-            else:
-                scope = ("chips", 1)
-            records.append(FaultRecord(t, scope, repair_duration(), "mtbf"))
-            t += rng.expovariate(rate)
+            return ("chips", 1)
+
+        if config.hazard_shape == 1.0:
+            # memoryless (the historical process — this branch must stay
+            # byte-identical draw for draw)
+            t = rng.expovariate(rate)
+            while t <= horizon:
+                records.append(
+                    FaultRecord(t, mtbf_scope(), repair_duration(), "mtbf")
+                )
+                t += rng.expovariate(rate)
+        else:
+            # Weibull-style age dependence (faults/hazard.py): the fleet
+            # intensity follows lam(t) = rate * k * (t/horizon)^(k-1),
+            # normalized so the expected count over the horizon equals
+            # the homogeneous process at the same mtbf.  Sampled by time
+            # rescaling: unit-exponential partial sums S_i in transformed
+            # time invert through the cumulative hazard
+            # H(t) = rate * horizon * (t/horizon)^k.
+            k = config.hazard_shape
+            total = rate * horizon
+            s = rng.expovariate(1.0)
+            while s < total:
+                t = horizon * (s / total) ** (1.0 / k)
+                records.append(
+                    FaultRecord(t, mtbf_scope(), repair_duration(), "mtbf")
+                )
+                s += rng.expovariate(1.0)
 
     # -- planned maintenance windows (deterministic) ------------------- #
     if config.maintenance_period > 0 and horizon > 0:
@@ -304,9 +346,25 @@ def generate_fault_schedule(
         and horizon > 0
     ):
         domains = getattr(inner, "failure_domains", lambda: [])()
+        weights = config.domain_weights
+        if weights is not None:
+            unknown = set(weights) - {lvl for lvl, _ in domains}
+            if unknown and domains:
+                raise ValueError(
+                    f"domain_weights name levels this cluster has no "
+                    f"domains for: {sorted(unknown)}"
+                )
+            if any(w < 0 for w in weights.values()):
+                raise ValueError(
+                    f"domain_weights must be >= 0, got {weights}"
+                )
+            # zero-weighted levels leave the process entirely
+            domains = [
+                (lvl, scope) for lvl, scope in domains
+                if weights.get(lvl, 1.0) > 0.0
+            ]
         if domains:
             rng = random.Random(f"{seed}:faults:domain")
-            rate = len(domains) / config.domain_mtbf
 
             def domain_duration() -> float:
                 if math.isinf(config.domain_repair):
@@ -315,17 +373,41 @@ def generate_fault_schedule(
                     return rng.expovariate(1.0 / config.domain_repair)
                 return 0.0
 
-            t = rng.expovariate(rate)
-            while t <= horizon:
+            if weights is None:
                 # every domain is an independent Poisson process at rate
                 # 1/domain_mtbf; the superposition picks uniformly, so
                 # host outages dominate in aggregate simply because there
-                # are more hosts than racks than pods
-                level, scope = domains[rng.randrange(len(domains))]
-                records.append(FaultRecord(
-                    t, scope, domain_duration(), "domain", level=level,
-                ))
-                t += rng.expovariate(rate)
+                # are more hosts than racks than pods.  This branch is
+                # the historical draw sequence, byte-identical by pin.
+                rate = len(domains) / config.domain_mtbf
+                t = rng.expovariate(rate)
+                while t <= horizon:
+                    level, scope = domains[rng.randrange(len(domains))]
+                    records.append(FaultRecord(
+                        t, scope, domain_duration(), "domain", level=level,
+                    ))
+                    t += rng.expovariate(rate)
+            else:
+                # per-level rate weighting (ISSUE 8 satellite): a domain
+                # at level L fires at weight(L)/domain_mtbf, so the
+                # superposition rate is sum(weights)/domain_mtbf and the
+                # pick is weighted by cumulative level weight
+                import bisect
+
+                cum: List[float] = []
+                acc = 0.0
+                for lvl, _ in domains:
+                    acc += weights.get(lvl, 1.0)
+                    cum.append(acc)
+                rate = acc / config.domain_mtbf
+                t = rng.expovariate(rate)
+                while t <= horizon:
+                    idx = bisect.bisect_right(cum, rng.random() * acc)
+                    level, scope = domains[min(idx, len(domains) - 1)]
+                    records.append(FaultRecord(
+                        t, scope, domain_duration(), "domain", level=level,
+                    ))
+                    t += rng.expovariate(rate)
 
     # -- straggler chips (degrade, never revoke) ----------------------- #
     if (
@@ -445,6 +527,14 @@ _SPEC_KEYS = {
     "spot_warning": ("config", "spot_warning"),
     "domain_mtbf": ("config", "domain_mtbf"),
     "domain_repair": ("config", "domain_repair"),
+    # per-level domain rate multipliers (ISSUE 8 satellite): the
+    # single-knob domain_mtbf form stays untouched when none is given
+    "domain_host": ("weight", "host"),
+    "domain_rack": ("weight", "rack"),
+    "domain_pod": ("weight", "pod"),
+    "hazard_shape": ("config", "hazard_shape"),
+    "hazard_util": ("config", "hazard_util_weight"),
+    "migrate_threshold": ("config", "migrate_threshold"),
     "straggler_mtbf": ("config", "straggler_mtbf"),
     "straggler_repair": ("config", "straggler_repair"),
     "straggler_degrade": ("config", "straggler_degrade"),
@@ -465,7 +555,13 @@ def parse_fault_spec(spec: str):
     ``maintenance_duration``, ``spot`` (fraction), ``spot_mtbf``,
     ``spot_outage``, ``spot_warning`` (pre-revoke notice lead time),
     ``domain_mtbf``, ``domain_repair`` (correlated host/rack/pod
-    outages), ``straggler_mtbf``, ``straggler_repair``,
+    outages), ``domain_host``/``domain_rack``/``domain_pod`` (per-level
+    outage-rate multipliers; omitting all keeps the historical uniform
+    pick), ``hazard_shape`` (Weibull shape of the MTBF process; 1 =
+    memoryless), ``hazard_util`` (effective-age seconds per busy
+    chip-second, the runtime wear term), ``migrate_threshold``
+    (gang-exposure trigger for proactive checkpoint-and-migrate; inf =
+    never), ``straggler_mtbf``, ``straggler_repair``,
     ``straggler_degrade`` (residual chip-rate fraction), ``link_mtbf``,
     ``link_repair``, ``link_degrade`` (residual capacity fraction),
     ``ckpt`` (checkpoint interval), ``restore`` (seconds or ``auto``),
@@ -492,7 +588,12 @@ def parse_fault_spec(spec: str):
             value: object = "auto"
         else:
             value = float(raw)
-        setattr(config if target == "config" else recovery, attr, value)
+        if target == "weight":
+            if config.domain_weights is None:
+                config.domain_weights = {}
+            config.domain_weights[attr] = float(value)
+        else:
+            setattr(config if target == "config" else recovery, attr, value)
     if not 0.0 <= config.straggler_degrade <= 1.0:
         raise ValueError(
             f"straggler_degrade is the residual chip-rate FRACTION in "
@@ -507,6 +608,27 @@ def parse_fault_spec(spec: str):
         raise ValueError(
             f"ckpt_write is seconds per checkpoint write >= 0 (or "
             f"'auto'), got {recovery.ckpt_write}"
+        )
+    if config.hazard_shape <= 0.0:
+        raise ValueError(
+            f"hazard_shape is a Weibull shape > 0 (1 = memoryless), got "
+            f"{config.hazard_shape}"
+        )
+    if config.hazard_util_weight < 0.0:
+        raise ValueError(
+            f"hazard_util is effective-age seconds per busy chip-second "
+            f">= 0, got {config.hazard_util_weight}"
+        )
+    if config.migrate_threshold <= 0.0:
+        raise ValueError(
+            f"migrate_threshold is a gang-exposure trigger > 0 (inf = "
+            f"never), got {config.migrate_threshold}"
+        )
+    if config.domain_weights is not None and any(
+        w < 0 for w in config.domain_weights.values()
+    ):
+        raise ValueError(
+            f"domain level weights must be >= 0, got {config.domain_weights}"
         )
     if not 0.0 <= config.link_degrade <= 1.0:
         # a fraction, not seconds: an out-of-range value would be clamped

@@ -122,6 +122,10 @@ def run_cell(
     sample_interval: Optional[float] = None,
     domain_mtbf: float = math.inf,
     domain_repair: float = 2 * 3600.0,
+    domain_weights: Optional[Dict[str, float]] = None,
+    hazard_shape: float = 1.0,
+    hazard_util_weight: float = 0.0,
+    migrate_threshold: float = math.inf,
     straggler_mtbf: float = math.inf,
     straggler_repair: float = 3600.0,
     straggler_degrade: float = 0.5,
@@ -155,28 +159,40 @@ def run_cell(
     checkpoint writes) — all defaulting off, so pre-existing grids stay
     byte-identical.  Every cell additionally reports ``availability``
     and ``mttr_s`` next to the goodput decomposition.
+
+    ISSUE 8 passthrough (same default-off, hash-gated contract):
+    ``domain_weights`` (per-level outage-rate multipliers),
+    ``hazard_shape`` / ``hazard_util_weight`` (Weibull-aged,
+    wear-scored failure hazard), and ``migrate_threshold`` (proactive
+    checkpoint-and-migrate trigger; arms ``plan.hazard``).
     """
+    from gpuschedule_tpu.faults.hazard import hazard_config
+
     name, kwargs = POLICY_CONFIGS[policy_key]
     cluster = TpuCluster("v5e", dims=tuple(dims), num_pods=num_pods)
     jobs = generate_philly_like_trace(num_jobs, seed=seed)
     horizon = max_time if max_time is not None else fault_horizon(jobs)
+    fconfig = FaultConfig(
+        mtbf=mtbf, repair=repair,
+        domain_mtbf=domain_mtbf, domain_repair=domain_repair,
+        domain_weights=domain_weights,
+        hazard_shape=hazard_shape,
+        hazard_util_weight=hazard_util_weight,
+        migrate_threshold=migrate_threshold,
+        straggler_mtbf=straggler_mtbf,
+        straggler_repair=straggler_repair,
+        straggler_degrade=straggler_degrade,
+        spot_fraction=spot_fraction, spot_mtbf=spot_mtbf,
+        spot_outage=spot_outage, spot_warning=spot_warning,
+    )
     plan = FaultPlan(
         records=generate_fault_schedule(
-            cluster,
-            FaultConfig(
-                mtbf=mtbf, repair=repair,
-                domain_mtbf=domain_mtbf, domain_repair=domain_repair,
-                straggler_mtbf=straggler_mtbf,
-                straggler_repair=straggler_repair,
-                straggler_degrade=straggler_degrade,
-                spot_fraction=spot_fraction, spot_mtbf=spot_mtbf,
-                spot_outage=spot_outage, spot_warning=spot_warning,
-            ),
-            horizon=horizon, seed=seed,
+            cluster, fconfig, horizon=horizon, seed=seed,
         ),
         recovery=RecoveryModel(
             ckpt_interval=ckpt, restore=restore, ckpt_write=ckpt_write,
         ),
+        hazard=hazard_config(fconfig),
     )
     metrics = MetricsLog(attribution=attribution)
     if events_path is not None:
@@ -190,6 +206,14 @@ def run_cell(
         # knob value that generates zero records must not perturb the hash
         if domain_mtbf > 0 and math.isfinite(domain_mtbf):
             extra_cfg["domain"] = [domain_mtbf, domain_repair]
+            if domain_weights:
+                extra_cfg["domain_weights"] = dict(sorted(
+                    domain_weights.items()
+                ))
+        if plan.hazard is not None:
+            extra_cfg["hazard"] = [
+                hazard_shape, hazard_util_weight, migrate_threshold,
+            ]
         if straggler_mtbf > 0 and math.isfinite(straggler_mtbf):
             extra_cfg["straggler"] = [
                 straggler_mtbf, straggler_repair, straggler_degrade
@@ -248,6 +272,9 @@ def grid_cells(
     run_one,
     *,
     workers: int = 1,
+    max_retries: int = 2,
+    backoff_s: float = 1.0,
+    retry_log: Optional[List[dict]] = None,
 ) -> Dict[str, List[dict]]:
     """Run a (policy x grid-point) matrix of independent seeded cells,
     serially or process-parallel, reassembling results in deterministic
@@ -255,21 +282,72 @@ def grid_cells(
     cluster / schedule from the seed, so cells are embarrassingly
     parallel and the parallel artifact is byte-identical to the serial
     one).  ``run_one(key, point)`` must be picklable (module-level) for
-    ``workers > 1``."""
+    ``workers > 1``.
+
+    Crash resilience (ISSUE 8 satellite): a cell whose worker crashed or
+    was killed (OOM-killer, a BrokenProcessPool taking its poolmates
+    down with it) is retried up to ``max_retries`` times with
+    exponential backoff (``backoff_s * 2^round``) in a fresh pool before
+    the grid fails; only the failed cells re-run, and results still
+    reassemble in grid order, so a transiently-killed worker cannot
+    perturb the artifact.  The serial path retries raising cells the
+    same way.  ``retry_log`` (when given) collects one
+    ``{"cell": [key, index], "round": n}`` record per retried cell —
+    ``tools/fault_chaos.py`` reports them."""
+    import time
+
+    def note_retries(cells, rnd: int) -> None:
+        if retry_log is not None:
+            for key, i in cells:
+                retry_log.append({"cell": [key, i], "round": rnd})
+
     if workers <= 1:
-        return {key: [run_one(key, pt) for pt in points] for key in keys}
+        out: Dict[str, List[dict]] = {}
+        for key in keys:
+            row = []
+            for i, pt in enumerate(points):
+                for attempt in range(max_retries + 1):
+                    try:
+                        row.append(run_one(key, pt))
+                        break
+                    except Exception:
+                        if attempt == max_retries:
+                            raise
+                        note_retries([(key, i)], attempt + 1)
+                        time.sleep(backoff_s * (2 ** attempt))
+            out[key] = row
+        return out
     from concurrent.futures import ProcessPoolExecutor
 
-    with ProcessPoolExecutor(max_workers=workers) as pool:
-        futures = {
-            (key, i): pool.submit(run_one, key, pt)
-            for key in keys
-            for i, pt in enumerate(points)
-        }
-        return {
-            key: [futures[(key, i)].result() for i in range(len(points))]
-            for key in keys
-        }
+    pending = {(key, i): pt for key in keys for i, pt in enumerate(points)}
+    results: Dict[Tuple[str, int], dict] = {}
+    rnd = 0
+    while True:
+        # a fresh pool per round: a killed worker breaks its whole pool,
+        # so the survivors of a crash cannot be resubmitted to it
+        with ProcessPoolExecutor(max_workers=workers) as pool:
+            futures = {
+                cell: pool.submit(run_one, cell[0], pt)
+                for cell, pt in pending.items()
+            }
+            failed: List[Tuple[str, int]] = []
+            for cell, fut in futures.items():
+                try:
+                    results[cell] = fut.result()
+                except Exception as exc:  # BrokenProcessPool included
+                    failed.append(cell)
+                    last_exc = exc
+        if not failed:
+            break
+        if rnd >= max_retries:
+            raise last_exc
+        rnd += 1
+        note_retries(failed, rnd)
+        time.sleep(backoff_s * (2 ** (rnd - 1)))
+        pending = {cell: pending[cell] for cell in failed}
+    return {
+        key: [results[(key, i)] for i in range(len(points))] for key in keys
+    }
 
 
 def _mtbf_cell(key: str, mtbf: float, cell_kwargs: dict) -> dict:

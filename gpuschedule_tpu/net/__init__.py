@@ -13,8 +13,10 @@ decisions at production scale):
 - :mod:`gpuschedule_tpu.net.model` — ``NetModel``: per-job demands from
   the :mod:`~gpuschedule_tpu.profiler.ici` analytic allreduce model,
   dynamic ``locality_factor`` re-pricing on every running-set change,
-  ``("link", pod)`` fault degradation, and residual-bandwidth scoring
-  for the ``contention`` placement scheme;
+  ``("link", pod)`` fault degradation, residual-bandwidth scoring
+  for the ``contention`` placement scheme, and — with redundant sibling
+  uplinks (``uplinks_per_pod > 1``, ISSUE 8) — proportional-multipath
+  adaptive routing around degraded links (``reroute`` events);
 - :mod:`gpuschedule_tpu.net.sweep` — the contention-vs-offered-load grid
   behind ``tools/net_sweep.py``.
 
@@ -25,7 +27,13 @@ gauges, per-link Perfetto tracks, the analyzer's network panel).  Like
 the sim core, this package is deliberately jax-free.
 """
 
-from gpuschedule_tpu.net.fabric import CORE, FabricTopology, Link, uplink
+from gpuschedule_tpu.net.fabric import (
+    CORE,
+    FabricTopology,
+    Link,
+    sibling_uplink,
+    uplink,
+)
 from gpuschedule_tpu.net.maxmin import Flow, maxmin_allocate
 from gpuschedule_tpu.net.model import (
     JobShare,
@@ -40,6 +48,7 @@ __all__ = [
     "CORE",
     "FabricTopology",
     "Link",
+    "sibling_uplink",
     "uplink",
     "Flow",
     "maxmin_allocate",

@@ -43,7 +43,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from gpuschedule_tpu.models.config import resolve_model_config
-from gpuschedule_tpu.net.fabric import CORE, FabricTopology, uplink
+from gpuschedule_tpu.net.fabric import CORE, FabricTopology
 from gpuschedule_tpu.net.maxmin import Flow, maxmin_allocate
 from gpuschedule_tpu.profiler.ici import (
     cross_pod_allreduce_seconds,
@@ -59,23 +59,30 @@ class NetConfig:
     non-blocking, no contention between disjoint-pod jobs; 4.0 = the
     textbook 4:1 datacenter fabric).  ``ingest_gbps_per_chip`` is the
     inelastic input-pipeline draw per occupied chip (0 disables the
-    ingest term entirely)."""
+    ingest term entirely).  ``uplinks_per_pod`` (ISSUE 8) splits each
+    pod's injection budget across that many redundant sibling uplinks —
+    independent failure domains the model routes flows around when one
+    degrades; 1 (the default) is the historical single-uplink fabric,
+    byte-identical."""
 
     oversubscription: float = 4.0
     ingest_gbps_per_chip: float = 0.05
+    uplinks_per_pod: int = 1
 
 
 _SPEC_KEYS = {
     "os": "oversubscription",
     "oversubscription": "oversubscription",
     "ingest": "ingest_gbps_per_chip",
+    "uplinks": "uplinks_per_pod",
 }
 
 
 def parse_net_spec(spec: str) -> NetConfig:
     """Parse the CLI's ``--net k=v,...`` spec.  Keys: ``os`` /
     ``oversubscription`` (core oversubscription ratio), ``ingest``
-    (Gbps per occupied chip)."""
+    (Gbps per occupied chip), ``uplinks`` (redundant sibling uplinks
+    per pod, 1-8; >1 arms adaptive routing)."""
     config = NetConfig()
     for pair in spec.split(","):
         pair = pair.strip()
@@ -87,7 +94,18 @@ def parse_net_spec(spec: str) -> NetConfig:
             raise ValueError(
                 f"bad --net entry {pair!r}; known keys: {sorted(set(_SPEC_KEYS))}"
             )
-        setattr(config, _SPEC_KEYS[key], float(raw))
+        if key == "uplinks":
+            v = float(raw)
+            if v != int(v):
+                # every other malformed --net value errors loudly; a
+                # fractional sibling count must not silently truncate
+                raise ValueError(
+                    f"--net uplinks must be a whole number of sibling "
+                    f"uplinks, got {raw.strip()}"
+                )
+            config.uplinks_per_pod = int(v)
+        else:
+            setattr(config, _SPEC_KEYS[key], float(raw))
     # range-check here, not deep inside FabricTopology at Simulator
     # construction: a bad spec must be a clean CLI error, not a traceback
     if not config.oversubscription > 0:
@@ -98,17 +116,27 @@ def parse_net_spec(spec: str) -> NetConfig:
         raise ValueError(
             f"--net ingest must be >= 0, got {config.ingest_gbps_per_chip}"
         )
+    if not 1 <= config.uplinks_per_pod <= 8:
+        raise ValueError(
+            f"--net uplinks must be in [1, 8], got {config.uplinks_per_pod}"
+        )
     return config
 
 
 @dataclass(frozen=True)
 class JobShare:
-    """One multislice job's allocation in the latest recompute."""
+    """One multislice job's allocation in the latest recompute.
 
-    gbps: float           # per-uplink injection rate granted (max-min fair)
-    demand_gbps: float    # offered demand (one full uplink)
+    ``route`` (ISSUE 8 adaptive routing) is the flow's weighted uplink
+    set on a redundant-sibling fabric — the engine emits a ``reroute``
+    event when it changes.  Always the empty tuple on a single-uplink
+    fabric."""
+
+    gbps: float           # per-pod injection rate granted (max-min fair)
+    demand_gbps: float    # offered demand (one full pod uplink budget)
     factor: float         # the dynamic locality factor at this share
     pods: Tuple[int, ...]
+    route: Tuple[Tuple[str, float], ...] = ()
 
 
 @dataclass(frozen=True)
@@ -151,9 +179,19 @@ class NetModel:
         self.config = config or NetConfig()
         self.topology: Optional[FabricTopology] = None
         self._cluster = None
-        # active uplink degradations: pod -> list of residual-capacity
-        # fractions (stacked outages multiply; repair pops one instance)
-        self._degraded: Dict[int, List[float]] = {}
+        # active uplink degradations: link NAME -> list of residual-
+        # capacity fractions (stacked outages multiply; repair pops one
+        # instance).  On a redundant-sibling fabric each new outage lands
+        # on the least-degraded sibling, spreading damage deterministically.
+        self._degraded: Dict[str, List[float]] = {}
+        # outage identity -> the sibling it landed on, so repair heals
+        # exactly the right sibling under overlapping equal-severity
+        # outages (the engine keys by fault-record identity)
+        self._degrade_sites: Dict[object, str] = {}
+        # cached per-pod route weights (routing fabrics): a pure function
+        # of link health, invalidated by degrade/repair alongside the
+        # flow cache so healthy-fabric recomputes skip the rebuild
+        self._pod_routes: Optional[List[Tuple[Tuple[str, float], ...]]] = None
         # last recompute's elastic usage per link (residual_gbps reads it)
         self._elastic_used: Dict[str, float] = {}
         self.recomputes = 0
@@ -216,11 +254,15 @@ class NetModel:
             self._state = NetState()
             return
         self.topology = FabricTopology.from_cluster(
-            inner, oversubscription=self.config.oversubscription
+            inner,
+            oversubscription=self.config.oversubscription,
+            uplinks_per_pod=self.config.uplinks_per_pod,
         )
         self._cluster = inner
         self._elastic_used = {}
         self._degraded = {}
+        self._degrade_sites = {}
+        self._pod_routes = None
         self._dirty = True
         self._flows_dirty = True
         self._state = NetState()
@@ -230,9 +272,18 @@ class NetModel:
             name: link.capacity_gbps for name, link in topo.links.items()
         }
         self._sorted_links = tuple(sorted(topo.links))
-        self._uplinks = tuple(uplink(p) for p in range(topo.num_pods))
+        # per-pod sibling uplink names (one historical name each on a
+        # non-redundant fabric); _uplinks keeps the primary sibling for
+        # the single-uplink fast paths
+        self._pod_links = tuple(
+            topo.pod_uplinks(p) for p in range(topo.num_pods)
+        )
+        self._uplinks = tuple(names[0] for names in self._pod_links)
         self._link_pod = {
-            name: (None if name == CORE else int(name.rsplit("pod", 1)[1]))
+            name: (
+                None if name == CORE
+                else int(name.rsplit("pod", 1)[1].split(".", 1)[0])
+            )
             for name in topo.links
         }
         self._t_step = float(getattr(inner, "dcn_step_seconds", 1.0))
@@ -245,36 +296,72 @@ class NetModel:
     # ------------------------------------------------------------------ #
     # link health (the ("link", pod) fault scope, faults/)
 
-    def degrade_link(self, pod: int, residual_frac: float) -> None:
-        """One DCN-uplink outage: pod ``pod``'s uplink drops to
-        ``residual_frac`` of its current capacity (0.0 = hard outage).
-        Outages stack multiplicatively until each is repaired."""
+    @property
+    def routing_enabled(self) -> bool:
+        """True when the fabric has redundant sibling uplinks to route
+        around (ISSUE 8); single-uplink fabrics keep every historical
+        code path."""
+        return self.topology is not None and self.topology.uplinks_per_pod > 1
+
+    def degrade_link(self, pod: int, residual_frac: float, *, key=None) -> None:
+        """One DCN-uplink outage: a sibling of pod ``pod``'s uplink set
+        drops to ``residual_frac`` of its current capacity (0.0 = hard
+        outage).  On a redundant fabric the outage lands on the sibling
+        with the fewest active degradations (lowest index breaks ties),
+        spreading damage deterministically; outages stack
+        multiplicatively until each is repaired.
+
+        ``key`` (the engine passes the fault record's identity) pins the
+        chosen sibling so the matching :meth:`repair_link` heals exactly
+        the sibling THIS outage degraded — overlapping outages of equal
+        severity on different siblings would otherwise be un-pairable
+        from the fraction alone."""
         topo = self._require_attached()
         if not 0 <= pod < topo.num_pods:
             raise ValueError(f"link fault pod {pod} out of range")
-        self._degraded.setdefault(pod, []).append(
-            min(1.0, max(0.0, float(residual_frac)))
+        name = min(
+            self._pod_links[pod],
+            key=lambda n: (len(self._degraded.get(n, ())), n),
         )
-        self._dirty = True
-
-    def repair_link(self, pod: int, residual_frac: float) -> None:
-        """Undo one :meth:`degrade_link` of the same severity."""
-        stack = self._degraded.get(pod)
         frac = min(1.0, max(0.0, float(residual_frac)))
-        if not stack or frac not in stack:
-            raise ValueError(f"repair of healthy link pod{pod}")
-        stack.remove(frac)
-        if not stack:
-            del self._degraded[pod]
+        self._degraded.setdefault(name, []).append(frac)
+        if key is not None:
+            self._degrade_sites[key] = name
         self._dirty = True
+        if topo.uplinks_per_pod > 1:
+            # route weights are part of the cached flow links: a health
+            # change re-routes, so the flow cache must rebuild
+            self._flows_dirty = True
+            self._pod_routes = None
+
+    def repair_link(self, pod: int, residual_frac: float, *, key=None) -> None:
+        """Undo one :meth:`degrade_link` of the same severity — on the
+        sibling its ``key`` recorded, falling back (keyless callers) to
+        the first sibling in index order holding a matching
+        degradation."""
+        topo = self._require_attached()
+        frac = min(1.0, max(0.0, float(residual_frac)))
+        site = self._degrade_sites.pop(key, None) if key is not None else None
+        names = (site,) if site is not None else self._pod_links[pod]
+        for name in names:
+            stack = self._degraded.get(name)
+            if stack and frac in stack:
+                stack.remove(frac)
+                if not stack:
+                    del self._degraded[name]
+                self._dirty = True
+                if topo.uplinks_per_pod > 1:
+                    self._flows_dirty = True
+                    self._pod_routes = None
+                return
+        raise ValueError(f"repair of healthy link pod{pod}")
 
     def _capacity(self, link: str) -> float:
         """Current (post-degrade) capacity of one link."""
         topo = self._require_attached()
         cap = topo.links[link].capacity_gbps
         if link != CORE:
-            pod = self._link_pod[link]
-            for frac in self._degraded.get(pod, ()):
+            for frac in self._degraded.get(link, ()):
                 cap *= frac
         return cap
 
@@ -334,13 +421,18 @@ class NetModel:
         return path
 
     def _ingest_gbps(self, pod: int) -> float:
-        """Inelastic input-pipeline draw on one pod's uplink, clamped to
-        the link's (post-degrade) capacity."""
+        """Inelastic input-pipeline draw on one pod's uplink set, clamped
+        to its total (post-degrade) capacity."""
         rate = self.config.ingest_gbps_per_chip
         if rate <= 0.0 or self._cluster is None:
             return 0.0
         used = self._cluster.pod_used_chips(pod)
-        return min(used * rate, self._capacity(self._uplinks[pod]))
+        names = self._pod_links[pod]
+        if len(names) == 1:
+            cap = self._capacity(names[0])
+        else:
+            cap = sum(self._capacity(n) for n in names)
+        return min(used * rate, cap)
 
     # ------------------------------------------------------------------ #
     # the dirty set (ISSUE 7 tentpole): what invalidates the cached state
@@ -404,6 +496,51 @@ class NetModel:
         self._integrate(now)
         self.recomputes += 1
 
+        # effective (post-degrade) capacities, one map per pass: the
+        # degradation stack is almost always empty, so start from the
+        # attach-time base capacities and only touch degraded uplinks
+        # (same multiplication order as _capacity — identical floats).
+        # Built before the flow set because adaptive routing derives its
+        # per-pod route weights from them.
+        link_pod = self._link_pod
+        caps = dict(self._base_caps)
+        for name, stack in self._degraded.items():
+            cap = caps[name]
+            for frac in stack:
+                cap *= frac
+            caps[name] = cap
+
+        routing = topo.uplinks_per_pod > 1
+        pod_routes = self._pod_routes
+        if routing and pod_routes is None:
+            # Adaptive route choice (ISSUE 8): each pod's injection
+            # spreads across its sibling uplinks IN PROPORTION TO their
+            # surviving capacity, so every loaded sibling saturates at
+            # the same flow rate and the pod's effective uplink budget is
+            # exactly the sum of surviving sibling capacities — a
+            # degraded sibling sheds load onto the healthy ones (jobs
+            # slow by the lost fraction instead of stalling), a dead one
+            # leaves the route entirely.  All siblings dead falls back to
+            # an even spread over zero-capacity links: the flow stalls.
+            # Routes are a pure function of link health: cached until the
+            # next degrade/repair invalidates them.
+            pod_routes = []
+            for names in self._pod_links:
+                total = 0.0
+                caps_p = []
+                for n in names:
+                    c = caps[n]
+                    caps_p.append((n, c))
+                    total += c
+                if total > 0.0:
+                    pod_routes.append(tuple(
+                        (n, c / total) for n, c in caps_p if c > 0.0
+                    ))
+                else:
+                    w = 1.0 / len(names)
+                    pod_routes.append(tuple((n, w) for n in names))
+            self._pod_routes = pod_routes
+
         demand = self._demand_gbps()
         reused = reuse_flows and not self._flows_dirty
         if reused:
@@ -418,45 +555,63 @@ class NetModel:
                 pods = self._multislice_pods(job)
                 if pods is None:
                     continue
-                flows.append(Flow(job.job_id, self._path(pods), demand))
+                if routing:
+                    links = tuple(
+                        item for p in pods for item in pod_routes[p]
+                    ) + ((CORE, float(len(pods))),)
+                else:
+                    links = self._path(pods)
+                flows.append(Flow(job.job_id, links, demand))
                 meta[job.job_id] = pods
                 job_by_id[job.job_id] = job
             if reuse_flows:
                 # only the engine's marked path caches the rebuild — a
                 # direct caller's ad-hoc running list must never leak
-                # into a later engine reuse
+                # into a later engine reuse.  (Route weights are part of
+                # the links, which is why degrade/repair invalidate the
+                # flow cache on a redundant fabric.)
                 self._flows, self._flow_meta, self._flow_jobs = (
                     flows, meta, job_by_id
                 )
                 self._flows_dirty = False
 
-        # effective (post-degrade) capacities, one map per pass: the
-        # degradation stack is almost always empty, so start from the
-        # attach-time base capacities and only touch degraded uplinks
-        # (same multiplication order as _capacity — identical floats)
-        link_pod = self._link_pod
-        caps = dict(self._base_caps)
-        for pod, stack in self._degraded.items():
-            cap = caps[self._uplinks[pod]]
-            for frac in stack:
-                cap *= frac
-            caps[self._uplinks[pod]] = cap
-
         rate = self.config.ingest_gbps_per_chip
+        ingest_link: Dict[str, float] = {}
         if rate > 0.0:
             cluster = self._cluster
-            ingest = {
-                p: min(cluster.pod_used_chips(p) * rate, caps[up])
-                for p, up in enumerate(self._uplinks)
-            }
-            ingest_total = sum(ingest.values())
-            capacity: Dict[str, float] = {}
-            for name in topo.links:
-                cap = caps[name]
-                if name == CORE:
-                    capacity[name] = max(0.0, cap - ingest_total)
-                else:
-                    capacity[name] = max(0.0, cap - ingest[link_pod[name]])
+            if routing:
+                # ingest follows the same proportional spread as the
+                # elastic routes, clamped to the pod's surviving total
+                ingest = {}
+                for p, names in enumerate(self._pod_links):
+                    pod_cap = sum(caps[n] for n in names)
+                    amt = min(cluster.pod_used_chips(p) * rate, pod_cap)
+                    ingest[p] = amt
+                    for n, w in pod_routes[p]:
+                        ingest_link[n] = amt * w
+                ingest_total = sum(ingest.values())
+                capacity: Dict[str, float] = {}
+                for name in topo.links:
+                    cap = caps[name]
+                    if name == CORE:
+                        capacity[name] = max(0.0, cap - ingest_total)
+                    else:
+                        capacity[name] = max(
+                            0.0, cap - ingest_link.get(name, 0.0)
+                        )
+            else:
+                ingest = {
+                    p: min(cluster.pod_used_chips(p) * rate, caps[up])
+                    for p, up in enumerate(self._uplinks)
+                }
+                ingest_total = sum(ingest.values())
+                capacity = {}
+                for name in topo.links:
+                    cap = caps[name]
+                    if name == CORE:
+                        capacity[name] = max(0.0, cap - ingest_total)
+                    else:
+                        capacity[name] = max(0.0, cap - ingest[link_pod[name]])
         else:
             ingest = dict.fromkeys(range(topo.num_pods), 0.0)
             ingest_total = 0.0
@@ -476,12 +631,17 @@ class NetModel:
             for link, w in flow.links:
                 elastic[link] += w * r
             share = prev_shares.get(key)
-            if share is None or share.gbps != r or share.pods != meta[key]:
+            route = flow.links[:-1] if routing else ()
+            if share is None or share.gbps != r or share.pods != meta[key] or (
+                routing and share.route != route
+            ):
                 # the factor is a pure function of (job model/tp, pod
                 # set, share): an unchanged (rate, pods) pair reuses the
                 # previous JobShare outright and skips the allreduce-term
                 # call — same key with different pods (a rebind between
-                # passes) re-derives
+                # passes) re-derives.  A route change alone rebuilds too
+                # (same factor, but the engine must see the new route to
+                # emit its reroute event).
                 pods = meta[key]
                 share = JobShare(
                     gbps=r,
@@ -490,6 +650,7 @@ class NetModel:
                         job_by_id[key], len(pods), r / hosts_per_pod
                     ),
                     pods=pods,
+                    route=route,
                 )
             state.shares[key] = share
         prev_links = prev.links
@@ -497,6 +658,8 @@ class NetModel:
             cap = caps[name]
             if name == CORE:
                 used = ingest_total + elastic[name]
+            elif routing:
+                used = ingest_link.get(name, 0.0) + elastic[name]
             else:
                 used = ingest[link_pod[name]] + elastic[name]
             sample = prev_links.get(name)
@@ -515,9 +678,17 @@ class NetModel:
         """Unallocated uplink bandwidth on pod ``pod`` right now: the
         (post-degrade) capacity minus live ingest minus the elastic load
         the last recompute granted — the contention placement scheme's
-        scoring signal."""
-        cap = self._capacity(uplink(pod))
-        used = self._ingest_gbps(pod) + self._elastic_used.get(uplink(pod), 0.0)
+        scoring signal.  Summed across siblings on a redundant fabric."""
+        names = self._pod_links[pod]
+        if len(names) == 1:
+            name = names[0]
+            cap = self._capacity(name)
+            used = self._ingest_gbps(pod) + self._elastic_used.get(name, 0.0)
+            return max(0.0, cap - used)
+        cap = sum(self._capacity(n) for n in names)
+        used = self._ingest_gbps(pod) + sum(
+            self._elastic_used.get(n, 0.0) for n in names
+        )
         return max(0.0, cap - used)
 
     # ------------------------------------------------------------------ #

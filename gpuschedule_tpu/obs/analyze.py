@@ -159,6 +159,9 @@ class JobRecord:
     net_updates: int = 0
     bw_gbps_s: float = 0.0
     demand_gbps: Optional[float] = None
+    # adaptive routing (ISSUE 8): how often this flow's weighted uplink
+    # set changed (a degraded sibling shed onto survivors, or healed)
+    reroutes: int = 0
     run_time: float = 0.0         # seconds spent RUNNING
     queue_time: float = 0.0       # seconds QUEUED after submit (incl. requeues)
     suspended_time: float = 0.0   # seconds SUSPENDED (preempted with resume intent)
@@ -271,6 +274,7 @@ class JobRecord:
             "net_updates": self.net_updates,
             "mean_bw_gbps": self.mean_bw_gbps(),
             "demand_gbps": self.demand_gbps,
+            **({"reroutes": self.reroutes} if self.reroutes else {}),
             **({"delay_legs": dict(self.delay_legs)} if self.delay_legs else {}),
         }
 
@@ -342,6 +346,12 @@ class RunAnalysis:
     # ROADMAP PR-3 demand-only-occupancy omission, retired)
     sample_series: List[Tuple[float, int, int, int]] = field(
         default_factory=list)
+    # proactive checkpoint-and-migrate (ISSUE 8): aggregate of the
+    # ``proactive`` payloads riding migrate events — moves taken, the
+    # work a revocation at each move instant would have rolled back
+    # (avoided loss), and the write+restore overhead actually paid.
+    # Empty when the run never migrated proactively.
+    proactive: Dict[str, float] = field(default_factory=dict)
     mean_phys_occupancy: Optional[float] = None
     # memoized derived views (report/compare each read them several times;
     # at Philly scale recomputing means redundant full scans and sorts)
@@ -622,6 +632,9 @@ _LEGAL_FROM = {
     # extends to max_time (the wait closure depends on it)
     "cutoff": (RUNNING, QUEUED, SUSPENDED),
     "net": (RUNNING,),
+    # adaptive routing (ISSUE 8): the flow's weighted uplink set moved
+    # onto different siblings (rate/factor changes ride "net" events)
+    "reroute": (RUNNING,),
     # straggler re-price (faults/, ISSUE 6): the gang's rate changed
     # because a chip under it degraded or recovered
     "slow": (RUNNING,),
@@ -663,6 +676,8 @@ def analyze_events(
     # piecewise-constant integral ([last_t, last_used, area, first_t])
     sample_series: List[Tuple[float, int, int, int]] = []
     samp_acc: Optional[List[float]] = None
+    # proactive checkpoint-and-migrate aggregate (ISSUE 8)
+    proactive: Dict[str, float] = {}
 
     used = running_n = pending_n = 0
     last_t: Optional[float] = None
@@ -974,6 +989,11 @@ def analyze_events(
             a.rec.net_updates += 1
             if ev.get("demand_gbps") is not None:
                 a.rec.demand_gbps = float(ev["demand_gbps"])
+        elif kind == "reroute":
+            # route choice moved (ISSUE 8): no rate or progress change by
+            # itself — share/factor changes arrive as their own "net"
+            # event in the same batch
+            a.rec.reroutes += 1
         elif kind in ("migrate", "resize", "rebind"):
             adopt_snapshot(a, ev, t)
             # close the bandwidth integral at the placement boundary; the
@@ -982,6 +1002,23 @@ def analyze_events(
             settle_bw(a, t)
             if kind == "migrate":
                 a.rec.migrations += 1
+                pro = ev.get("proactive")
+                if pro:
+                    # hazard-driven checkpoint-then-migrate (ISSUE 8):
+                    # aggregate avoided-loss vs paid-overhead for the
+                    # fault panel
+                    proactive["migrations"] = (
+                        proactive.get("migrations", 0) + 1
+                    )
+                    proactive["avoided_s"] = (
+                        proactive.get("avoided_s", 0.0)
+                        + float(pro.get("avoided_s", 0.0))
+                    )
+                    proactive["overhead_s"] = (
+                        proactive.get("overhead_s", 0.0)
+                        + float(pro.get("write_s", 0.0))
+                        + float(pro.get("restore_s", 0.0))
+                    )
             elif kind == "rebind":
                 a.rec.rebinds += 1
             new_chips = int(ev.get("chips", a.chips_alloc))
@@ -1095,6 +1132,7 @@ def analyze_events(
         net_link_means=net_link_means,
         sample_series=sample_series,
         mean_phys_occupancy=mean_phys,
+        proactive=proactive,
     )
     return analysis
 

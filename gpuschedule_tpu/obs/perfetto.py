@@ -228,9 +228,10 @@ def trace_events(events: Iterable[dict]) -> List[dict]:
                         "ts": h0, "dur": max(0.0, t_us - h0),
                         "pid": pid, "tid": tid, "args": args,
                     })
-        elif kind in ("net", "slow", "warn"):
+        elif kind in ("net", "slow", "warn", "reroute"):
             # contention re-price / straggler re-price / spot pre-revoke
-            # notice: instants on the job's occupancy track
+            # notice / adaptive-routing route change: instants on the
+            # job's occupancy track
             iv = open_iv.get(job)
             instant(kind, iv[0] if iv else f"job/{job}", t_us, extra)
         elif kind == "netlink":

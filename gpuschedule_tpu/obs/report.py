@@ -778,7 +778,8 @@ def render_report(analysis: RunAnalysis, *, title: Optional[str] = None) -> str:
 </div>"""
 
     fault_panel = ""
-    if s["faults"] or s["revocations"] or gp["lost_chip_s"] > 0:
+    pro = getattr(analysis, "proactive", None) or {}
+    if s["faults"] or s["revocations"] or gp["lost_chip_s"] > 0 or pro:
         kinds = attribution["kinds"]
         lost_total = sum(k["lost_work_s"] for k in kinds.values())
         lost_warned = sum(k.get("lost_work_warned_s", 0.0)
@@ -799,6 +800,18 @@ def render_report(analysis: RunAnalysis, *, title: Optional[str] = None) -> str:
             f"<p class=\"meta\">correlated domain outages</p>"
             f"{_domain_table(domains)}" if domains else ""
         )
+        proactive_note = ""
+        if pro.get("migrations"):
+            # hazard-driven checkpoint-then-migrate (ISSUE 8): what the
+            # moves insured against vs what they cost — avoided-loss
+            # measurable against lost-work in one line
+            proactive_note = (
+                f"<p class=\"meta\">proactive migration: "
+                f"{int(pro['migrations'])} moves avoided "
+                f"{_esc(_fmt_dur(pro.get('avoided_s', 0.0)))} of exposed "
+                f"work for {_esc(_fmt_dur(pro.get('overhead_s', 0.0)))} "
+                f"checkpoint+restore overhead paid</p>"
+            )
         fault_panel = f"""
 <h2>Faults</h2>
 <div class="panel">
@@ -806,6 +819,7 @@ def render_report(analysis: RunAnalysis, *, title: Optional[str] = None) -> str:
   {s['repairs']} repairs · {_esc(_fmt_dur(lost_total))} work
   lost{warned_note}</p>
   {_stacked_goodput_bar(gp)}
+  {proactive_note}
   {_fault_kind_table(attribution)}
   {domain_table}
 </div>"""

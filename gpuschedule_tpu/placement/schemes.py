@@ -9,7 +9,7 @@ from gpuschedule_tpu.cluster.gpu import SCHEMES as GPU_SCHEMES
 from gpuschedule_tpu.cluster.gpu import GpuCluster
 from gpuschedule_tpu.cluster.tpu import TpuCluster
 
-TPU_SCHEMES = ("consolidated", "random", "spread", "contention")
+TPU_SCHEMES = ("consolidated", "random", "spread", "contention", "health")
 
 Origin = Tuple[int, ...]
 
@@ -28,7 +28,19 @@ class PlacedTpuCluster:
     uplinks already loaded with multislice allreduce or ingest traffic.
     Without a :class:`~gpuschedule_tpu.net.model.NetModel` attached, every
     pod scores equally and the scheme degrades to consolidated's pod-index
-    order — deterministic either way (no RNG involved).
+    order — deterministic either way (no RNG involved).  When a hazard
+    model is bound to the cluster (faults/hazard.py), the residual score
+    is additionally discounted by ``1 + hazard`` per pod, so equal
+    bandwidth goes to the healthier pod (hazard 0 everywhere divides by
+    1.0 exactly — bit-identical orderings).
+
+    The ``health`` scheme (ISSUE 8) is failure-aware for *every* policy,
+    not just Gandiva's post-hoc evacuation: pods are searched in
+    ascending ``cluster.hazard_score(("pod", p))`` order (degraded-chip
+    penalty plus the bound hazard model's age/wear term; pod index
+    breaks ties) and every allocation carries a soft ``avoid_degraded``
+    hint, so a gang never lands on a known-slow chip while a clean box
+    exists anywhere.
     """
 
     def __init__(
@@ -57,16 +69,45 @@ class PlacedTpuCluster:
     def _pod_order(self, pods: List[int]) -> List[int]:
         """Contention scoring: most residual uplink bandwidth first, pod
         index as the deterministic tie-break (ties are the rule when no
-        net model is attached or nothing is running)."""
+        net model is attached or nothing is running).  A bound hazard
+        model (faults/hazard.py — i.e. a hazard knob was armed)
+        additionally discounts each pod's residual by ``1 + hazard``.
+        The discount is gated on the BOUND MODEL, not on the score being
+        nonzero: a pre-hazard config with stragglers (whose degrade
+        penalty alone would make the score nonzero) must keep its PR-7
+        pod orderings byte for byte."""
         if self.net is None:
             return sorted(pods)
-        return sorted(pods, key=lambda p: (-self.net.residual_gbps(p), p))
+        if getattr(self.inner, "_hazard_model", None) is None:
+            return sorted(pods, key=lambda p: (-self.net.residual_gbps(p), p))
+        return sorted(
+            pods,
+            key=lambda p: (
+                -self.net.residual_gbps(p)
+                / (1.0 + self.inner.hazard_score(("pod", p))),
+                p,
+            ),
+        )
+
+    def _health_pod_order(self, pods: List[int]) -> List[int]:
+        """Health scoring (ISSUE 8): lowest hazard first — degraded-chip
+        penalty plus the bound model's age/wear term — pod index as the
+        deterministic tie-break (every pod ties at 0.0 on a healthy,
+        hazard-free fleet, degrading to consolidated's order)."""
+        return sorted(
+            pods, key=lambda p: (self.inner.hazard_score(("pod", p)), p)
+        )
 
     def allocate(self, num_chips: int, *, job=None, hint: Optional[dict] = None):
         if self.scheme == "consolidated":
             merged: dict = {}
         elif self.scheme == "contention":
             merged = {"pod_order": self._pod_order}
+        elif self.scheme == "health":
+            merged = {
+                "pod_order": self._health_pod_order,
+                "avoid_degraded": True,
+            }
         else:
             merged = {"origin_order": self._origin_order}
         if hint:

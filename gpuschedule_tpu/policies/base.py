@@ -86,6 +86,29 @@ class Policy:
         degraded unit are already slowed — Gandiva migrates them off.
         """
 
+    def on_hazard(self, sim, job, exposure: float) -> None:
+        """React to a running gang whose failure exposure crossed the
+        fault plan's ``migrate_threshold`` (faults/hazard.py, ISSUE 8).
+
+        ``exposure`` combines the gang's lost straggler rate
+        (``1 - job.slow_factor``) with its relative hazard heat (how much
+        hotter than the fleet mean its pods run).  The engine offers a
+        priced **checkpoint-then-migrate**: the default accepts —
+        :meth:`Simulator.proactive_migrate` takes a checkpoint (raising
+        the rollback floor to the current watermark), pays the write +
+        restore cost as overhead, and moves the gang to a strictly
+        clean allocation (``avoid_degraded="strict"``; no clean box →
+        no move, no cost).  Override to decline (``pass``) or to react
+        differently; the ``proactive-migrate`` rationale rides the
+        migrate event either way so avoided-loss is measurable against
+        lost-work in the fault panel.
+        """
+        why = (
+            self.explain("proactive-migrate", exposure=round(exposure, 6))
+            if self.explaining(sim) else None
+        )
+        sim.proactive_migrate(job, exposure=exposure, why=why)
+
     def on_warning(self, sim, fault, victims) -> None:
         """React to a spot pre-revoke notice (faults/) at ``sim.now``.
 

@@ -94,6 +94,10 @@ class Simulator:
         self.net = net
         self._net_links: Dict[str, tuple] = {}  # last emitted link sample
         self._net_priced: Dict[str, float] = {}  # job_id -> last emitted bw
+        # adaptive routing (ISSUE 8): job_id -> last priced route (the
+        # flow's weighted uplink set); maintained only when the fabric
+        # has redundant uplinks, so single-uplink runs never touch it
+        self._net_routes: Dict[str, tuple] = {}
         if net is not None:
             net.attach(cluster)
         # Fault injection (faults/): a FaultPlan whose records become
@@ -102,6 +106,20 @@ class Simulator:
         # pre-faults engine; an empty-record plan (mtbf=inf) arms the path
         # without firing it.
         self.faults = faults
+        # Failure hazard (faults/hazard.py, ISSUE 8): when the fault plan
+        # arms any hazard knob, build the runtime model, bind it to the
+        # cluster (placement schemes read cluster.hazard_score) and arm
+        # the proactive checkpoint-and-migrate trigger.  The default
+        # (plan.hazard None) leaves self.hazard None: no wear tracking,
+        # no per-batch observe call, no behavior change.
+        self.hazard = None
+        self._migrate_threshold = math.inf
+        if faults is not None and getattr(faults, "hazard", None) is not None:
+            from gpuschedule_tpu.faults.hazard import HazardModel
+
+            self.hazard = HazardModel(faults.hazard, cluster)
+            cluster.bind_hazard(self.hazard)
+            self._migrate_threshold = faults.hazard.migrate_threshold
         # Stable sort: ties on submit_time keep trace order, and each job gets
         # a numeric arrival sequence so policies can tie-break without relying
         # on string job_id ordering (which misorders 'j2' vs 'j10').
@@ -471,6 +489,7 @@ class Simulator:
         overhead: float,
         placement_hint: Optional[dict] = None,
         why: Optional[dict] = None,
+        event_extra: Optional[dict] = None,
     ) -> bool:
         """Move a running job to a fresh allocation, paying ``overhead``
         seconds of modeled checkpoint/restore cost (SURVEY.md §3.3 migration).
@@ -513,6 +532,8 @@ class Simulator:
                 extra["slow_factor"] = job.slow_factor
             if why is not None:
                 extra["why"] = why
+            if event_extra:
+                extra.update(event_extra)
             self.metrics.event("migrate", self.now, job, **extra)
         return True
 
@@ -585,6 +606,74 @@ class Simulator:
             track=track_label(alloc.detail), prog=_prog(job), **extra,
         )
 
+    def proactive_migrate(
+        self, job: Job, *, exposure: float = 0.0, why: Optional[dict] = None
+    ) -> bool:
+        """Priced checkpoint-then-migrate (ISSUE 8): the action the
+        engine offers ``Policy.on_hazard`` when a running gang's failure
+        exposure crosses the fault plan's ``migrate_threshold``.
+
+        Takes a checkpoint *now* (the write cost plus the restore on the
+        new slice ride the move as overhead — the PR-6 priced-recovery
+        machinery), migrates the gang to a strictly clean allocation
+        (``avoid_degraded="strict"``: no clean box anywhere → no move,
+        NO cost — the gang keeps limping where it is), and raises the
+        rollback floor to the checkpointed watermark so a later fault on
+        the new hardware loses nothing already protected.
+
+        Accounting: ``avoided_s`` is the work a revocation at this
+        instant would have rolled back (the loss this move insures
+        against), ``write_s + restore_s`` the overhead actually paid —
+        both ride the migrate event (``proactive`` payload) and the
+        ``proactive_avoided_work_s`` / ``proactive_overhead_s``
+        counters, so the fault panel can weigh avoided-loss against
+        paid-overhead."""
+        if job.state is not JobState.RUNNING:
+            return False
+        if self.faults is None or self.faults.recovery is None:
+            return False
+        recovery = self.faults.recovery
+        job.advance(self.now)
+        write = recovery.ckpt_write_seconds(job, self.cluster)
+        restore = recovery.restore_overhead(job, self.cluster)
+        avoided = recovery.lost_progress(job)
+        event_extra = None
+        if self.metrics.record_events:
+            event_extra = {"proactive": {
+                "exposure": exposure, "avoided_s": avoided,
+                "write_s": write, "restore_s": restore,
+            }}
+        moved = self.migrate(
+            job, overhead=write + restore,
+            placement_hint={"avoid_degraded": "strict"},
+            why=why, event_extra=event_extra,
+        )
+        if not moved:
+            self.metrics.count("proactive_migrates_blocked")
+            return False
+        # the checkpoint this move just paid for protects everything
+        # executed so far: a fault right after it loses nothing
+        job.ckpt_protected = max(job.ckpt_protected or 0.0, job.executed_work)
+        self.metrics.count("proactive_migrations")
+        self.metrics.count("proactive_avoided_work_s", avoided)
+        self.metrics.count("proactive_overhead_s", write + restore)
+        return True
+
+    def _offer_hazard_migrations(self) -> None:
+        """Offer ``Policy.on_hazard`` every running gang whose exposure
+        crosses the armed ``migrate_threshold``.  Evaluated after each
+        degrade-mask change (straggler onset/recovery) — the events that
+        move exposure; a gang stuck on degraded chips with no clean box
+        is re-offered at the next change and stays put at zero cost."""
+        hazard = self.hazard
+        threshold = self._migrate_threshold
+        for job in list(self.running):
+            exposure = 1.0 - job.slow_factor
+            if hazard is not None and job.allocation is not None:
+                exposure += hazard.gang_exposure(job.allocation)
+            if exposure >= threshold:
+                self.policy.on_hazard(self, job, exposure)
+
     # ------------------------------------------------------------------ #
 
     def _finish(self, job: Job) -> None:
@@ -639,6 +728,14 @@ class Simulator:
             return
         state = self.net.recompute(self.now, self.running, reuse_flows=True)
         record = self.metrics.record_events
+        # adaptive routing (ISSUE 8): with redundant uplinks, a flow's
+        # weighted uplink set is a route choice that shifts when link
+        # health does — emit the change as a ``reroute`` event.  Gated on
+        # the fabric actually having siblings, so single-uplink replays
+        # never touch the dict (byte-identity with PR 7).
+        routing = getattr(self.net, "routing_enabled", False)
+        if routing:
+            routed, self._net_routes = self._net_routes, {}
         priced, self._net_priced = self._net_priced, {}
         for job in self.running:
             share = state.shares.get(job.job_id)
@@ -657,6 +754,19 @@ class Simulator:
                         )
                 continue
             self._net_priced[job.job_id] = share.gbps
+            if routing:
+                route = share.route
+                self._net_routes[job.job_id] = route
+                old = routed.get(job.job_id)
+                if old is not None and old != route:
+                    # the flow moved onto different uplinks (or different
+                    # weights) — a route change, not just a speed change
+                    self.metrics.count("reroutes")
+                    if record:
+                        self.metrics.event(
+                            "reroute", self.now, job,
+                            links=[[name, w] for name, w in route],
+                        )
             if (share.factor == job.locality_factor
                     and priced.get(job.job_id) == share.gbps):
                 continue
@@ -732,6 +842,11 @@ class Simulator:
         for job in victims:
             self._revoke(job, rec)
         self.policy.on_fault(self, rec, victims)
+        if math.isfinite(self._migrate_threshold):
+            # hazard-heat exposure (wear-aged pods) moves with time, not
+            # only with the degrade mask: fault events are the periodic
+            # evaluation points for configs whose stragglers are off
+            self._offer_hazard_migrations()
 
     def _apply_link_fault(self, rec) -> None:
         """A ``("link", pod)`` DCN-uplink outage — the first *partial
@@ -752,7 +867,9 @@ class Simulator:
                 duration=rec.duration if math.isfinite(rec.duration) else "inf",
             )
         if self.net is not None:
-            self.net.degrade_link(int(rec.scope[1]), rec.degrade)
+            # keyed by record identity so the repair heals exactly the
+            # sibling this outage degraded (redundant-uplink fabrics)
+            self.net.degrade_link(int(rec.scope[1]), rec.degrade, key=id(rec))
         else:
             self.metrics.count("link_faults_inert")
         if math.isfinite(rec.duration):
@@ -809,6 +926,13 @@ class Simulator:
                     "slow", self.now, job, slow_factor=factor,
                     prog=_prog(job),
                 )
+        if math.isfinite(self._migrate_threshold):
+            # proactive checkpoint-and-migrate (ISSUE 8): straggler
+            # exposure moves exactly when the degrade mask does; the
+            # hazard-heat term is additionally re-evaluated at fault
+            # events (_apply_fault) — between those, exposure changes
+            # are not observed (docs/faults.md omissions)
+            self._offer_hazard_migrations()
 
     def _apply_warning(self, rec) -> None:
         """A spot pre-revoke notice, ``rec.warning`` seconds ahead of its
@@ -1013,7 +1137,8 @@ class Simulator:
                     # health mask (nothing was marked unhealthy)
                     if self.net is not None:
                         self.net.repair_link(int(payload.scope[1]),
-                                             payload.degrade)
+                                             payload.degrade,
+                                             key=id(payload))
                 elif payload.kind == "straggler":
                     # straggler recovery lives in the degrade mask, not
                     # the health mask; gangs on the healed unit speed
@@ -1115,6 +1240,7 @@ class Simulator:
         heap = self._heap
         max_time = self.max_time
         net = self.net
+        hazard = self.hazard
         cluster = self.cluster
         running, pending = self.running, self.pending
         policy_schedule = self.policy.schedule
@@ -1142,6 +1268,11 @@ class Simulator:
                 # integral and dust its low-order bits
                 self._drain_batch(t)
                 continue
+            if hazard is not None:
+                # integrate wear before the batch mutates occupancy:
+                # between batches occupancy is constant, so the busy
+                # chip-second integral is exact piecewise
+                hazard.observe(t, cluster)
             self._advance_running(t)
             if self._drain_batch(t):
                 wakeup = policy_schedule(self)
@@ -1176,6 +1307,8 @@ class Simulator:
                     # the sampler observes, the replay must not feel it)
                     self._drain_batch(t)
                     continue
+                if self.hazard is not None:
+                    self.hazard.observe(t, self.cluster)
                 with tracer.span("sim.batch", cat="sim", sim_now=t) as sp:
                     self._advance_running(t)
                     dirty = self._drain_batch(t)

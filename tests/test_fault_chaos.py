@@ -1,6 +1,9 @@
-"""Slow-marked wrapper around tools/fault_chaos.py (ISSUE 6 satellite):
-N seeded random fault configs x the eight-policy suite, asserting no
-crash and the exact goodput + delay-by-cause closures on every cell."""
+"""Wrappers around tools/fault_chaos.py (ISSUE 6 satellite, widened by
+ISSUE 8): seeded random fault configs x policies, asserting no crash and
+the exact goodput + delay-by-cause closures on every cell.  The full
+eight-policy sweep stays slow-marked; the mini-chaos (small trace, 2
+seeds, 2 policies) runs in tier-1 so closure regressions in the widened
+knob space — hazard, routing, weighting — surface on every run."""
 
 from __future__ import annotations
 
@@ -12,6 +15,26 @@ import pytest
 sys.path.insert(
     0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
 )
+
+
+def test_fault_chaos_mini_closures_hold():
+    """Fast non-slow mini-chaos (ISSUE 8 satellite): one randomized
+    config per seed on a small trace, two policies — the closure
+    contract over the full knob space, cheap enough for tier-1."""
+    from fault_chaos import run_chaos
+
+    for seed in (0, 1):
+        doc = run_chaos(configs=1, num_jobs=12, seed=seed,
+                        policies=["fifo", "gandiva"], max_time=25_000.0)
+        assert doc["cells"] == 2
+        failures = [
+            f"seed {seed} config {entry['index']} x {cell['policy']}: {msg}"
+            for entry in doc["configs"]
+            for cell in entry["cells"]
+            for msg in cell["failures"]
+        ]
+        assert not failures, "\n".join(failures)
+        assert doc["retried_cells"] == []
 
 
 @pytest.mark.slow

@@ -1,0 +1,546 @@
+"""Hazard-driven fleet reaction tests (faults/hazard.py, ISSUE 8).
+
+Covers the tentpole's compute side end to end: Weibull age-dependent
+fault schedules (time-rescaling arithmetic pinned by hand-replicated RNG
+draws, memoryless branch byte-identical), per-level domain rate
+weighting (single-knob form unchanged), the runtime hazard score
+(degrade-mask penalty + wear-inflated age), health-aware placement for
+every policy (the ``health`` scheme and ``avoid_degraded`` allocator
+masks), and the proactive checkpoint-and-migrate offer with
+hand-computed avoided-loss vs paid-overhead accounting.
+"""
+
+import math
+import random
+
+import pytest
+
+from gpuschedule_tpu.cluster.base import SimpleCluster
+from gpuschedule_tpu.cluster.gpu import GpuCluster
+from gpuschedule_tpu.cluster.tpu import TpuCluster
+from gpuschedule_tpu.faults import (
+    FaultConfig,
+    FaultPlan,
+    FaultRecord,
+    HazardConfig,
+    HazardModel,
+    RecoveryModel,
+    generate_fault_schedule,
+    hazard_config,
+    make_fault_plan,
+    parse_fault_spec,
+)
+from gpuschedule_tpu.placement import with_placement
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.policies.base import Policy
+from gpuschedule_tpu.sim import Job, Simulator
+from gpuschedule_tpu.sim.metrics import MetricsLog
+
+
+def _fleet(pods=2, dims=(4, 4)):
+    return TpuCluster("v5e", dims=dims, num_pods=pods)
+
+
+# --------------------------------------------------------------------- #
+# schedule generation: Weibull age dependence
+
+
+def test_memoryless_mtbf_draw_sequence_pinned():
+    """shape=1 must keep the historical draw sequence to the float: time
+    draw, then scope draws, then repair draw, per record."""
+    c = SimpleCluster(64)
+    cfg = FaultConfig(mtbf=5000.0, repair=600.0)
+    records = generate_fault_schedule(c, cfg, horizon=40_000.0, seed=7)
+
+    rng = random.Random("7:faults:mtbf")
+    rate = 64 / 5000.0
+    expected = []
+    t = rng.expovariate(rate)
+    while t <= 40_000.0:
+        expected.append((t, rng.expovariate(1.0 / 600.0)))
+        t += rng.expovariate(rate)
+    assert [(r.time, r.duration) for r in records] == expected
+    assert all(r.scope == ("chips", 1) for r in records)
+
+
+def test_weibull_schedule_time_rescaling_exact():
+    """shape=k samples the non-homogeneous process by inverting the
+    cumulative hazard: t_i = H * (S_i / (rate*H))^(1/k) with S_i
+    unit-exponential partial sums — hand-replicated draw for draw."""
+    c = SimpleCluster(64)
+    k, horizon, mtbf = 2.0, 40_000.0, 5000.0
+    cfg = FaultConfig(mtbf=mtbf, repair=600.0, hazard_shape=k)
+    records = generate_fault_schedule(c, cfg, horizon=horizon, seed=7)
+
+    rng = random.Random("7:faults:mtbf")
+    rate = 64 / mtbf
+    total = rate * horizon
+    expected = []
+    s = rng.expovariate(1.0)
+    while s < total:
+        t = horizon * (s / total) ** (1.0 / k)
+        expected.append((t, rng.expovariate(1.0 / 600.0)))
+        s += rng.expovariate(1.0)
+    assert [(r.time, r.duration) for r in records] == expected
+    assert records == sorted(records, key=lambda r: r.time)
+
+
+def test_weibull_wearout_clusters_failures_late():
+    """k>1 concentrates failures late, k<1 early, at the same expected
+    count — mean failure time must order accordingly."""
+    c = SimpleCluster(256)
+
+    def mean_t(shape):
+        cfg = FaultConfig(mtbf=2000.0, hazard_shape=shape)
+        rs = generate_fault_schedule(c, cfg, horizon=50_000.0, seed=3)
+        assert rs
+        return sum(r.time for r in rs) / len(rs)
+
+    assert mean_t(0.7) < mean_t(1.0) < mean_t(3.0)
+
+
+# --------------------------------------------------------------------- #
+# per-level domain rate weighting (satellite)
+
+
+def test_domain_weights_pick_only_positive_levels():
+    c = _fleet(dims=(8, 8))  # 64-chip pods: host, rack AND pod tiers
+    cfg = FaultConfig(
+        domain_mtbf=2000.0,
+        domain_weights={"host": 0.0, "rack": 0.0, "pod": 1.0},
+    )
+    records = generate_fault_schedule(c, cfg, horizon=100_000.0, seed=5)
+    assert records
+    assert all(r.kind == "domain" and r.level == "pod" for r in records)
+
+
+def test_domain_weights_shift_level_mix():
+    c = _fleet(dims=(8, 8))
+    base = FaultConfig(domain_mtbf=3000.0)
+    heavy_pod = FaultConfig(
+        domain_mtbf=3000.0,
+        domain_weights={"host": 0.1, "rack": 0.1, "pod": 10.0},
+    )
+
+    def pod_share(cfg):
+        rs = generate_fault_schedule(c, cfg, horizon=200_000.0, seed=5)
+        assert rs
+        return sum(1 for r in rs if r.level == "pod") / len(rs)
+
+    assert pod_share(heavy_pod) > pod_share(base)
+
+
+def test_domain_weights_single_knob_form_unchanged():
+    """weights=None is literally the historical draw path (the uniform
+    randrange pick) — hand-replicated, so the single-knob form stays
+    hash- and byte-pinned."""
+    c = _fleet()
+    cfg = FaultConfig(domain_mtbf=4000.0, domain_repair=1000.0)
+    records = generate_fault_schedule(c, cfg, horizon=60_000.0, seed=9)
+
+    domains = c.failure_domains()
+    rng = random.Random("9:faults:domain")
+    rate = len(domains) / 4000.0
+    expected = []
+    t = rng.expovariate(rate)
+    while t <= 60_000.0:
+        level, scope = domains[rng.randrange(len(domains))]
+        expected.append((t, scope, rng.expovariate(1.0 / 1000.0), level))
+        t += rng.expovariate(rate)
+    assert [(r.time, r.scope, r.duration, r.level) for r in records] == expected
+
+
+def test_domain_weights_validation():
+    c = _fleet(dims=(8, 8))
+    with pytest.raises(ValueError, match="no domains"):
+        generate_fault_schedule(
+            c,
+            FaultConfig(domain_mtbf=1000.0, domain_weights={"switch": 1.0}),
+            horizon=1000.0, seed=0,
+        )
+    with pytest.raises(ValueError, match=">= 0"):
+        generate_fault_schedule(
+            c,
+            FaultConfig(domain_mtbf=1000.0, domain_weights={"pod": -1.0}),
+            horizon=1000.0, seed=0,
+        )
+    # all-zero weights: the process is disarmed, no records
+    assert generate_fault_schedule(
+        c,
+        FaultConfig(
+            domain_mtbf=1000.0,
+            domain_weights={"host": 0.0, "rack": 0.0, "pod": 0.0},
+        ),
+        horizon=1000.0, seed=0,
+    ) == []
+    # naming a level the (4,4) fleet does not tile (rack >= pod) errors
+    with pytest.raises(ValueError, match="no domains"):
+        generate_fault_schedule(
+            _fleet(),
+            FaultConfig(domain_mtbf=1000.0, domain_weights={"rack": 1.0}),
+            horizon=1000.0, seed=0,
+        )
+
+
+def test_parse_spec_hazard_and_weight_keys():
+    cfg, _ = parse_fault_spec(
+        "domain_mtbf=86400,domain_host=2,domain_rack=0.5,domain_pod=0,"
+        "hazard_shape=2.5,hazard_util=5,migrate_threshold=0.4"
+    )
+    assert cfg.domain_weights == {"host": 2.0, "rack": 0.5, "pod": 0.0}
+    assert cfg.hazard_shape == 2.5
+    assert cfg.hazard_util_weight == 5.0
+    assert cfg.migrate_threshold == 0.4
+    # single-knob form leaves weights None (the hash-pinned path)
+    cfg2, _ = parse_fault_spec("domain_mtbf=86400")
+    assert cfg2.domain_weights is None
+    for bad in ("hazard_shape=0", "hazard_shape=-1", "hazard_util=-2",
+                "migrate_threshold=0", "domain_host=-1"):
+        with pytest.raises(ValueError):
+            parse_fault_spec(bad)
+
+
+def test_hazard_config_arms_only_when_any_knob_set():
+    assert hazard_config(FaultConfig()) is None
+    assert hazard_config(FaultConfig(mtbf=1000.0)) is None
+    hc = hazard_config(FaultConfig(mtbf=1000.0, hazard_shape=2.0))
+    assert hc is not None and hc.shape == 2.0 and hc.life == 1000.0
+    assert hazard_config(FaultConfig(migrate_threshold=0.5)) is not None
+    plan = make_fault_plan(_fleet(), FaultConfig(), horizon=0.0)
+    assert plan.hazard is None
+
+
+# --------------------------------------------------------------------- #
+# the runtime hazard score
+
+
+def test_hazard_score_zero_when_nothing_armed():
+    c = _fleet()
+    assert c.hazard_score(("pod", 0)) == 0.0
+    assert c.hazard_score(("chip", 0, (0, 0))) == 0.0
+
+
+def test_hazard_score_degrade_penalty_tpu():
+    c = _fleet()
+    c.mark_degraded(("chip", 0, (1, 1)), 0.5)
+    assert c.hazard_score(("pod", 0)) == pytest.approx(0.5)
+    assert c.hazard_score(("pod", 1)) == 0.0
+    assert c.hazard_score(("chip", 0, (1, 1))) == pytest.approx(0.5)
+    assert c.hazard_score(("chip", 0, (0, 0))) == 0.0
+    c.mark_degraded(("chip", 0, (2, 2)), 0.75)
+    assert c.hazard_score(("pod", 0)) == pytest.approx(0.5 + 0.25)
+
+
+def test_hazard_score_degrade_penalty_gpu():
+    g = GpuCluster(num_switches=2, nodes_per_switch=2, gpus_per_node=4)
+    g.mark_degraded(("node", 0, 1), 0.25)
+    assert g.hazard_score(("node", 0, 1)) == pytest.approx(0.75)
+    assert g.hazard_score(("node", 0, 0)) == 0.0
+    assert g.hazard_score(("switch", 0)) == pytest.approx(0.75)
+    assert g.hazard_score(("switch", 1)) == 0.0
+
+
+def test_hazard_model_wear_raises_busy_pod_score():
+    c = _fleet()
+    model = HazardModel(
+        HazardConfig(shape=2.0, util_weight=4.0, life=50_000.0), c
+    )
+    c.bind_hazard(model)
+    # pod 0 busy, pod 1 idle for 1000 s
+    alloc = c.allocate(16, hint={"pod": 0})
+    assert alloc is not None
+    model.observe(1000.0, c)
+    hot = c.hazard_score(("pod", 0))
+    cold = c.hazard_score(("pod", 1))
+    assert hot > cold > 0.0  # both aged, the busy pod aged more
+    # the gang on the hot pod reads as hotter than fleet mean
+    assert model.gang_exposure(alloc) > 0.0
+
+
+def test_hazard_model_fleet_wear_bucket_on_gpu():
+    """Flavors without pod identity still age with utilization: the
+    fleet-wide wear bucket feeds the rate, so a busy GPU fleet scores
+    hotter than an idle one (uniformly — no per-node wear)."""
+    g = GpuCluster(num_switches=1, nodes_per_switch=2, gpus_per_node=4)
+    busy = HazardModel(
+        HazardConfig(shape=2.0, util_weight=10.0, life=50_000.0), g
+    )
+    idle = HazardModel(
+        HazardConfig(shape=2.0, util_weight=10.0, life=50_000.0), g
+    )
+    alloc = g.allocate(4)
+    busy.observe(1000.0, g)
+    g.free(alloc)
+    idle.observe(1000.0, g)
+    assert busy.score(g, ("node", 0, 0)) > idle.score(g, ("node", 0, 0)) > 0.0
+
+
+def test_hazard_model_memoryless_shape_is_uniform():
+    c = _fleet()
+    model = HazardModel(HazardConfig(shape=1.0, life=10_000.0), c)
+    c.bind_hazard(model)
+    c.allocate(16, hint={"pod": 0})
+    model.observe(500.0, c)
+    # k=1: the rate is 1/life regardless of age or wear
+    assert c.hazard_score(("pod", 0)) == c.hazard_score(("pod", 1))
+    assert c.hazard_score(("pod", 0)) == pytest.approx(
+        16 * 3600.0 / 10_000.0
+    )
+
+
+# --------------------------------------------------------------------- #
+# avoid-mask allocation + the health scheme
+
+
+def test_avoid_mask_soft_prefers_clean_box():
+    c = _fleet(pods=1, dims=(4, 4))
+    c.mark_degraded(("chip", 0, (0, 0)), 0.5)
+    a = c.allocate(4, hint={"avoid_degraded": True})
+    assert a is not None
+    assert (0, 0) not in set(a.detail.chips())
+    # without the hint, first-fit lands on the origin corner
+    c.free(a)
+    b = c.allocate(4)
+    assert (0, 0) in set(b.detail.chips())
+
+
+def test_avoid_mask_soft_falls_back_strict_refuses():
+    c = _fleet(pods=1, dims=(2, 2))
+    for x in range(2):
+        for y in range(2):
+            c.mark_degraded(("chip", 0, (x, y)), 0.5)
+    assert c.allocate(4, hint={"avoid_degraded": "strict"}) is None
+    soft = c.allocate(4, hint={"avoid_degraded": True})
+    assert soft is not None and soft.num_chips == 4
+
+
+def test_avoid_mask_multislice_clean_pods_first():
+    c = _fleet(pods=3)
+    c.mark_degraded(("chip", 0, (0, 0)), 0.5)
+    a = c.allocate(32, hint={"avoid_degraded": True})  # 2 whole pods
+    assert sorted(s.pod for s in a.detail.slices) == [1, 2]
+    c.free(a)
+    # strict with only one clean pod pair impossible -> None
+    c.mark_degraded(("chip", 1, (0, 0)), 0.5)
+    assert c.allocate(32, hint={"avoid_degraded": "strict"}) is None
+    # soft still places, degraded pods last
+    b = c.allocate(32, hint={"avoid_degraded": True})
+    assert b is not None and 2 in {s.pod for s in b.detail.slices}
+
+
+def test_avoid_mask_gpu_nodes():
+    g = GpuCluster(num_switches=1, nodes_per_switch=2, gpus_per_node=4)
+    g.mark_degraded(("node", 0, 0), 0.5)
+    a = g.allocate(4, hint={"avoid_degraded": True})
+    assert [nd for nd, _ in a.detail.nodes] == [(0, 1)]
+    b = g.allocate(4, hint={"avoid_degraded": "strict"})
+    assert b is None  # only the degraded node is left
+    soft = g.allocate(4, hint={"avoid_degraded": True})
+    assert soft is not None  # falls back onto the slow node
+
+
+def test_health_scheme_steers_off_degraded_pod():
+    c = _fleet(pods=2, dims=(4, 4))
+    placed = with_placement(c, "health")
+    c.mark_degraded(("chip", 0, (0, 0)), 0.5)
+    a = placed.allocate(16)  # a full pod's worth
+    assert a.detail.pod == 1
+    # control: consolidated first-fit takes pod 0 regardless
+    c2 = _fleet(pods=2, dims=(4, 4))
+    c2.mark_degraded(("chip", 0, (0, 0)), 0.5)
+    assert c2.allocate(16).detail.pod == 0
+
+
+def test_health_scheme_ties_degrade_to_pod_index_order():
+    c = _fleet(pods=2)
+    placed = with_placement(c, "health")
+    a = placed.allocate(4)
+    assert a.detail.pod == 0  # healthy fleet: consolidated's order
+
+
+def test_contention_scheme_discounts_hazard_only_when_model_bound():
+    """With a hazard model bound (a hazard knob armed), equal residual
+    bandwidth sorts the degraded pod last.  WITHOUT a bound model the
+    discount must not apply at all — a pre-hazard straggler+contention
+    config keeps its PR-7 pod orderings even though the degrade penalty
+    alone would make hazard_score nonzero (the knob-off byte-identity
+    contract)."""
+    from gpuschedule_tpu.placement.schemes import PlacedTpuCluster
+
+    class StubNet:
+        def residual_gbps(self, pod):
+            return 100.0
+
+    c = _fleet(pods=2)
+    placed = PlacedTpuCluster(c, "contention", net=StubNet())
+    c.mark_degraded(("chip", 0, (0, 0)), 0.5)
+    # no model bound: the degraded pod keeps its historical rank
+    assert placed._pod_order([0, 1]) == [0, 1]
+    c.bind_hazard(HazardModel(HazardConfig(migrate_threshold=0.5), c))
+    assert placed._pod_order([0, 1]) == [1, 0]
+    c.clear_degraded(("chip", 0, (0, 0)), 0.5)
+    # model bound but nothing degraded / no finite life: all pods tie at
+    # 0.0 and the order degrades to pod index
+    assert placed._pod_order([0, 1]) == [0, 1]
+
+
+# --------------------------------------------------------------------- #
+# proactive checkpoint-and-migrate
+
+
+def _straggler_plan(*, threshold, when=100.0, degrade=0.5, restore=5.0,
+                    ckpt=30.0, chip=(0, 0), duration=math.inf):
+    return FaultPlan(
+        records=[FaultRecord(
+            when, ("chip", 0, chip), duration, "straggler", degrade=degrade,
+        )],
+        recovery=RecoveryModel(ckpt_interval=ckpt, restore=restore),
+        hazard=HazardConfig(migrate_threshold=threshold),
+    )
+
+
+def test_proactive_migrate_hand_computed():
+    """Straggler onset at t=100 on a gang with threshold 0.4: exposure
+    0.5 triggers the offer, the default accepts, the gang moves to the
+    clean pod paying restore=5 s, avoided loss is the un-checkpointed
+    tail (100 mod 30 = 10), and the rollback floor rises to the full
+    executed work."""
+    c = _fleet(pods=2)
+    job = Job("j", 0.0, num_chips=16, duration=500.0)
+    plan = _straggler_plan(threshold=0.4)
+    res = Simulator(c, make_policy("fifo"), [job], faults=plan).run()
+    assert res.counters["proactive_migrations"] == 1
+    assert res.counters["proactive_avoided_work_s"] == pytest.approx(10.0)
+    assert res.counters["proactive_overhead_s"] == pytest.approx(5.0)
+    assert job.ckpt_protected == pytest.approx(100.0)
+    assert job.allocation is None and job.state.value == "done"
+    # moved to the clean pod and ran at full rate: only the 5 s restore
+    # stretches the runtime
+    assert job.end_time == pytest.approx(505.0)
+    (j,) = res.jobs
+    assert j.migration_count == 1
+
+
+def test_proactive_migrate_below_threshold_stays_put():
+    c = _fleet(pods=2)
+    job = Job("j", 0.0, num_chips=16, duration=500.0)
+    plan = _straggler_plan(threshold=0.6)  # exposure 0.5 < 0.6
+    res = Simulator(c, make_policy("fifo"), [job], faults=plan).run()
+    assert res.counters.get("proactive_migrations", 0) == 0
+    # slowed for the whole remaining run instead
+    assert job.end_time == pytest.approx(100.0 + 400.0 / 0.5)
+
+
+def test_proactive_migrate_blocked_without_clean_box():
+    """Single-pod fleet: strict avoidance finds no clean slice — no
+    move, no cost, the gang keeps limping at the degraded rate."""
+    c = _fleet(pods=1)
+    job = Job("j", 0.0, num_chips=16, duration=500.0)
+    plan = _straggler_plan(threshold=0.4)
+    res = Simulator(c, make_policy("fifo"), [job], faults=plan).run()
+    assert res.counters.get("proactive_migrations", 0) == 0
+    assert res.counters["proactive_migrates_blocked"] >= 1
+    assert job.end_time == pytest.approx(100.0 + 400.0 / 0.5)
+
+
+def test_policy_can_decline_on_hazard():
+    class Decliner(Policy):
+        name = "decliner"
+
+        def schedule(self, sim):
+            for j in list(sim.pending):
+                sim.try_start(j)
+            return None
+
+        def on_hazard(self, sim, job, exposure):
+            pass  # explicitly decline the offered migration
+
+    c = _fleet(pods=2)
+    job = Job("j", 0.0, num_chips=16, duration=500.0)
+    plan = _straggler_plan(threshold=0.4)
+    res = Simulator(c, Decliner(), [job], faults=plan).run()
+    assert res.counters.get("proactive_migrations", 0) == 0
+    assert job.end_time == pytest.approx(100.0 + 400.0 / 0.5)
+
+
+def test_proactive_migrate_event_payload_and_report():
+    """The migrate event carries the proactive payload, the analyzer
+    aggregates it, and the fault panel prints avoided-loss vs
+    paid-overhead (the acceptance surface)."""
+    from gpuschedule_tpu.obs import analyze_events, render_report
+
+    c = _fleet(pods=2)
+    job = Job("j", 0.0, num_chips=16, duration=500.0)
+    plan = _straggler_plan(threshold=0.4)
+    metrics = MetricsLog(record_events=True, run_meta={
+        "run_id": "x", "seed": 0, "policy": "fifo", "config_hash": "h"})
+    Simulator(c, make_policy("fifo"), [job], faults=plan,
+              metrics=metrics).run()
+    events = metrics.events
+    (mig,) = [e for e in events if e.get("event") == "migrate"]
+    assert mig["proactive"]["avoided_s"] == pytest.approx(10.0)
+    assert mig["proactive"]["restore_s"] == pytest.approx(5.0)
+    assert mig["why"]["rule"] == "proactive-migrate"
+    an = analyze_events(events)
+    assert an.proactive["migrations"] == 1
+    assert an.proactive["avoided_s"] == pytest.approx(10.0)
+    assert an.proactive["overhead_s"] == pytest.approx(5.0)
+    html = render_report(an)
+    assert "proactive migration" in html
+    assert "avoided" in html
+
+
+def test_hazard_heat_only_config_triggers_on_fault_events():
+    """No stragglers at all: a gang on a wear-hot pod is still offered
+    the proactive move when a fault event gives the engine an
+    evaluation point (the hazard-heat half of the trigger)."""
+    c = _fleet(pods=2)
+    placed = with_placement(c, "health")
+    job = Job("j", 0.0, num_chips=8, duration=3000.0)
+    plan = FaultPlan(
+        # an mtbf fault on the idle pod at t=1000: revokes nothing, but
+        # the post-fault offer sees the running gang's wear heat
+        records=[FaultRecord(1000.0, ("chip", 1, (3, 3)), math.inf, "mtbf")],
+        recovery=RecoveryModel(ckpt_interval=400.0, restore=5.0),
+        hazard=HazardConfig(
+            shape=2.0, util_weight=10.0, migrate_threshold=0.5,
+            life=100_000.0,
+        ),
+    )
+    res = Simulator(placed, make_policy("fifo"), [job], faults=plan).run()
+    # pod0 wear/chip after 1000 s busy: 8000/16 = 500; fleet mean 250.
+    # Effective ages (1000 + 10*500) vs (1000 + 10*250) -> heat
+    # 6000/3500 ~ 1.714, exposure ~0.714 >= 0.5 (slow_factor is 1.0:
+    # this is the hazard-heat half alone): the gang moves to the cooler
+    # pod
+    assert res.counters.get("proactive_migrations", 0) == 1
+    assert job.end_time == pytest.approx(3005.0)
+
+
+def test_health_placement_reduces_straggler_exposure():
+    """Acceptance: on a seeded straggler replay, health placement's
+    straggler-exposed gang-seconds are strictly below origin (first-fit)
+    placement's."""
+    def run(scheme):
+        c = _fleet(pods=2)
+        cluster = with_placement(c, scheme) if scheme != "consolidated" else c
+        jobs = [
+            Job(f"j{i}", 60.0 * i, num_chips=16, duration=50.0)
+            for i in range(3)
+        ]
+        plan = FaultPlan(
+            records=[FaultRecord(
+                0.0, ("chip", 0, (0, 0)), math.inf, "straggler",
+                degrade=0.5,
+            )],
+            recovery=RecoveryModel(),
+        )
+        metrics = MetricsLog(attribution=True)
+        res = Simulator(cluster, make_policy("fifo"), jobs, faults=plan,
+                        metrics=metrics).run()
+        return res.delay_by_cause.get("straggler", 0.0)
+
+    origin = run("consolidated")
+    health = run("health")
+    assert origin > 0.0
+    assert health == 0.0  # every gang landed on the clean pod

@@ -1,0 +1,441 @@
+"""Adaptive-routing tests (net/ redundant uplinks, ISSUE 8).
+
+Covers the tentpole's network side: redundant-sibling fabric capacities,
+the proportional-multipath route-choice rule with hand-computed max-min
+arithmetic, permanent-outage -> reroute -> repair sequences, stall-only
+fallback when routing is off (single-uplink fabrics keep every
+historical behavior), the PR-7 dirty-set contract on both fabric kinds,
+``reroute`` event emission/analysis, and the acceptance comparison:
+routing-on strictly beats routing-off goodput on a degraded-fabric +
+straggler replay.
+"""
+
+import json
+import math
+
+import pytest
+
+from gpuschedule_tpu.cluster.tpu import DCN_GBPS, TpuCluster
+from gpuschedule_tpu.faults import FaultPlan, FaultRecord, RecoveryModel
+from gpuschedule_tpu.models.config import resolve_model_config
+from gpuschedule_tpu.net import CORE, FabricTopology, NetConfig, NetModel, uplink
+from gpuschedule_tpu.net.fabric import sibling_uplink
+from gpuschedule_tpu.obs import analyze_events
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.profiler.ici import (
+    cross_pod_allreduce_seconds,
+    dp_gradient_bytes,
+)
+from gpuschedule_tpu.sim import Job, Simulator
+from gpuschedule_tpu.sim.metrics import MetricsLog
+
+
+def _fleet(pods=2, dims=(4, 4)):
+    """v5e (4,4) pods: 16 chips, 2 hosts, 200 Gbps pod uplink budget."""
+    return TpuCluster("v5e", dims=dims, num_pods=pods)
+
+
+def _whale(name, submit, duration, model="transformer-tiny", chips=32):
+    return Job(name, submit, num_chips=chips, duration=duration,
+               model_name=model)
+
+
+def _factor(model, m, per_host_gbps, t_step=1.0):
+    B = dp_gradient_bytes(resolve_model_config(model).param_count)
+    t_dcn = cross_pod_allreduce_seconds(B, m, dcn_gbps=per_host_gbps)
+    return t_step / (t_step + t_dcn)
+
+
+def _net(uplinks=2, os=1.0, ingest=0.0):
+    return NetModel(NetConfig(
+        oversubscription=os, ingest_gbps_per_chip=ingest,
+        uplinks_per_pod=uplinks,
+    ))
+
+
+# --------------------------------------------------------------------- #
+# fabric
+
+
+def test_redundant_sibling_capacities_and_names():
+    topo = FabricTopology(num_pods=2, hosts_per_pod=2, dcn_gbps=DCN_GBPS,
+                          oversubscription=1.0, uplinks_per_pod=2)
+    # the POD budget is unchanged; siblings split it
+    assert topo.uplink_gbps == 2 * DCN_GBPS
+    assert topo.sibling_gbps == DCN_GBPS
+    assert topo.core_gbps == 2 * topo.uplink_gbps
+    assert set(topo.links) == {
+        CORE, "uplink/pod0.0", "uplink/pod0.1",
+        "uplink/pod1.0", "uplink/pod1.1",
+    }
+    assert topo.pod_uplinks(0) == ("uplink/pod0.0", "uplink/pod0.1")
+    assert all(
+        topo.links[n].capacity_gbps == DCN_GBPS
+        for n in topo.pod_uplinks(0)
+    )
+
+
+def test_single_uplink_fabric_keeps_historical_names():
+    topo = FabricTopology(num_pods=2, hosts_per_pod=2, dcn_gbps=DCN_GBPS)
+    assert topo.uplinks_per_pod == 1
+    assert set(topo.links) == {CORE, uplink(0), uplink(1)}
+    assert topo.pod_uplinks(1) == (uplink(1),)
+    assert sibling_uplink(1, 0, 1) == uplink(1)
+    assert topo.path([0, 1]) == (
+        (uplink(0), 1.0), (uplink(1), 1.0), (CORE, 2.0),
+    )
+
+
+def test_redundant_path_spreads_evenly_when_healthy():
+    topo = FabricTopology(num_pods=2, hosts_per_pod=2, dcn_gbps=DCN_GBPS,
+                          uplinks_per_pod=2)
+    assert topo.path([0]) == (
+        ("uplink/pod0.0", 0.5), ("uplink/pod0.1", 0.5), (CORE, 1.0),
+    )
+
+
+def test_uplinks_knob_validation():
+    with pytest.raises(ValueError, match="uplinks_per_pod"):
+        FabricTopology(num_pods=1, hosts_per_pod=1, dcn_gbps=100.0,
+                       uplinks_per_pod=0)
+    with pytest.raises(ValueError, match="uplinks_per_pod"):
+        FabricTopology(num_pods=1, hosts_per_pod=1, dcn_gbps=100.0,
+                       uplinks_per_pod=9)
+    from gpuschedule_tpu.net import parse_net_spec
+    assert parse_net_spec("uplinks=3").uplinks_per_pod == 3
+    with pytest.raises(ValueError, match="uplinks"):
+        parse_net_spec("uplinks=0")
+    with pytest.raises(ValueError, match="whole number"):
+        parse_net_spec("uplinks=2.5")  # must not silently truncate
+
+
+# --------------------------------------------------------------------- #
+# route choice: hand-computed capacity arithmetic
+
+
+def test_healthy_redundant_fabric_reproduces_static_factor():
+    """Splitting the budget across healthy siblings must not change the
+    solo job's share: proportional weights make every sibling saturate
+    at the same flow rate, so the pod budget is intact."""
+    c = _fleet()
+    job = _whale("w", 0.0, 100.0)
+    net = _net(uplinks=2)
+    net.attach(c)
+    job.allocation = c.allocate(32)
+    state = net.recompute(0.0, [job])
+    share = state.shares["w"]
+    assert share.gbps == pytest.approx(2 * DCN_GBPS)
+    static = c._multislice_speed_factor(2, job)
+    assert share.factor == static  # bit-for-bit, like the k=1 fabric
+    assert share.route == (
+        ("uplink/pod0.0", 0.5), ("uplink/pod0.1", 0.5),
+        ("uplink/pod1.0", 0.5), ("uplink/pod1.1", 0.5),
+    )
+
+
+def test_partial_sibling_degrade_proportional_reroute():
+    """One sibling of pod0 degraded to 0.5: caps (50, 100), weights
+    (1/3, 2/3), pod budget 150 — the flow's rate is exactly the sum of
+    surviving capacities and both siblings saturate together."""
+    c = _fleet()
+    job = _whale("w", 0.0, 100.0)
+    net = _net(uplinks=2)
+    net.attach(c)
+    job.allocation = c.allocate(32)
+    net.degrade_link(0, 0.5)
+    state = net.recompute(0.0, [job])
+    share = state.shares["w"]
+    assert share.gbps == pytest.approx(150.0)
+    assert dict(share.route)["uplink/pod0.0"] == pytest.approx(50.0 / 150.0)
+    assert dict(share.route)["uplink/pod0.1"] == pytest.approx(100.0 / 150.0)
+    assert state.links["uplink/pod0.0"].used_gbps == pytest.approx(50.0)
+    assert state.links["uplink/pod0.0"].capacity_gbps == pytest.approx(50.0)
+    assert state.links["uplink/pod0.1"].used_gbps == pytest.approx(100.0)
+    # healthy pod1 still spreads evenly under the lower rate
+    assert state.links["uplink/pod1.0"].used_gbps == pytest.approx(75.0)
+    assert net.residual_gbps(0) == pytest.approx(0.0)
+    assert net.residual_gbps(1) == pytest.approx(50.0)
+
+
+def test_dead_sibling_leaves_route_entirely():
+    c = _fleet()
+    job = _whale("w", 0.0, 100.0)
+    net = _net(uplinks=2)
+    net.attach(c)
+    job.allocation = c.allocate(32)
+    net.degrade_link(0, 0.0)
+    state = net.recompute(0.0, [job])
+    share = state.shares["w"]
+    assert share.gbps == pytest.approx(100.0)  # the surviving sibling
+    names = [n for n, _ in share.route]
+    assert "uplink/pod0.0" not in names
+    assert dict(share.route)["uplink/pod0.1"] == pytest.approx(1.0)
+    assert state.links["uplink/pod0.0"].used_gbps == 0.0
+
+
+def test_all_siblings_dead_stalls_flow():
+    c = _fleet()
+    job = _whale("w", 0.0, 100.0)
+    net = _net(uplinks=2)
+    net.attach(c)
+    job.allocation = c.allocate(32)
+    net.degrade_link(0, 0.0)
+    net.degrade_link(0, 0.0)  # second outage lands on the other sibling
+    state = net.recompute(0.0, [job])
+    assert state.shares["w"].gbps == 0.0
+    assert state.shares["w"].factor == 0.0
+
+
+def test_keyed_repair_heals_exactly_its_outages_sibling():
+    """Overlapping outages of EQUAL severity on different siblings: the
+    fraction alone cannot pair a repair with its outage — the engine
+    keys by fault-record identity, so fault B's repair must heal the
+    sibling B degraded, not the first fraction-match in index order."""
+    c = _fleet()
+    net = _net(uplinks=2)
+    net.attach(c)
+    net.degrade_link(0, 0.5, key="A")    # least-degraded: sibling .0
+    net.degrade_link(0, 0.5, key="B")    # then sibling .1
+    net.degrade_link(0, 0.2, key="C")    # tie on count: sibling .0
+    assert net._capacity("uplink/pod0.0") == pytest.approx(100.0 * 0.5 * 0.2)
+    assert net._capacity("uplink/pod0.1") == pytest.approx(50.0)
+    net.repair_link(0, 0.5, key="B")     # B landed on .1 — heal .1
+    assert net._capacity("uplink/pod0.0") == pytest.approx(10.0)
+    assert net._capacity("uplink/pod0.1") == pytest.approx(100.0)
+    net.repair_link(0, 0.5, key="A")
+    net.repair_link(0, 0.2, key="C")
+    assert net._capacity("uplink/pod0.0") == pytest.approx(100.0)
+
+
+def test_degrade_spreads_and_repair_heals_matching_sibling():
+    c = _fleet()
+    net = _net(uplinks=2)
+    net.attach(c)
+    net.degrade_link(0, 0.5)
+    net.degrade_link(0, 0.25)  # least-degraded sibling takes the new one
+    assert net._capacity("uplink/pod0.0") == pytest.approx(50.0)
+    assert net._capacity("uplink/pod0.1") == pytest.approx(25.0)
+    net.repair_link(0, 0.5)
+    assert net._capacity("uplink/pod0.0") == pytest.approx(100.0)
+    net.repair_link(0, 0.25)
+    assert net._capacity("uplink/pod0.1") == pytest.approx(100.0)
+    with pytest.raises(ValueError, match="healthy"):
+        net.repair_link(0, 0.25)
+
+
+# --------------------------------------------------------------------- #
+# engine: outage -> reroute -> repair sequences
+
+
+def test_outage_reroute_repair_hand_computed_end_time():
+    """A hard outage on one sibling halves pod0's budget for 20 s: the
+    job slows to the half-uplink factor instead of stalling, then
+    resumes — the end time is exact piecewise arithmetic."""
+    c = _fleet()
+    job = _whale("w", 0.0, 100.0)
+    plan = FaultPlan(records=[
+        FaultRecord(10.0, ("link", 0), 20.0, "link", degrade=0.0)])
+    res = Simulator(c, make_policy("fifo"), [job], faults=plan,
+                    net=_net(uplinks=2)).run()
+    (j,) = res.jobs
+    f = c._multislice_speed_factor(
+        2, Job("p", 0.0, 32, 1.0, model_name="transformer-tiny"))
+    # surviving sibling: 100 Gbps pod budget -> 50 Gbps per host
+    f_deg = _factor("transformer-tiny", 2, DCN_GBPS / 2.0)
+    assert f_deg > 0.0
+    expected = 30.0 + (100.0 - 10.0 * f - 20.0 * f_deg) / f
+    assert j.end_time == pytest.approx(expected, rel=1e-9)
+    assert j.fault_count == 0 and j.lost_work == 0.0
+    assert res.counters["reroutes"] == 2  # shed at t=10, restored at t=30
+
+
+def test_stall_only_fallback_when_every_sibling_dead():
+    """Two overlapping hard outages kill both siblings: the flow stalls
+    for the overlap exactly like the single-uplink fabric."""
+    c = _fleet()
+    job = _whale("w", 0.0, 100.0)
+    plan = FaultPlan(records=[
+        FaultRecord(10.0, ("link", 0), 20.0, "link", degrade=0.0),
+        FaultRecord(10.0, ("link", 0), 20.0, "link", degrade=0.0),
+    ])
+    res = Simulator(c, make_policy("fifo"), [job], faults=plan,
+                    net=_net(uplinks=2)).run()
+    (j,) = res.jobs
+    f = c._multislice_speed_factor(
+        2, Job("p", 0.0, 32, 1.0, model_name="transformer-tiny"))
+    assert j.end_time == pytest.approx(30.0 + (100.0 - 10.0 * f) / f,
+                                       rel=1e-9)
+    assert j.fault_count == 0
+
+
+def test_routing_off_stalls_at_hard_outage():
+    """Single-uplink fabric (routing off): the same outage stalls the
+    job at factor 0 — the historical behavior, pinned."""
+    c = _fleet()
+    job = _whale("w", 0.0, 100.0)
+    plan = FaultPlan(records=[
+        FaultRecord(10.0, ("link", 0), 20.0, "link", degrade=0.0)])
+    res = Simulator(c, make_policy("fifo"), [job], faults=plan,
+                    net=_net(uplinks=1)).run()
+    (j,) = res.jobs
+    f = c._multislice_speed_factor(
+        2, Job("p", 0.0, 32, 1.0, model_name="transformer-tiny"))
+    assert j.end_time == pytest.approx(30.0 + (100.0 - 10.0 * f) / f,
+                                       rel=1e-9)
+    assert res.counters.get("reroutes", 0) == 0
+
+
+def test_reroute_events_emitted_and_analyzed():
+    c = _fleet()
+    job = _whale("w", 0.0, 100.0)
+    plan = FaultPlan(records=[
+        FaultRecord(10.0, ("link", 0), 20.0, "link", degrade=0.0)])
+    metrics = MetricsLog(record_events=True, run_meta={
+        "run_id": "x", "seed": 0, "policy": "fifo", "config_hash": "h"})
+    Simulator(c, make_policy("fifo"), [job], faults=plan,
+              metrics=metrics, net=_net(uplinks=2)).run()
+    events = metrics.events
+    reroutes = [e for e in events if e.get("event") == "reroute"]
+    assert [e["t"] for e in reroutes] == [10.0, 30.0]
+    shed = dict(tuple(pair) for pair in reroutes[0]["links"])
+    assert shed["uplink/pod0.1"] == pytest.approx(1.0)
+    assert "uplink/pod0.0" not in shed
+    restored = dict(tuple(pair) for pair in reroutes[1]["links"])
+    assert restored["uplink/pod0.0"] == pytest.approx(0.5)
+    an = analyze_events(events)
+    assert an.jobs[0].reroutes == 2
+    assert an.goodput() is not None  # closures still derive
+
+
+def test_explicit_uplinks_1_replay_byte_identical(tmp_path):
+    """NetConfig(uplinks_per_pod=1) spelled explicitly is byte-identical
+    to the default config: same events stream, same jobs."""
+    def run(tag, config):
+        out = tmp_path / tag
+        out.mkdir()
+        c = _fleet()
+        jobs = [_whale("w", 0.0, 100.0), _whale("v", 5.0, 80.0)]
+        plan = FaultPlan(records=[
+            FaultRecord(10.0, ("link", 0), 20.0, "link", degrade=0.5)])
+        metrics = MetricsLog(
+            record_events=True,
+            events_sink=out / "events.jsonl",
+            run_meta={"run_id": "x", "seed": 0, "policy": "fifo",
+                      "config_hash": "h"},
+        )
+        with metrics:
+            Simulator(c, make_policy("fifo"), jobs, faults=plan,
+                      metrics=metrics, net=NetModel(config)).run()
+        metrics.write(out)
+        return ((out / "events.jsonl").read_bytes(),
+                (out / "jobs.csv").read_bytes())
+
+    a = run("default", NetConfig(oversubscription=1.0,
+                                 ingest_gbps_per_chip=0.0))
+    b = run("explicit", NetConfig(oversubscription=1.0,
+                                  ingest_gbps_per_chip=0.0,
+                                  uplinks_per_pod=1))
+    assert a == b
+
+
+# --------------------------------------------------------------------- #
+# PR-7 dirty-set contract on both fabric kinds
+
+
+def test_dirty_tiers_preserved_on_single_uplink_fabric():
+    c = _fleet()
+    job = _whale("w", 0.0, 100.0)
+    net = _net(uplinks=1)
+    net.attach(c)
+    job.allocation = c.allocate(32)
+    net.mark_dirty(job)
+    net.recompute(0.0, [job], reuse_flows=True)
+    assert not net._flows_dirty
+    # k=1: a link-health change re-prices but the flow SET is unchanged
+    net.degrade_link(0, 0.5)
+    assert net._dirty and not net._flows_dirty
+
+
+def test_dirty_tiers_invalidate_flows_on_redundant_fabric():
+    c = _fleet()
+    job = _whale("w", 0.0, 100.0)
+    net = _net(uplinks=2)
+    net.attach(c)
+    job.allocation = c.allocate(32)
+    net.mark_dirty(job)
+    net.recompute(0.0, [job], reuse_flows=True)
+    assert not net._flows_dirty
+    # k>1: route weights live in the cached flow links — must rebuild
+    net.degrade_link(0, 0.5)
+    assert net._dirty and net._flows_dirty
+    state = net.recompute(1.0, [job], reuse_flows=True)
+    assert state.shares["w"].gbps == pytest.approx(150.0)
+    net.repair_link(0, 0.5)
+    assert net._flows_dirty
+    state = net.recompute(2.0, [job], reuse_flows=True)
+    assert state.shares["w"].gbps == pytest.approx(200.0)
+
+
+def test_incremental_reuse_equals_fresh_model_under_routing():
+    """Engine-path reuse (reuse_flows=True across degrade/repair) must
+    equal a fresh full recompute at every step."""
+    c = _fleet()
+    job = _whale("w", 0.0, 100.0)
+    inc = _net(uplinks=2)
+    inc.attach(c)
+    job.allocation = c.allocate(32)
+
+    def fresh_state(degrades):
+        m = _net(uplinks=2)
+        m.attach(c)
+        for pod, frac in degrades:
+            m.degrade_link(pod, frac)
+        return m.recompute(0.0, [job])
+
+    inc.mark_dirty(job)
+    s0 = inc.recompute(0.0, [job], reuse_flows=True)
+    assert s0.shares == fresh_state([]).shares
+    inc.degrade_link(0, 0.25)
+    s1 = inc.recompute(0.0, [job], reuse_flows=True)
+    assert s1.shares == fresh_state([(0, 0.25)]).shares
+    assert s1.links == fresh_state([(0, 0.25)]).links
+    inc.repair_link(0, 0.25)
+    s2 = inc.recompute(0.0, [job], reuse_flows=True)
+    assert s2.shares == fresh_state([]).shares
+
+
+# --------------------------------------------------------------------- #
+# acceptance: routing-on strictly beats routing-off
+
+
+def test_routing_on_beats_routing_off_goodput():
+    """Seeded degraded-fabric + straggler replay at a fixed horizon:
+    with redundant uplinks the fleet keeps producing through the outage
+    window (jobs slow, not stall), so useful chip-seconds strictly
+    exceed the single-uplink run's."""
+    def run(uplinks):
+        c = _fleet()
+        jobs = [_whale("w", 0.0, 400.0), _whale("v", 0.0, 300.0, chips=8)]
+        plan = FaultPlan(
+            records=[
+                FaultRecord(10.0, ("link", 0), 200.0, "link", degrade=0.0),
+                FaultRecord(50.0, ("chip", 1, (3, 3)), 100.0, "straggler",
+                            degrade=0.8),
+            ],
+            recovery=RecoveryModel(),
+        )
+        return Simulator(
+            c, make_policy("fifo"), jobs, faults=plan,
+            net=_net(uplinks=uplinks), max_time=250.0,
+        ).run()
+
+    off = run(1)
+    on = run(2)
+    # executed work is the discriminating goodput signal: a stalled gang
+    # still HOLDS its chips (identical useful_chip_s service), it just
+    # produces nothing with them
+    work_on = sum(j.executed_work for j in on.jobs)
+    work_off = sum(j.executed_work for j in off.jobs)
+    assert work_on > work_off
+    assert on.counters["reroutes"] >= 1
+    assert off.counters.get("reroutes", 0) == 0

@@ -2,22 +2,99 @@
 (policy x grid-point) cells across a process pool; every cell is an
 isolated seeded replay, and results reassemble in deterministic grid
 order — so the parallel artifact must be BYTE-IDENTICAL to the serial
-one.  The slow-marked tests pin exactly that."""
+one.  The slow-marked tests pin exactly that.
+
+ISSUE 8 adds crash resilience: a crashed/killed worker cell retries up
+to twice with exponential backoff in a fresh pool before the grid
+fails, preserving deterministic grid-order reassembly — pinned here
+with deliberately crashing cells."""
 
 from __future__ import annotations
 
 import json
 import math
+import os
+from pathlib import Path
 
 import pytest
 
-from gpuschedule_tpu.faults.sweep import jsonable
+from gpuschedule_tpu.faults.sweep import grid_cells, jsonable
 from gpuschedule_tpu.faults.sweep import sweep as fault_sweep
 from gpuschedule_tpu.net.sweep import sweep as net_sweep
 
 
 def _doc(grid) -> str:
     return json.dumps(jsonable(grid), indent=2, sort_keys=True)
+
+
+# module-level cell thunks: picklable for the process pool -------------- #
+
+_CRASH_DIR: str = ""
+
+
+def _flaky_cell(key: str, point):
+    """Hard-kills its worker process the first time each cell runs (a
+    marker file records the attempt), succeeds on the retry — the
+    OOM-killed-worker simulation."""
+    marker = Path(_CRASH_DIR) / f"{key}-{point}.attempted"
+    if not marker.exists():
+        marker.write_text("1")
+        os._exit(1)  # hard kill: BrokenProcessPool, not an exception
+    return {"key": key, "point": point}
+
+
+def _always_crashes(key: str, point):
+    os._exit(1)
+
+
+def test_grid_cells_serial_retries_then_succeeds():
+    attempts: dict = {}
+
+    def run_one(key, pt):
+        attempts[(key, pt)] = attempts.get((key, pt), 0) + 1
+        if attempts[(key, pt)] < 2:
+            raise RuntimeError("transient")
+        return {"key": key, "pt": pt}
+
+    log: list = []
+    out = grid_cells(["a", "b"], [0, 1], run_one, workers=1,
+                     backoff_s=0.0, retry_log=log)
+    assert out == {"a": [{"key": "a", "pt": 0}, {"key": "a", "pt": 1}],
+                   "b": [{"key": "b", "pt": 0}, {"key": "b", "pt": 1}]}
+    assert {tuple(r["cell"]) for r in log} == {
+        ("a", 0), ("a", 1), ("b", 0), ("b", 1)}
+    assert all(r["round"] == 1 for r in log)
+
+
+def test_grid_cells_serial_exhausted_retries_raise():
+    def run_one(key, pt):
+        raise RuntimeError("permanent")
+
+    log: list = []
+    with pytest.raises(RuntimeError, match="permanent"):
+        grid_cells(["a"], [0], run_one, workers=1, backoff_s=0.0,
+                   retry_log=log)
+    assert len(log) == 2  # both retry rounds were attempted
+
+
+def test_grid_cells_parallel_survives_killed_worker(tmp_path):
+    """A worker hard-killed mid-cell (os._exit: the pool breaks, no
+    Python exception crosses back) is retried in a fresh pool and the
+    grid still reassembles in deterministic order."""
+    global _CRASH_DIR
+    _CRASH_DIR = str(tmp_path)
+    log: list = []
+    out = grid_cells(["a"], [0, 1], _flaky_cell, workers=2,
+                     backoff_s=0.0, retry_log=log)
+    assert out == {"a": [{"key": "a", "point": 0},
+                         {"key": "a", "point": 1}]}
+    assert log  # at least one cell was retried
+    assert all(r["round"] >= 1 for r in log)
+
+
+def test_grid_cells_parallel_permanent_crash_fails_grid(tmp_path):
+    with pytest.raises(Exception):
+        grid_cells(["a"], [0], _always_crashes, workers=2, backoff_s=0.0)
 
 
 def test_workers_with_shared_events_path_refused(tmp_path):

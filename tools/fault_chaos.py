@@ -39,11 +39,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from gpuschedule_tpu.cluster.tpu import TpuCluster  # noqa: E402
 from gpuschedule_tpu.faults.recovery import FaultPlan, RecoveryModel  # noqa: E402
+from gpuschedule_tpu.faults.hazard import hazard_config  # noqa: E402
 from gpuschedule_tpu.faults.schedule import (  # noqa: E402
     FaultConfig,
     fault_horizon,
     generate_fault_schedule,
 )
+from gpuschedule_tpu.net.model import NetConfig, NetModel  # noqa: E402
 from gpuschedule_tpu.faults.sweep import POLICY_CONFIGS, jsonable  # noqa: E402
 from gpuschedule_tpu.obs.analyze import analyze_file  # noqa: E402
 from gpuschedule_tpu.policies import make_policy  # noqa: E402
@@ -58,7 +60,11 @@ def _loguniform(rng: random.Random, lo: float, hi: float) -> float:
 
 def draw_config(rng: random.Random):
     """One random point in the full fault knob space: every process can
-    be on or off, repairs can be permanent, degradations can be total."""
+    be on or off, repairs can be permanent, degradations can be total.
+    ISSUE 8 widened the space with hazard knobs (Weibull shape, wear
+    weighting, proactive-migrate threshold), per-level domain rate
+    weights, link faults, and an optional redundant-uplink fabric
+    (adaptive routing) — the closures must hold across all of it."""
     config = FaultConfig(
         mtbf=(math.inf if rng.random() < 0.25
               else _loguniform(rng, 3e3, 1e5)),
@@ -77,21 +83,49 @@ def draw_config(rng: random.Random):
                      else _loguniform(rng, 2e4, 3e5)),
         domain_repair=(math.inf if rng.random() < 0.05
                        else rng.uniform(600.0, 7200.0)),
+        domain_weights=(None if rng.random() < 0.5 else {
+            "host": rng.uniform(0.0, 4.0),
+            "rack": rng.uniform(0.0, 2.0),
+            "pod": rng.uniform(0.0, 1.0),
+        }),
+        hazard_shape=(1.0 if rng.random() < 0.5
+                      else rng.uniform(0.6, 3.0)),
+        hazard_util_weight=(0.0 if rng.random() < 0.5
+                            else _loguniform(rng, 0.1, 10.0)),
+        migrate_threshold=(math.inf if rng.random() < 0.5
+                           else rng.uniform(0.2, 0.8)),
         straggler_mtbf=(math.inf if rng.random() < 0.4
                         else _loguniform(rng, 1e4, 2e5)),
         straggler_repair=rng.uniform(600.0, 7200.0),
         straggler_degrade=rng.uniform(0.0, 1.0),
+        link_mtbf=(math.inf if rng.random() < 0.5
+                   else _loguniform(rng, 1e4, 2e5)),
+        link_repair=(math.inf if rng.random() < 0.05
+                     else rng.uniform(600.0, 7200.0)),
+        link_degrade=rng.uniform(0.0, 1.0),
     )
     recovery = RecoveryModel(
         ckpt_interval=rng.uniform(300.0, 3600.0),
         restore=rng.choice(["auto", rng.uniform(10.0, 120.0)]),
         ckpt_write=rng.choice([0.0, "auto", rng.uniform(5.0, 120.0)]),
     )
-    return config, recovery
+    # half the cells run a shared fabric too — with or without redundant
+    # siblings, so link faults exercise stall, partial-degrade, AND
+    # reroute behavior under the same closure assertions
+    if rng.random() < 0.5:
+        net = NetConfig(
+            oversubscription=rng.choice([1.0, 2.0, 4.0]),
+            ingest_gbps_per_chip=rng.choice([0.0, 0.05]),
+            uplinks_per_pod=rng.choice([1, 2, 3]),
+        )
+    else:
+        net = None
+    return config, recovery, net
 
 
 def run_cell(policy_key: str, config, recovery, *, num_jobs: int,
-             seed: int, max_time: float, events_path: Path) -> dict:
+             seed: int, max_time: float, events_path: Path,
+             net_config=None) -> dict:
     """One chaos cell: replay, capture, analyze, assert both closures."""
     name, kwargs = POLICY_CONFIGS[policy_key]
     cluster = TpuCluster("v5e", dims=(8, 8), num_pods=2)
@@ -102,16 +136,19 @@ def run_cell(policy_key: str, config, recovery, *, num_jobs: int,
             cluster, config, horizon=horizon, seed=seed,
         ),
         recovery=recovery,
+        hazard=hazard_config(config),
     )
     metrics = MetricsLog(
         events_sink=events_path, attribution=True,
         run_meta={"run_id": f"chaos-{policy_key}", "seed": seed,
                   "policy": policy_key, "config_hash": "chaos"},
     )
+    net = NetModel(net_config) if net_config is not None else None
     with metrics:
         res = Simulator(
             cluster, make_policy(name, **kwargs), jobs,
             metrics=metrics, faults=plan, max_time=max_time,
+            net=net,
         ).run()
     analysis = analyze_file(events_path)
     failures = []
@@ -132,6 +169,10 @@ def run_cell(policy_key: str, config, recovery, *, num_jobs: int,
             res.counters.get("straggler_reprices", 0)
         ),
         "spot_warnings": int(res.counters.get("spot_warnings", 0)),
+        "proactive_migrations": int(
+            res.counters.get("proactive_migrations", 0)
+        ),
+        "reroutes": int(res.counters.get("reroutes", 0)),
         "goodput": dict(res.goodput),
         "failures": failures,
     }
@@ -141,11 +182,12 @@ def _chaos_cell(key: str, point, *, tmp: str, num_jobs: int, seed: int,
                 max_time: float) -> dict:
     """Module-level cell thunk (picklable for the process pool): one
     (config index, policy) chaos cell writing/analyzing its own stream."""
-    i, config, recovery = point
+    i, config, recovery, net_config = point
     return run_cell(
         key, config, recovery, num_jobs=num_jobs, seed=seed,
         max_time=max_time,
         events_path=Path(tmp) / f"c{i}-{key}.events.jsonl",
+        net_config=net_config,
     )
 
 
@@ -176,16 +218,21 @@ def run_chaos(*, configs: int, num_jobs: int, seed: int,
     for i in range(configs):
         rng = random.Random(f"{seed}:chaos:{i}")
         drawn.append(draw_config(rng))
-    points = [(i, config, recovery)
-              for i, (config, recovery) in enumerate(drawn)]
+    points = [(i, config, recovery, net_config)
+              for i, (config, recovery, net_config) in enumerate(drawn)]
+    retry_log: list = []
     with tempfile.TemporaryDirectory(prefix="fault_chaos_") as tmp:
         cells = grid_cells(
             keys, points,
             partial(_chaos_cell, tmp=tmp, num_jobs=num_jobs, seed=seed,
                     max_time=max_time),
             workers=workers,
+            retry_log=retry_log,
         )
-    for i, (config, recovery) in enumerate(drawn):
+    # crash-resilience visibility (ISSUE 8 satellite): which cells had a
+    # crashed/killed worker and were re-run (empty on a clean grid)
+    out["retried_cells"] = retry_log
+    for i, (config, recovery, net_config) in enumerate(drawn):
         entry = {
             "index": i,
             "config": dict(config.__dict__),
@@ -194,6 +241,8 @@ def run_chaos(*, configs: int, num_jobs: int, seed: int,
                 "restore": recovery.restore,
                 "ckpt_write": recovery.ckpt_write,
             },
+            "net": (dict(net_config.__dict__)
+                    if net_config is not None else None),
             "cells": [],
         }
         for key in keys:
