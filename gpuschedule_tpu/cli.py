@@ -310,7 +310,8 @@ def cmd_report(args) -> int:
     from gpuschedule_tpu.obs import SchemaError, StreamError, analyze_file, write_report
 
     try:
-        analysis = analyze_file(args.events, require_header=not args.no_header)
+        analysis = analyze_file(args.events, require_header=not args.no_header,
+                                low_memory=args.low_mem)
     except (SchemaError, StreamError) as e:
         raise SystemExit(str(e)) from None
     out = write_report(analysis, args.out, title=args.title)
@@ -367,7 +368,10 @@ def cmd_compare(args) -> int:
         )
         return 2
     try:
-        analyses = [analyze_file(path) for path in args.streams]
+        analyses = [
+            analyze_file(path, low_memory=args.low_mem)
+            for path in args.streams
+        ]
         if len(analyses) == 2:
             result = compare_runs(
                 analyses[0], analyses[1],
@@ -1103,6 +1107,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     rep.add_argument("--no-header", action="store_true",
                      help="admit bare streams captured without run identity "
                           "(Python API without run_meta)")
+    rep.add_argument("--low-mem", action="store_true",
+                     help="bounded-memory analysis: spill finished job "
+                          "records to a sqlite temp store so multi-GB "
+                          "streams render at O(active jobs) resident "
+                          "memory; output is byte-identical")
     rep.set_defaults(fn=cmd_report)
 
     cmpr = sub.add_parser(
@@ -1127,6 +1136,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                            "scheduler)")
     cmpr.add_argument("--json", metavar="PATH",
                       help="write the machine-readable diff here")
+    cmpr.add_argument("--low-mem", action="store_true",
+                      help="bounded-memory analysis of each stream (see "
+                           "report --low-mem); verdicts byte-identical")
     cmpr.set_defaults(fn=cmd_compare)
 
     cmp_ = sub.add_parser("compare-topology",

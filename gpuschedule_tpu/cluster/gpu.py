@@ -187,17 +187,29 @@ class GpuCluster(ClusterBase):
     # ------------------------------------------------------------------ #
     # straggler degrade mask (faults/)
 
-    def mark_degraded(self, scope, factor: float) -> None:
+    def _degrade_victims(self, nd: NodeId) -> List[int]:
+        """Live alloc_ids with any GPU on one node — the only gangs whose
+        ``alloc_slow_factor`` can move when that node's degrade stack
+        does (the engine's ISSUE 9 scoped slow-factor re-derivation)."""
+        return sorted(
+            aid for aid, placement in self._live.items()
+            if any(node == nd for node, _ in placement.nodes)
+        )
+
+    def mark_degraded(self, scope, factor: float) -> List[int]:
         """One host node turns straggler: it keeps serving its GPUs at
         ``factor`` of their rate; gangs on it slow to match (never
-        revoked).  Overlapping degradations stack multiplicatively."""
+        revoked).  Overlapping degradations stack multiplicatively.
+        Returns the live alloc_ids holding GPUs on the node."""
         nd = self._node_scope(scope)
         self._node_degrade.setdefault(nd, []).append(
             min(1.0, max(0.0, float(factor)))
         )
+        return self._degrade_victims(nd)
 
-    def clear_degraded(self, scope, factor: float) -> None:
-        """Undo one :meth:`mark_degraded` of the same severity."""
+    def clear_degraded(self, scope, factor: float) -> List[int]:
+        """Undo one :meth:`mark_degraded` of the same severity.  Returns
+        the live alloc_ids holding GPUs on the healed node."""
         nd = self._node_scope(scope)
         stack = self._node_degrade.get(nd)
         frac = min(1.0, max(0.0, float(factor)))
@@ -206,6 +218,7 @@ class GpuCluster(ClusterBase):
         stack.remove(frac)
         if not stack:
             del self._node_degrade[nd]
+        return self._degrade_victims(nd)
 
     def degraded_chips(self) -> Dict[NodeId, float]:
         """Straggler view for policies: ``(switch, node) -> residual

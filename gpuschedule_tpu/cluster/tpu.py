@@ -226,6 +226,13 @@ class TpuCluster(OverlayMixin, ClusterBase):
             np.zeros(self.dims, dtype=np.int16) for _ in range(self.num_pods)
         ]
         self._unhealthy_cells = 0
+        # free-and-unhealthy cell count, maintained at every health and
+        # occupancy write (ISSUE 9): `unhealthy_chips` used to re-scan
+        # every pod's grids on each read, and with any outage live it is
+        # read on every free_chips (so every allocate, every blame rule)
+        # — O(fleet) per event.  The maintained count makes it O(1); a
+        # brute-scan equivalence is pinned by tests.
+        self._unhealthy_free = 0
         # straggler degrade mask (faults/): (pod, coord) -> stack of
         # residual-rate fractions (overlapping degradations multiply).  A
         # degraded chip stays allocatable — it is slow, not gone — so this
@@ -246,6 +253,36 @@ class TpuCluster(OverlayMixin, ClusterBase):
         self.fragmentation_failures = 0
         self.invalid_size_failures = 0
         self.allocation_attempts = 0
+        # Directionally-versioned failure caches (ISSUE 9): a hint-free
+        # ``allocate``/``can_allocate`` answer is a pure function of the
+        # (occupancy, health) state, and state mutations move feasibility
+        # MONOTONICALLY — a grant or an outage only removes capacity (a
+        # size that failed still fails), a free or a repair only restores
+        # it (a size that fit still fits).  ``_harden`` counts the former,
+        # ``_ease`` the latter; failed sizes cached against ``_ease`` and
+        # positive feasibility against ``_harden`` stay valid across the
+        # other direction's churn, so the blocked FIFO head retried on
+        # every event batch is refused in O(1) instead of re-running the
+        # window search.  Hinted calls (placement schemes, overlays,
+        # avoid masks) never consult the caches.  The degrade mask never
+        # bumps either counter: hint-free searches ignore it entirely.
+        self._ease = 0
+        self._harden = 0
+        self._fail_version = -1
+        self._fail_sizes: Dict[int, str] = {}   # size -> failure kind
+        self._can_true_version = -1
+        self._can_true: set = set()
+        self._can_false_version = -1
+        self._can_false: set = set()
+        # Bitmask row cache (ISSUE 9): each pod's blocked grid (occupancy
+        # | health) packed as one int per torus row, rebuilt lazily after
+        # any write to that pod.  The hint-free slice search runs on these
+        # ints (AND rows, shift-AND for the run, lowest set bit for the
+        # column) — the same lexicographic first-fit origin the numpy
+        # sliding-window scan returns, at a fraction of the cost.
+        self._rows: List[Optional[List[int]]] = [None] * self.num_pods
+        self._row_len = self.dims[-1]
+        self._row_grid = self.dims[:-1]  # outer axes of the row table
 
     # ------------------------------------------------------------------ #
     # ClusterBase surface
@@ -258,15 +295,14 @@ class TpuCluster(OverlayMixin, ClusterBase):
     def unhealthy_chips(self) -> int:
         """Unoccupied chips currently inside an outage (free_chips subtracts
         these; occupied-and-unhealthy only exists transiently inside a fault
-        event, before the engine revokes the victims)."""
+        event, before the engine revokes the victims).  O(1): the count is
+        maintained at every health/occupancy write (ISSUE 9) — it equals
+        ``sum(((h > 0) & (o == 0)).sum())`` over the pods at all times,
+        including mid-fault-event (the maintenance arithmetic masks on
+        occupancy exactly as the old scan did)."""
         if self._unhealthy_cells == 0:
             return 0
-        return int(
-            sum(
-                ((h > 0) & (o == 0)).sum()
-                for h, o in zip(self._health, self._occ)
-            )
-        )
+        return self._unhealthy_free
 
     # ------------------------------------------------------------------ #
     # fault health mask (faults/)
@@ -312,8 +348,15 @@ class TpuCluster(OverlayMixin, ClusterBase):
         victims = self.peek_victims(scope)
         for pod, origin, shape in self._fault_boxes(scope):
             h = self._box(self._health[pod], origin, shape)
-            self._unhealthy_cells += int((h == 0).sum())
+            newly = h == 0
+            self._unhealthy_cells += int(newly.sum())
+            # only free cells join the unhealthy-free count; an occupied
+            # victim cell joins later, when the revocation frees it
+            o = self._box(self._occ[pod], origin, shape)
+            self._unhealthy_free += int((newly & (o == 0)).sum())
             h += 1
+            self._rows[pod] = None
+        self._harden += 1
         return victims
 
     def repair(self, scope) -> None:
@@ -322,7 +365,12 @@ class TpuCluster(OverlayMixin, ClusterBase):
             if (h <= 0).any():
                 raise ValueError(f"repair of healthy chips: {scope!r}")
             h -= 1
-            self._unhealthy_cells -= int((h == 0).sum())
+            healed = h == 0
+            self._unhealthy_cells -= int(healed.sum())
+            o = self._box(self._occ[pod], origin, shape)
+            self._unhealthy_free -= int((healed & (o == 0)).sum())
+            self._rows[pod] = None
+        self._ease += 1
 
     def peek_victims(self, scope) -> List[int]:
         """The alloc_ids :meth:`mark_unhealthy` WOULD return for this
@@ -375,10 +423,26 @@ class TpuCluster(OverlayMixin, ClusterBase):
     # ------------------------------------------------------------------ #
     # straggler degrade mask (faults/)
 
-    def mark_degraded(self, scope, factor: float) -> None:
+    def _degrade_victims(self, pod: int, coord: Tuple[int, ...]) -> List[int]:
+        """Live alloc_ids (bases + overlays riding them) whose geometry
+        covers one chip — the gangs whose ``alloc_slow_factor`` can move
+        when that chip's degrade stack does.  The engine re-derives slow
+        factors for exactly these (ISSUE 9) instead of sweeping the
+        running set."""
+        one = tuple(1 for _ in self.dims)
+        hits = {
+            aid for aid, geom in self._live.items()
+            if self._geom_overlaps(geom, pod, coord, one)
+        }
+        hits |= {o for o, b in self._overlays.items() if b in hits}
+        return sorted(hits)
+
+    def mark_degraded(self, scope, factor: float) -> List[int]:
         """One chip turns straggler: ``("chip", pod, coord)`` drops to
         ``factor`` of its rate.  Overlapping degradations stack
-        multiplicatively; the chip stays allocatable throughout."""
+        multiplicatively; the chip stays allocatable throughout.  Returns
+        the live alloc_ids whose gangs hold the chip (the only gangs
+        whose slow factor can change)."""
         if scope[0] != "chip":
             raise ValueError(
                 f"TpuCluster stragglers take ('chip', pod, coord) scopes, "
@@ -392,9 +456,12 @@ class TpuCluster(OverlayMixin, ClusterBase):
         self._chip_degrade.setdefault((pod, coord), []).append(
             min(1.0, max(0.0, float(factor)))
         )
+        return self._degrade_victims(pod, coord)
 
-    def clear_degraded(self, scope, factor: float) -> None:
-        """Undo one :meth:`mark_degraded` of the same severity."""
+    def clear_degraded(self, scope, factor: float) -> List[int]:
+        """Undo one :meth:`mark_degraded` of the same severity.  Returns
+        the live alloc_ids holding the healed chip (the gangs that may
+        now speed back up)."""
         pod, coord = int(scope[1]), tuple(int(c) for c in scope[2])
         stack = self._chip_degrade.get((pod, coord))
         frac = min(1.0, max(0.0, float(factor)))
@@ -403,6 +470,7 @@ class TpuCluster(OverlayMixin, ClusterBase):
         stack.remove(frac)
         if not stack:
             del self._chip_degrade[(pod, coord)]
+        return self._degrade_victims(pod, coord)
 
     def degraded_chips(self) -> Dict[Tuple[int, Tuple[int, ...]], float]:
         """Straggler view for policies: ``(pod, coord) -> residual rate``
@@ -521,8 +589,31 @@ class TpuCluster(OverlayMixin, ClusterBase):
             return overlay
         if num_chips <= 0:
             return None
+        # hint-free failure cache (ISSUE 9): grants and outages only make
+        # allocation HARDER, so a failed size stays failed until a free
+        # or repair (an _ease bump) restores capacity — refuse in O(1),
+        # re-deriving the counter effect a fresh call would have (the
+        # free-chip precheck is O(1), so 'nofree' vs geometric 'frag' is
+        # still classified exactly).
+        trivial = not hint
+        if trivial:
+            if self._fail_version != self._ease:
+                self._fail_version = self._ease
+                self._fail_sizes.clear()
+            else:
+                kind = self._fail_sizes.get(num_chips)
+                if kind is not None:
+                    if kind == "invalid":
+                        self.invalid_size_failures += 1
+                    elif num_chips <= self.free_chips:
+                        # capacity exists in aggregate, geometry still
+                        # blocks: exactly the fresh call's 'frag' path
+                        self.fragmentation_failures += 1
+                    return None
         if num_chips > self.pod_chips:
-            return self._allocate_multislice(num_chips, job=job, hint=hint)
+            return self._allocate_multislice(
+                num_chips, job=job, hint=hint, record_fail=trivial
+            )
         shapes = valid_slice_shapes(num_chips, self.dims)
         if not shapes:
             # Grant-or-None contract (ClusterBase): a non-pow2 / oversized
@@ -530,6 +621,8 @@ class TpuCluster(OverlayMixin, ClusterBase):
             # expected to map raw GPU counts via round_up() at ingestion,
             # but an unmapped trace must not crash the engine mid-run.
             self.invalid_size_failures += 1
+            if trivial:
+                self._fail_sizes[num_chips] = "invalid"
             return None
         hint = hint or {}
         if "shape" in hint:
@@ -550,6 +643,8 @@ class TpuCluster(OverlayMixin, ClusterBase):
         origin_order = hint.get("origin_order")
 
         if num_chips > self.free_chips:
+            if trivial:
+                self._fail_sizes[num_chips] = "nofree"
             return None
         # Avoid-mask (ISSUE 8): an ``avoid_degraded`` hint first searches
         # with known-slow (straggler) chips masked out, so a gang never
@@ -574,6 +669,14 @@ class TpuCluster(OverlayMixin, ClusterBase):
             for pod in pods:
                 if pod_used is not None and pod_cap - pod_used[pod] < num_chips:
                     continue
+                if not avoiding and origin_order is None:
+                    # bitmask first-fit (ISSUE 9): identical origin, no
+                    # numpy window machinery
+                    for shape in shapes:
+                        origin = self._scan_pod_rows(pod, shape)
+                        if origin is not None:
+                            return self._grant(pod, origin, shape)
+                    continue
                 blocked = (
                     self._blocked_avoiding(pod) if avoiding
                     else self._blocked(pod)
@@ -590,6 +693,8 @@ class TpuCluster(OverlayMixin, ClusterBase):
             # enough chips in aggregate, full search space, still no box:
             # that is geometric fragmentation by definition
             self.fragmentation_failures += 1
+            if trivial:
+                self._fail_sizes[num_chips] = "frag"
         return None
 
     def _empty_pods(self) -> List[int]:
@@ -604,17 +709,24 @@ class TpuCluster(OverlayMixin, ClusterBase):
             and (self._unhealthy_cells == 0 or not self._health[p].any())
         ]
 
-    def _allocate_multislice(self, num_chips: int, *, job=None, hint=None):
+    def _allocate_multislice(
+        self, num_chips: int, *, job=None, hint=None, record_fail=False
+    ):
         """Grant a gang larger than one pod as whole empty pods joined
         over DCN, or None.  Only whole-pod multiples are valid multislice
         sizes (each per-pod slice is the full torus, so every pod keeps
         its wraparound ICI).  A ``pod_order`` hint decides which empty
-        pods the gang claims first."""
+        pods the gang claims first.  ``record_fail`` (hint-free calls
+        only) feeds the ISSUE 9 failure cache."""
         m, rem = divmod(num_chips, self.pod_chips)
         if rem or m > self.num_pods:
             self.invalid_size_failures += 1
+            if record_fail:
+                self._fail_sizes[num_chips] = "invalid"
             return None
         if num_chips > self.free_chips:
+            if record_fail:
+                self._fail_sizes[num_chips] = "nofree"
             return None
         empty = self._empty_pods()
         hint = hint or {}
@@ -638,6 +750,8 @@ class TpuCluster(OverlayMixin, ClusterBase):
             # enough chips in aggregate but not enough whole pods free:
             # cross-pod fragmentation
             self.fragmentation_failures += 1
+            if record_fail:
+                self._fail_sizes[num_chips] = "frag"
             return None
         wrap = tuple(True for _ in self.dims)
         origin = tuple(0 for _ in self.dims)
@@ -648,6 +762,8 @@ class TpuCluster(OverlayMixin, ClusterBase):
         for s in slices:
             self._occ[s.pod][...] = 1
             self._pod_used[s.pod] = self.pod_chips
+            self._rows[s.pod] = None
+        self._harden += 1
         geom = MultiSliceGeometry(
             slices=slices, speed_factor=self._multislice_speed_factor(m, job)
         )
@@ -693,14 +809,27 @@ class TpuCluster(OverlayMixin, ClusterBase):
         geom = self._live.pop(allocation.alloc_id, None)
         if geom is None:
             raise ValueError(f"double free of allocation {allocation.alloc_id}")
+        count_unhealthy = self._unhealthy_cells > 0
         if isinstance(geom, MultiSliceGeometry):
             for s in geom.slices:
+                if count_unhealthy:
+                    # cells revoked mid-outage become free-and-unhealthy
+                    # the moment the victim's box is released
+                    self._unhealthy_free += int(
+                        (self._health[s.pod] > 0).sum()
+                    )
                 self._occ[s.pod][...] = 0
                 self._pod_used[s.pod] = 0
+                self._rows[s.pod] = None
         else:
+            if count_unhealthy:
+                hbox = self._box(self._health[geom.pod], geom.origin, geom.shape)
+                self._unhealthy_free += int((hbox > 0).sum())
             self._box(self._occ[geom.pod], geom.origin, geom.shape)[...] = 0
             self._pod_used[geom.pod] -= geom.num_chips
+            self._rows[geom.pod] = None
         self._used -= geom.num_chips
+        self._ease += 1
 
     def _live_size(self, alloc_id: int) -> Optional[int]:
         geom = self._live.get(alloc_id)
@@ -744,7 +873,27 @@ class TpuCluster(OverlayMixin, ClusterBase):
 
     def can_allocate(self, num_chips: int) -> bool:
         """Exact feasibility: is a free box of some valid shape available
-        now (or, above pod size, enough whole empty pods)?"""
+        now (or, above pod size, enough whole empty pods)?  Memoized
+        directionally (ISSUE 9): a True answer survives frees/repairs (a
+        box that fit still fits) and is dropped on grants/outages; a
+        False answer survives grants/outages and is dropped on frees/
+        repairs.  Pure and side-effect-free, so the memo is invisible;
+        tick-driven policies ask the same sizes on every batch."""
+        if self._can_true_version != self._harden:
+            self._can_true_version = self._harden
+            self._can_true.clear()
+        if self._can_false_version != self._ease:
+            self._can_false_version = self._ease
+            self._can_false.clear()
+        if num_chips in self._can_true:
+            return True
+        if num_chips in self._can_false:
+            return False
+        result = self._can_allocate_uncached(num_chips)
+        (self._can_true if result else self._can_false).add(num_chips)
+        return result
+
+    def _can_allocate_uncached(self, num_chips: int) -> bool:
         if num_chips <= 0 or num_chips > self.free_chips:
             return False
         if num_chips > self.pod_chips:
@@ -754,7 +903,7 @@ class TpuCluster(OverlayMixin, ClusterBase):
             return len(self._empty_pods()) >= m
         shapes = valid_slice_shapes(num_chips, self.dims)
         return any(
-            self._find_free_box(self._blocked(pod), shape, None) is not None
+            self._scan_pod_rows(pod, shape) is not None
             for pod in range(self.num_pods)
             for shape in shapes
         )
@@ -765,6 +914,76 @@ class TpuCluster(OverlayMixin, ClusterBase):
     @staticmethod
     def _box(occ: np.ndarray, origin: Tuple[int, ...], shape: Tuple[int, ...]) -> np.ndarray:
         return occ[tuple(slice(o, o + s) for o, s in zip(origin, shape))]
+
+    def _pod_rows(self, pod: int) -> List[int]:
+        """The pod's blocked grid packed as one int per torus row (bit
+        ``c`` of row ``r`` = cell blocked), rebuilt lazily after any
+        occupancy/health write to the pod (ISSUE 9 bitmask search)."""
+        rows = self._rows[pod]
+        if rows is None:
+            blocked = self._blocked(pod)
+            packed = np.packbits(
+                blocked.astype(bool).reshape(-1, self._row_len),
+                axis=1, bitorder="little",
+            )
+            rows = [
+                int.from_bytes(packed[i].tobytes(), "little")
+                for i in range(packed.shape[0])
+            ]
+            self._rows[pod] = rows
+        return rows
+
+    def _scan_pod_rows(self, pod: int, shape: Tuple[int, ...]) -> Optional[Tuple[int, ...]]:
+        """Bitmask first-fit: the lexicographically smallest free origin
+        for ``shape`` in ``pod``'s blocked grid — exactly the origin
+        :meth:`_find_free_box` returns on the same grid (pinned by
+        tests), found with row ANDs and a shift-AND run search instead
+        of the numpy sliding-window machinery.  Hint-free searches only;
+        custom origin orders and avoid-masks keep the numpy path."""
+        dims = self.dims
+        if any(s > d for s, d in zip(shape, dims)):
+            return None
+        rows = self._pod_rows(pod)
+        w = shape[-1]
+        W = self._row_len
+        colmask = (1 << (W - w + 1)) - 1
+        full = (1 << W) - 1
+        if len(dims) == 2:
+            h = shape[0]
+            for r in range(dims[0] - h + 1):
+                acc = rows[r]
+                for i in range(1, h):
+                    acc |= rows[r + i]
+                x = ~acc & full
+                for _ in range(w - 1):
+                    x &= x >> 1
+                x &= colmask
+                if x:
+                    return (r, (x & -x).bit_length() - 1)
+            return None
+        # generic ND (v5p 3D tori): rows are the C-order flattening of the
+        # outer axes; iterate outer origins lexicographically
+        outer_dims, outer_shape = dims[:-1], shape[:-1]
+        strides = [1] * len(outer_dims)
+        for i in range(len(outer_dims) - 2, -1, -1):
+            strides[i] = strides[i + 1] * outer_dims[i + 1]
+        offs = [
+            sum(o * st for o, st in zip(off, strides))
+            for off in itertools.product(*[range(s) for s in outer_shape])
+        ]
+        ranges = [range(d - s + 1) for d, s in zip(outer_dims, outer_shape)]
+        for origin in itertools.product(*ranges):
+            base = sum(o * st for o, st in zip(origin, strides))
+            acc = 0
+            for off in offs:
+                acc |= rows[base + off]
+            x = ~acc & full
+            for _ in range(w - 1):
+                x &= x >> 1
+            x &= colmask
+            if x:
+                return origin + ((x & -x).bit_length() - 1,)
+        return None
 
     def _find_free_box(self, occ, shape, origin_order) -> Optional[Tuple[int, ...]]:
         """First free origin for an axis-aligned ``shape`` box in ``occ``.
@@ -786,8 +1005,12 @@ class TpuCluster(OverlayMixin, ClusterBase):
         return tuple(int(c) for c in free[0])  # lexicographic first-fit
 
     def _grant(self, pod: int, origin: Tuple[int, ...], shape: Tuple[int, ...]) -> Allocation:
+        # granted boxes never cover unhealthy cells (the search grid masks
+        # them), so _unhealthy_free needs no adjustment here
         self._box(self._occ[pod], origin, shape)[...] = 1
         self._pod_used[pod] += math.prod(shape)
+        self._rows[pod] = None
+        self._harden += 1
         wrap = tuple(s == d for s, d in zip(shape, self.dims))
         geom = SliceGeometry(pod=pod, origin=origin, shape=shape, wrap_axes=wrap)
         alloc = Allocation(next(self._ids), geom.num_chips, detail=geom)

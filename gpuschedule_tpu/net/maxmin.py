@@ -22,7 +22,7 @@ inputs return identical rates regardless of input ordering.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, Iterable, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 # Relative freeze tolerance: a link whose remaining capacity is below
 # _EPS x its original capacity is saturated; a flow within _EPS x demand
@@ -40,42 +40,29 @@ class Flow:
     demand: float
 
 
-def maxmin_allocate(
-    flows: Iterable[Flow],
-    capacity_gbps: Dict[str, float],
-    *,
-    validate: bool = True,
-) -> Dict[str, float]:
-    """Max-min fair rates for ``flows`` under ``capacity_gbps``.
+def _validate_flows(flows, capacity_gbps) -> None:
+    if len({f.key for f in flows}) != len(flows):
+        raise ValueError("duplicate flow keys")
+    for f in flows:
+        for link, w in f.links:
+            if link not in capacity_gbps:
+                raise ValueError(
+                    f"flow {f.key!r} crosses unknown link {link!r}")
+            if w <= 0:
+                raise ValueError(
+                    f"flow {f.key!r} has non-positive weight on {link!r}")
 
-    Every flow's links must exist in ``capacity_gbps``; capacities may be
-    zero (flows crossing a dead link get rate 0).  Returns ``{flow.key:
-    rate}`` for every input flow.
 
-    ``validate=False`` skips the well-formedness sweep (duplicate keys,
-    unknown links, non-positive weights) for callers that construct the
-    flow set themselves and re-solve it repeatedly (the contention
-    model's hot path, ISSUE 7); the arithmetic is identical either way.
-    """
-    flows = sorted(flows, key=lambda f: f.key)
-    if validate:
-        if len({f.key for f in flows}) != len(flows):
-            raise ValueError("duplicate flow keys")
-        for f in flows:
-            for link, w in f.links:
-                if link not in capacity_gbps:
-                    raise ValueError(
-                        f"flow {f.key!r} crosses unknown link {link!r}")
-                if w <= 0:
-                    raise ValueError(
-                        f"flow {f.key!r} has non-positive weight on {link!r}")
-    rate: Dict[str, float] = {f.key: 0.0 for f in flows}
-    headroom = {k: max(0.0, float(v)) for k, v in capacity_gbps.items()}
-    sat_floor = {k: _EPS * (1.0 + headroom[k]) for k in headroom}
-    active: Dict[str, Flow] = {
-        f.key: f for f in flows if f.demand > 0.0 and f.links
-    }
-
+def _progressive_fill(
+    active: Dict[str, Flow],
+    rate: Dict[str, float],
+    headroom: Dict[str, float],
+    sat_floor: Dict[str, float],
+) -> None:
+    """The water-filling loop itself, mutating ``rate``/``headroom`` for
+    ``active`` — shared VERBATIM by the flat solver and each bottleneck
+    group's solve (:func:`maxmin_allocate_grouped`), so a one-group
+    decomposition reproduces the flat arithmetic bit for bit."""
     while active:
         # weight of the active flow set on each loaded link
         wsum: Dict[str, float] = {}
@@ -104,4 +91,178 @@ def maxmin_allocate(
             break
         for k in frozen:
             del active[k]
+
+
+def maxmin_allocate(
+    flows: Iterable[Flow],
+    capacity_gbps: Dict[str, float],
+    *,
+    validate: bool = True,
+) -> Dict[str, float]:
+    """Max-min fair rates for ``flows`` under ``capacity_gbps``.
+
+    Every flow's links must exist in ``capacity_gbps``; capacities may be
+    zero (flows crossing a dead link get rate 0).  Returns ``{flow.key:
+    rate}`` for every input flow.
+
+    ``validate=False`` skips the well-formedness sweep (duplicate keys,
+    unknown links, non-positive weights) for callers that construct the
+    flow set themselves and re-solve it repeatedly (the contention
+    model's hot path, ISSUE 7); the arithmetic is identical either way.
+    """
+    flows = sorted(flows, key=lambda f: f.key)
+    if validate:
+        _validate_flows(flows, capacity_gbps)
+    rate: Dict[str, float] = {f.key: 0.0 for f in flows}
+    headroom = {k: max(0.0, float(v)) for k, v in capacity_gbps.items()}
+    sat_floor = {k: _EPS * (1.0 + headroom[k]) for k in headroom}
+    active: Dict[str, Flow] = {
+        f.key: f for f in flows if f.demand > 0.0 and f.links
+    }
+    _progressive_fill(active, rate, headroom, sat_floor)
+    return rate
+
+
+# --------------------------------------------------------------------- #
+# Bottleneck-group decomposition (ISSUE 9 partial re-solve)
+
+
+@dataclass(frozen=True)
+class GroupSolve:
+    """One bottleneck group's cached solution: the exact inputs (member
+    flows in key order, every loaded link's capacity) and the rates the
+    fill derived from them.  Rates may be reused only when BOTH input
+    tuples compare equal — bitwise-identical inputs into a deterministic
+    pure solve give bitwise-identical outputs, which is the whole
+    byte-identity argument."""
+
+    flows: Tuple[Flow, ...]
+    caps: Tuple[Tuple[str, float], ...]
+    rates: Dict[str, float]
+
+
+class GroupCache:
+    """Across-recompute store of per-group solutions plus the reuse
+    counters (``reused`` is the contention model's ``partial_solves``
+    non-vacuity signal)."""
+
+    def __init__(self) -> None:
+        self.groups: Dict[Tuple[str, ...], GroupSolve] = {}
+        self.reused = 0
+        self.solved = 0
+
+
+def maxmin_allocate_grouped(
+    flows: Iterable[Flow],
+    capacity_gbps: Dict[str, float],
+    *,
+    cache: Optional[GroupCache] = None,
+    validate: bool = True,
+) -> Dict[str, float]:
+    """Max-min fair rates by **bottleneck-group decomposition** — the
+    ISSUE 9 partial re-solve.
+
+    Links that cannot bind — offered load comfortably below capacity, so
+    progressive filling could never saturate them — are *slack*; flows
+    couple only through the **contended** links (load within the
+    saturation tolerance of capacity).  Connected components over shared
+    contended links solve independently: each group runs the verbatim
+    :func:`_progressive_fill` loop over its member flows and every link
+    they load (slack ones included, at full capacity — they never bind,
+    but keeping them preserves the flat loop's shape), and a flow none of
+    whose links are contended takes its full demand outright.
+
+    With a :class:`GroupCache`, a group whose inputs (member flows and
+    all loaded-link capacities) are bitwise unchanged since its last
+    solve reuses the cached rates — the deterministic pure fill would
+    redo identical arithmetic — so a dirty set touching one group
+    re-solves only that group.  ``cache=None`` solves every group fresh:
+    the equivalence comparator, byte-identical by construction.
+
+    The decomposition equals the flat solver exactly in real arithmetic
+    and reproduces it bit-for-bit whenever one group spans every flow;
+    across multiple groups the flat solver's global increment chunking
+    re-associates float sums, so rates may differ in the last ulp — which
+    is why the grouped arithmetic is an opt-in (``NetConfig.partial``)
+    and the flat pass remains the no-flag fallback and oracle."""
+    flows = sorted(flows, key=lambda f: f.key)
+    if validate:
+        _validate_flows(flows, capacity_gbps)
+    rate: Dict[str, float] = {f.key: 0.0 for f in flows}
+    active = [f for f in flows if f.demand > 0.0 and f.links]
+
+    # per-link weighted offered load; a link is contended unless granting
+    # every crossing flow its full demand leaves headroom comfortably
+    # above the saturation floor (2x margin keeps borderline links in the
+    # coupled set, so tolerance-level saturation can never differ between
+    # a group solve and the flat loop)
+    load: Dict[str, float] = {}
+    for f in active:
+        for link, w in f.links:
+            load[link] = load.get(link, 0.0) + w * f.demand
+    contended = set()
+    for link, ld in load.items():
+        cap = max(0.0, float(capacity_gbps[link]))
+        if cap - ld < 2.0 * _EPS * (1.0 + cap):
+            contended.add(link)
+
+    # connected components over shared contended links (union-find)
+    parent = list(range(len(active)))
+
+    def find(i: int) -> int:
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    anchor: Dict[str, int] = {}
+    for i, f in enumerate(active):
+        for link, w in f.links:
+            if link in contended:
+                j = anchor.setdefault(link, i)
+                if j != i:
+                    ri, rj = find(i), find(j)
+                    if ri != rj:
+                        parent[ri] = rj
+
+    comps: Dict[int, List[Flow]] = {}
+    for i, f in enumerate(active):
+        if not any(link in contended for link, _ in f.links):
+            # every link this flow loads can carry the whole offered load:
+            # the fill would raise it straight to its demand
+            rate[f.key] = f.demand
+            continue
+        comps.setdefault(find(i), []).append(f)
+
+    new_groups: Dict[Tuple[str, ...], GroupSolve] = {}
+    for members in comps.values():
+        key = tuple(f.key for f in members)   # members are in key order
+        links = sorted({link for f in members for link, _ in f.links})
+        caps = tuple((link, float(capacity_gbps[link])) for link in links)
+        flows_t = tuple(members)
+        hit = cache.groups.get(key) if cache is not None else None
+        if hit is not None and hit.flows == flows_t and hit.caps == caps:
+            rate.update(hit.rates)
+            solve = hit
+            cache.reused += 1
+        else:
+            grate = {f.key: 0.0 for f in members}
+            headroom = {link: max(0.0, c) for link, c in caps}
+            sat_floor = {
+                link: _EPS * (1.0 + headroom[link]) for link in headroom
+            }
+            _progressive_fill(
+                {f.key: f for f in members}, grate, headroom, sat_floor
+            )
+            rate.update(grate)
+            solve = GroupSolve(flows_t, caps, grate)
+            if cache is not None:
+                cache.solved += 1
+        if cache is not None:
+            new_groups[key] = solve
+    if cache is not None:
+        # only current components stay cached: a group that dissolved
+        # (membership changed) can never be reused under the bitwise
+        # signature anyway
+        cache.groups = new_groups
     return rate

@@ -44,7 +44,12 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 from gpuschedule_tpu.models.config import resolve_model_config
 from gpuschedule_tpu.net.fabric import CORE, FabricTopology
-from gpuschedule_tpu.net.maxmin import Flow, maxmin_allocate
+from gpuschedule_tpu.net.maxmin import (
+    Flow,
+    GroupCache,
+    maxmin_allocate,
+    maxmin_allocate_grouped,
+)
 from gpuschedule_tpu.profiler.ici import (
     cross_pod_allreduce_seconds,
     dp_gradient_bytes,
@@ -63,11 +68,20 @@ class NetConfig:
     pod's injection budget across that many redundant sibling uplinks —
     independent failure domains the model routes flows around when one
     degrades; 1 (the default) is the historical single-uplink fabric,
-    byte-identical."""
+    byte-identical.  ``partial`` (ISSUE 9) arms the bottleneck-group
+    max-min solve: flows decompose into connected components over
+    contended links, each group solves independently, and a dirty set
+    touching only some groups re-solves only those against cached group
+    solutions (``net/maxmin.py:maxmin_allocate_grouped``).  Off (the
+    default) keeps the flat progressive-filling pass — the historical
+    float chunking, byte-identical to PR 7; the grouped arithmetic can
+    differ from it in the last ulp across multiple groups, which is why
+    the knob rides the config hash like every other ``--net`` key."""
 
     oversubscription: float = 4.0
     ingest_gbps_per_chip: float = 0.05
     uplinks_per_pod: int = 1
+    partial: bool = False
 
 
 _SPEC_KEYS = {
@@ -75,6 +89,7 @@ _SPEC_KEYS = {
     "oversubscription": "oversubscription",
     "ingest": "ingest_gbps_per_chip",
     "uplinks": "uplinks_per_pod",
+    "partial": "partial",
 }
 
 
@@ -82,7 +97,8 @@ def parse_net_spec(spec: str) -> NetConfig:
     """Parse the CLI's ``--net k=v,...`` spec.  Keys: ``os`` /
     ``oversubscription`` (core oversubscription ratio), ``ingest``
     (Gbps per occupied chip), ``uplinks`` (redundant sibling uplinks
-    per pod, 1-8; >1 arms adaptive routing)."""
+    per pod, 1-8; >1 arms adaptive routing), ``partial`` (0/1: arm the
+    bottleneck-group partial max-min re-solve)."""
     config = NetConfig()
     for pair in spec.split(","):
         pair = pair.strip()
@@ -104,6 +120,12 @@ def parse_net_spec(spec: str) -> NetConfig:
                     f"uplinks, got {raw.strip()}"
                 )
             config.uplinks_per_pod = int(v)
+        elif key == "partial":
+            if raw.strip() not in ("0", "1"):
+                raise ValueError(
+                    f"--net partial must be 0 or 1, got {raw.strip()}"
+                )
+            config.partial = raw.strip() == "1"
         else:
             setattr(config, _SPEC_KEYS[key], float(raw))
     # range-check here, not deep inside FabricTopology at Simulator
@@ -216,6 +238,14 @@ class NetModel:
         self._flows: List[Flow] = []
         self._flow_meta: Dict[str, Tuple[int, ...]] = {}
         self._flow_jobs: Dict[str, object] = {}
+        # Bottleneck-group partial re-solve (ISSUE 9): when the config
+        # arms it, recompute() solves per connected component over
+        # contended links and reuses cached group solutions whose inputs
+        # are bitwise unchanged.  ``partial_cache`` (test hook) disables
+        # only the reuse — every group solves fresh with the identical
+        # grouped arithmetic, the byte-equivalence comparator.
+        self._group_cache = GroupCache() if self.config.partial else None
+        self.partial_cache = True
         # per-(model, tp) gradient payload cache: the resolved config and
         # payload never change for a given job, so the per-flow model
         # lookup happens once per distinct model instead of per recompute
@@ -248,10 +278,14 @@ class NetModel:
             # same fleet, but drop the pricing cache: a NetModel reused
             # for a second Simulator over the same cluster must start
             # from a full recompute (pre-incremental semantics), not
-            # serve the previous run's final state from poll()
+            # serve the previous run's final state from poll().  The
+            # group cache drops with it — a fresh run must not reuse the
+            # previous run's group solves (same rule, same reason).
             self._dirty = True
             self._flows_dirty = True
             self._state = NetState()
+            if self._group_cache is not None:
+                self._group_cache = GroupCache()
             return
         self.topology = FabricTopology.from_cluster(
             inner,
@@ -618,7 +652,17 @@ class NetModel:
             capacity = {name: max(0.0, cap) for name, cap in caps.items()}
         # a reused flow list was validated when it was built; skip the
         # well-formedness sweep (keys/links/weights), not any arithmetic
-        rates = maxmin_allocate(flows, capacity, validate=not reused)
+        if self._group_cache is not None:
+            # bottleneck-group solve (ISSUE 9): group reuse only through
+            # the cache; partial_cache=False solves every group fresh
+            # with identical arithmetic (the equivalence comparator)
+            rates = maxmin_allocate_grouped(
+                flows, capacity,
+                cache=self._group_cache if self.partial_cache else None,
+                validate=not reused,
+            )
+        else:
+            rates = maxmin_allocate(flows, capacity, validate=not reused)
 
         prev = self._state
         state = NetState()
@@ -673,6 +717,13 @@ class NetModel:
         self._state = state
         self._dirty = False
         return state
+
+    @property
+    def partial_solves(self) -> int:
+        """Group re-solves avoided by the bottleneck-group cache (ISSUE 9
+        non-vacuity signal): 0 whenever ``partial`` is off or nothing was
+        ever reusable."""
+        return self._group_cache.reused if self._group_cache is not None else 0
 
     def residual_gbps(self, pod: int) -> float:
         """Unallocated uplink bandwidth on pod ``pod`` right now: the

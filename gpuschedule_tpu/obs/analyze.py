@@ -38,11 +38,15 @@ same footprint as jobs.csv — but full event payloads are not).
 
 from __future__ import annotations
 
+import gzip
 import hashlib
 import json
 import math
-from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+import os
+import sqlite3
+import tempfile
+from dataclasses import dataclass, field, fields as dataclass_fields
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 from gpuschedule_tpu.obs.metrics import quantile_sorted
 
@@ -279,6 +283,124 @@ class JobRecord:
         }
 
 
+# --------------------------------------------------------------------- #
+# bounded-memory spill store (ISSUE 9 streaming analyzer)
+
+_REC_FIELDS = tuple(f.name for f in dataclass_fields(JobRecord))
+
+
+class JobSpill:
+    """Disk spill for finished :class:`JobRecord` rows — the bounded-
+    memory analyzer's job store (ISSUE 9).
+
+    Finished records leave RAM as soon as their job leaves the active
+    set; a sqlite temp file keeps them keyed by arrival ``order`` (so
+    every aggregate still sums in arrival order — the bit-exact closure
+    arithmetic) alongside the finished-job metric columns the exact-
+    quantile second pass sorts server-side.  Rows round-trip through
+    JSON, which reproduces every float bit-for-bit, and ``delay_legs``
+    key order (the engine's accrual order — it IS the emitted-dict
+    order) survives because JSON objects preserve insertion order."""
+
+    def __init__(self) -> None:
+        self._dir = tempfile.TemporaryDirectory(prefix="gstpu-analyze-")
+        self._db = sqlite3.connect(os.path.join(self._dir.name, "jobs.sqlite"))
+        self._db.execute("PRAGMA journal_mode=OFF")
+        self._db.execute("PRAGMA synchronous=OFF")
+        self._db.execute(
+            "CREATE TABLE jobs ("
+            "ord INTEGER PRIMARY KEY, fin INTEGER, wait REAL, run_time REAL,"
+            "jct REAL, slowdown REAL, preempts REAL, faults REAL, data TEXT)"
+        )
+        self._buf: List[tuple] = []
+        self.count = 0
+
+    def add(self, rec: JobRecord) -> None:
+        state = {name: getattr(rec, name) for name in _REC_FIELDS}
+        fin = rec.finished
+        self._buf.append((
+            rec.order, 1 if fin else 0,
+            rec.wait() if fin else None,
+            rec.run_time if fin else None,
+            rec.jct() if fin else None,
+            rec.slowdown() if fin else None,
+            float(rec.preempts) if fin else None,
+            float(rec.faults) if fin else None,
+            json.dumps(state),
+        ))
+        self.count += 1
+        if len(self._buf) >= 512:
+            self.flush()
+
+    def flush(self) -> None:
+        if self._buf:
+            self._db.executemany(
+                "INSERT INTO jobs VALUES (?,?,?,?,?,?,?,?,?)", self._buf
+            )
+            self._buf.clear()
+
+    @staticmethod
+    def _load(data: str) -> JobRecord:
+        state = json.loads(data)
+        state["delay_legs"] = dict(state.get("delay_legs") or {})
+        return JobRecord(**state)
+
+    def iter_records(self) -> Iterator[JobRecord]:
+        self.flush()
+        cur = self._db.execute("SELECT data FROM jobs ORDER BY ord")
+        for (data,) in cur:
+            yield self._load(data)
+
+    def get(self, order: int) -> JobRecord:
+        self.flush()
+        row = self._db.execute(
+            "SELECT data FROM jobs WHERE ord = ?", (order,)
+        ).fetchone()
+        if row is None:
+            raise IndexError(order)
+        return self._load(row[0])
+
+    def sorted_metric(self, column: str) -> Tuple[int, Iterator[float]]:
+        """(count, ascending iterator) over one finished-job metric —
+        sqlite's external sort keeps the analyzer's resident memory flat
+        however long the stream was."""
+        self.flush()
+        (n,) = self._db.execute(
+            f"SELECT COUNT(*) FROM jobs WHERE fin = 1 AND {column} IS NOT NULL"
+        ).fetchone()
+        cur = self._db.execute(
+            f"SELECT {column} FROM jobs "
+            f"WHERE fin = 1 AND {column} IS NOT NULL ORDER BY {column}"
+        )
+        return n, (v for (v,) in cur)
+
+
+class SpilledJobs(Sequence):
+    """Arrival-order view over a :class:`JobSpill` with the list surface
+    :class:`RunAnalysis` consumers use (iteration, ``len``, indexing).
+    Each pass re-reads the store, so any number of aggregate scans run at
+    constant resident memory."""
+
+    def __init__(self, spill: JobSpill):
+        self._spill = spill
+
+    def __len__(self) -> int:
+        return self._spill.count
+
+    def __iter__(self) -> Iterator[JobRecord]:
+        return self._spill.iter_records()
+
+    def __getitem__(self, index):
+        if isinstance(index, slice):
+            return [self[i] for i in range(*index.indices(len(self)))]
+        n = len(self)
+        if index < 0:
+            index += n
+        if not 0 <= index < n:
+            raise IndexError(index)
+        return self._spill.get(index)
+
+
 @dataclass
 class _Active:
     """Per-job in-flight reconstruction state (the O(active jobs) part)."""
@@ -317,6 +439,45 @@ def _stat_block(values: Sequence[float]) -> dict:
     }
 
 
+def _stat_block_sorted(n: int, ascending: Iterable[float]) -> dict:
+    """:func:`_stat_block` from a pre-sorted value stream of known length
+    (the spill store's server-side ORDER BY): one pass captures the sum
+    (same ascending addition order as the in-memory sort), the max (last
+    value), and the straddling order statistics each quantile needs —
+    then interpolates with :func:`quantile_sorted`'s exact formula, so
+    the result dict is bit-identical to the in-memory one."""
+    if n == 0:
+        return {"n": 0, "mean": None, "max": None,
+                **{name: None for name, _ in _QUANTS}}
+    wanted: Dict[int, float] = {}
+    for _, q in _QUANTS:
+        h = (n - 1) * q
+        i = int(math.floor(h))
+        wanted[i] = 0.0
+        if i + 1 < n:
+            wanted[i + 1] = 0.0
+    total = 0.0
+    last = 0.0
+    for idx, v in enumerate(ascending):
+        v = float(v)
+        total += v
+        if idx in wanted:
+            wanted[idx] = v
+        last = v
+    out = {"n": n, "mean": total / n, "max": last}
+    for name, q in _QUANTS:
+        h = (n - 1) * q
+        i = int(math.floor(h))
+        g = h - i
+        if g == 0.0 or i + 1 >= n:
+            out[name] = float(wanted[i])
+        else:
+            a, b = float(wanted[i]), float(wanted[i + 1])
+            # numpy _lerp anchor switch — quantile_sorted's own formula
+            out[name] = b - (b - a) * (1.0 - g) if g >= 0.5 else a + (b - a) * g
+    return out
+
+
 @dataclass
 class RunAnalysis:
     """Everything :func:`analyze_events` derives from one stream."""
@@ -353,6 +514,11 @@ class RunAnalysis:
     # Empty when the run never migrated proactively.
     proactive: Dict[str, float] = field(default_factory=dict)
     mean_phys_occupancy: Optional[float] = None
+    # bounded-memory mode (ISSUE 9): the spill store behind ``jobs`` when
+    # the stream was analyzed with one — distributions() then sorts each
+    # metric server-side instead of materializing value lists
+    _spill: Optional[JobSpill] = field(
+        default=None, repr=False, compare=False)
     # memoized derived views (report/compare each read them several times;
     # at Philly scale recomputing means redundant full scans and sorts)
     _goodput_cache: Optional[Dict[str, float]] = field(
@@ -385,8 +551,21 @@ class RunAnalysis:
 
     def distributions(self) -> Dict[str, dict]:
         """Wait/run/JCT/slowdown/preempt-count distributions over finished
-        jobs, with exact p50/p95/p99 (numpy-equivalent linear quantiles)."""
+        jobs, with exact p50/p95/p99 (numpy-equivalent linear quantiles).
+        In bounded-memory mode each metric streams from the spill store's
+        server-side sort — identical floats (same ascending multiset, same
+        ordered sum, same interpolation), no value lists in RAM."""
         if self._dist_cache is not None:
+            return self._dist_cache
+        if self._spill is not None:
+            self._dist_cache = {
+                name: _stat_block_sorted(*self._spill.sorted_metric(col))
+                for name, col in (
+                    ("wait", "wait"), ("run", "run_time"), ("jct", "jct"),
+                    ("slowdown", "slowdown"), ("preempt_count", "preempts"),
+                    ("fault_count", "faults"),
+                )
+            }
             return self._dist_cache
         fin = [r for r in self.jobs if r.finished]
         waits = [w for w in (r.wait() for r in fin) if w is not None]
@@ -650,6 +829,7 @@ def analyze_events(
     strict: bool = True,
     drift_tol: float = 1e-5,
     max_util_samples: int = 200_000,
+    spill: Optional[JobSpill] = None,
 ) -> RunAnalysis:
     """Single-pass lifecycle reconstruction of one event stream.
 
@@ -659,9 +839,17 @@ def analyze_events(
     time going backwards, and analyzer-vs-engine progress drift beyond
     ``drift_tol`` (relative) into :class:`StreamError`; non-strict mode
     tallies them in ``counts["anomalies"]`` instead.
+
+    ``spill`` (ISSUE 9 bounded-memory mode, usually via ``analyze_file
+    (low_memory=True)``): finished job records leave RAM for the given
+    :class:`JobSpill` as their jobs finish, and the returned analysis's
+    ``jobs`` is a lazy arrival-order view over the store — every derived
+    number (aggregates, exact quantiles, report tables) is byte-identical
+    to the in-memory analysis, at O(active jobs) resident memory.
     """
     header: Optional[RunHeader] = None
     jobs: List[JobRecord] = []
+    n_jobs = 0
     active: Dict[str, _Active] = {}
     counts: Dict[str, int] = {}
     fault_kinds: Dict[str, dict] = {}
@@ -819,11 +1007,13 @@ def analyze_events(
                 bad(f"bad/duplicate arrival for {ev.get('job')!r}")
                 continue
             rec = JobRecord(
-                job_id=ev["job"], order=len(jobs), submit_t=t,
+                job_id=ev["job"], order=n_jobs, submit_t=t,
                 chips=int(ev.get("chips", 0)),
                 duration=ev.get("duration"), status=ev.get("status"),
             )
-            jobs.append(rec)
+            n_jobs += 1
+            if spill is None:
+                jobs.append(rec)
             active[rec.job_id] = _Active(
                 rec=rec, state=QUEUED, t_state=t, t_prog=t,
                 cause=ev.get("cause"),
@@ -838,10 +1028,15 @@ def analyze_events(
                 bad("reject without a job id")
                 continue
             rec = JobRecord(
-                job_id=ev["job"], order=len(jobs), submit_t=t,
+                job_id=ev["job"], order=n_jobs, submit_t=t,
                 chips=int(ev.get("chips", 0)), end_t=t, end_state="rejected",
             )
-            jobs.append(rec)
+            n_jobs += 1
+            if spill is None:
+                jobs.append(rec)
+            else:
+                # a reject never re-enters the stream: spill it now
+                spill.add(rec)
             continue
         if kind == "fault":
             row = kind_row(str(ev.get("fault", "?")))
@@ -1066,6 +1261,10 @@ def analyze_events(
             used -= a.chips_alloc
             running_n -= 1
             del active[a.rec.job_id]
+            if spill is not None:
+                # the record is final: it leaves resident memory here —
+                # the bounded-memory mode's whole point
+                spill.add(a.rec)
             sample(t)
         elif kind == "cutoff":
             # horizon cutoff: final snapshot for a still-active job; the
@@ -1100,6 +1299,13 @@ def analyze_events(
                 a.rec.delay_legs[a.cause] = (
                     a.rec.delay_legs.get(a.cause, 0.0) + dt
                 )
+    if spill is not None:
+        # unfinished jobs spill after their open wait interval closed,
+        # then the lazy arrival-order view replaces the in-memory list
+        for a in active.values():
+            spill.add(a.rec)
+        spill.flush()
+        jobs = SpilledJobs(spill)
     net_link_means: Dict[str, float] = {}
     for name, (last_t_l, util, area, first_t) in sorted(net_acc.items()):
         area += util * (end_t - last_t_l)  # hold the last value to the end
@@ -1133,20 +1339,30 @@ def analyze_events(
         sample_series=sample_series,
         mean_phys_occupancy=mean_phys,
         proactive=proactive,
+        _spill=spill,
     )
     return analysis
 
 
-def analyze_file(path, **kwargs) -> RunAnalysis:
+def analyze_file(path, *, low_memory: bool = False, **kwargs) -> RunAnalysis:
     """Analyze an events.jsonl file (streaming — constant memory in the
     stream length).  Unreadable files and truncated/corrupt records raise
     :class:`StreamError` — so the CLI's "not comparable" refusal path
     (exit 2) covers them, instead of a raw traceback masquerading as a
-    scheduler regression (exit 1)."""
+    scheduler regression (exit 1).
+
+    Gzip-compressed streams (``*.jsonl.gz``) decompress transparently —
+    multi-GB fleet logs are stored compressed, and ``report``/``compare``
+    read them the same way (ISSUE 9 satellite).  ``low_memory=True``
+    additionally spills finished job records to a sqlite temp store
+    (:class:`JobSpill`) so the whole analysis — aggregates, exact
+    quantiles, report tables — runs at O(active jobs) resident memory
+    with byte-identical output (the ISSUE 9 streaming analyzer)."""
 
     def records():
+        opener = gzip.open if str(path).endswith(".gz") else open
         try:
-            with open(path) as f:
+            with opener(path, "rt") as f:
                 for lineno, line in enumerate(f, 1):
                     line = line.strip()
                     if not line:
@@ -1159,7 +1375,10 @@ def analyze_file(path, **kwargs) -> RunAnalysis:
                             f"record ({e}) — was the writer killed mid-"
                             f"record?"
                         ) from None
-        except OSError as e:
+        except (OSError, EOFError) as e:
+            # gzip corruption raises BadGzipFile (an OSError) or EOFError
             raise StreamError(f"cannot read event stream {path}: {e}") from None
 
+    if low_memory:
+        kwargs["spill"] = JobSpill()
     return analyze_events(records(), **kwargs)

@@ -24,6 +24,7 @@ Per-mark hover carries exact values via native SVG ``<title>`` tooltips.
 
 from __future__ import annotations
 
+import heapq
 import html
 import math
 from pathlib import Path
@@ -599,8 +600,15 @@ def _net_links_table(analysis: RunAnalysis, net: dict) -> str:
 
 
 def _slowest_jobs_table(analysis: RunAnalysis, n: int = 10) -> str:
-    fin = [r for r in analysis.jobs if r.finished and r.jct() is not None]
-    worst = sorted(fin, key=lambda r: r.jct(), reverse=True)[:n]
+    # heapq.nlargest == sorted(..., reverse=True)[:n] (documented, ties
+    # broken identically) without materializing the full finished list —
+    # the bounded-memory analyzer (ISSUE 9) streams jobs from its spill
+    # store, and this table must not pull them all back into RAM
+    worst = heapq.nlargest(
+        n,
+        (r for r in analysis.jobs if r.finished and r.jct() is not None),
+        key=lambda r: r.jct(),
+    )
     if not worst:
         return '<p class="empty">no finished jobs</p>'
     # straggler slowdown column (ISSUE 6): only when the run attributed
@@ -689,9 +697,21 @@ def render_report(analysis: RunAnalysis, *, title: Optional[str] = None) -> str:
     pend_pts = [(t, float(p)) for t, _, _, p in analysis.util_series]
     total_chips = h.total_chips if h else None
 
-    fin = [r for r in analysis.jobs if r.finished]
-    waits = [w for w in (r.wait() for r in fin) if w is not None]
-    jcts = [j for j in (r.jct() for r in fin) if j is not None]
+    # one streaming pass for the CDF inputs: only the float values stay
+    # resident, never the records — the bounded-memory analyzer (ISSUE 9)
+    # may be feeding jobs from its spill store, and materializing the
+    # finished list here would defeat it.  Same values in the same jobs
+    # order as the old list comprehensions, so the charts are byte-equal.
+    waits: List[float] = []
+    jcts: List[float] = []
+    for r in analysis.jobs:
+        if r.finished:
+            w = r.wait()
+            if w is not None:
+                waits.append(w)
+            j = r.jct()
+            if j is not None:
+                jcts.append(j)
 
     kpis = [
         _tile("Finished jobs", _fmt_num(s["num_finished"]),

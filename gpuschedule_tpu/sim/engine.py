@@ -165,9 +165,21 @@ class Simulator:
         self._heap: list = []
         self._seq = itertools.count()
         self._nonticks = 0  # heap entries that are not policy ticks
-
-        for job in self.jobs:
-            self._push(job.submit_time, _ARRIVAL, job)
+        # Indexed hot paths (ISSUE 9): alloc_id -> Job for every bound
+        # allocation, so fault/warning victim resolution is O(victims)
+        # instead of a running-set sweep; running-set insertion tickets
+        # (Job.run_seq) let any indexed subset reproduce the sweep's exact
+        # iteration order by sorting.
+        self._alloc_jobs: Dict[int, Job] = {}
+        self._run_tickets = itertools.count()
+        # running multislice members (net/): the only jobs _net_update can
+        # emit for, so the per-pass scan is O(flows), not O(running).
+        # Keyed by object identity; values iterated in run_seq order.
+        self._net_members: Dict[int, Job] = {}
+        # engine-mutation counter + memo for the _quiesced endgame scan
+        # (every job.epoch bump increments it; see _quiesced)
+        self._mut = 0
+        self._stall_memo: tuple = ()
         if self.sample_interval is not None:
             # first sample one interval in (a t=0 sample of an empty
             # cluster carries no information)
@@ -197,11 +209,27 @@ class Simulator:
         # (the persistent ckpt_protected watermark still shrinks losses
         # of later unrelated revocations, but those are not "warned")
         self._warned_jobs: Dict[int, set] = {}
+        # Lazy event feed (ISSUE 9): trace arrivals and fault/warning
+        # records used to be pushed into the heap up front, so the heap
+        # held O(jobs + faults) entries for the whole replay and every
+        # push/pop paid a log of the TRACE length — the first of the
+        # per-event costs that grew with fleet scale.  Instead, the
+        # pre-known events become a time-sorted spec list fed through a
+        # cursor: exactly one spec sits in the heap at a time, and popping
+        # it pushes the next, so the heap stays at O(running + residue)
+        # whatever the trace length.  Byte-identity: the heap breaks ties
+        # by (time, kind, push seq) and spec kinds (_ARRIVAL/_FAULT/_WARN,
+        # the odd numbers) never collide with dynamic kinds at equal
+        # (time, kind) — sorting specs by (time, kind) with a stable sort
+        # (construction order breaks remaining ties, exactly as the old
+        # ascending push-seq did) reproduces the old pop order event for
+        # event.
+        specs: list = [(job.submit_time, _ARRIVAL, job) for job in self.jobs]
         if faults is not None and faults.records:
             self._drain_faults = True
             for i, rec in enumerate(faults.records):
                 self._fault_ids[id(rec)] = i
-                self._push(rec.time, _FAULT, rec)
+                specs.append((rec.time, _FAULT, rec))
                 # spot pre-revoke notice (ISSUE 6 priced recovery): the
                 # warning lands strictly before its revocation, giving
                 # running gangs on the spot unit a window to take an
@@ -209,7 +237,11 @@ class Simulator:
                 if rec.kind == "spot" and rec.warning > 0.0:
                     t_warn = rec.time - rec.warning
                     if 0.0 < t_warn < rec.time:
-                        self._push(t_warn, _WARN, rec)
+                        specs.append((t_warn, _WARN, rec))
+        specs.sort(key=lambda s: (s[0], s[1]))
+        self._specs = specs
+        self._spec_i = 0
+        self._push_next_spec()
         # Priced checkpoint writes (ISSUE 6): when the recovery model
         # charges for writes, size each job's per-write cost from its
         # model state and gang once, up front — Job.advance folds it into
@@ -240,6 +272,20 @@ class Simulator:
             self._nonticks += 1
         heapq.heappush(self._heap, (time, kind, next(self._seq), payload, epoch))
 
+    def _push_next_spec(self) -> None:
+        """Feed the next pre-known event (arrival / fault / warning) from
+        the time-sorted spec list into the heap.  Exactly one spec lives
+        in the heap at a time — the cursor invariant that keeps the heap
+        scale-free (ISSUE 9) and, while any spec remains, keeps
+        ``_nonticks`` >= 1 so the quiescence test and the sample re-arm
+        cutoff see pending real work exactly as they used to."""
+        i = self._spec_i
+        specs = self._specs
+        if i < len(specs):
+            self._spec_i = i + 1
+            t, kind, payload = specs[i]
+            self._push(t, kind, payload)
+
     def request_wakeup(self, time: float) -> None:
         """Policy-facing: ask to be re-invoked at absolute sim time ``time``."""
         if time > self.now + self.eps:
@@ -263,17 +309,34 @@ class Simulator:
         job.allocation = alloc
         job.locality_factor = getattr(alloc.detail, "speed_factor", 1.0)
         job.slow_factor = self.cluster.alloc_slow_factor(alloc)
+        self._alloc_jobs[alloc.alloc_id] = job
         if self.net is not None:
             # the flow set / pod occupancy changed: invalidate the cached
             # fabric pricing (ISSUE 7 incremental re-pricing)
             self.net.mark_dirty(job)
+            if getattr(alloc.detail, "slices", None):
+                # a DCN-spanning gang: it is (or is about to become) a
+                # flow, so _net_update must visit it (ISSUE 9 member set)
+                self._net_members[id(job)] = job
+
+    def _unbind_allocation(self, job: Job) -> None:
+        """Drop a job's allocation from the engine indices — called at
+        every ``cluster.free`` site, before the free, so the index never
+        holds a dead alloc_id."""
+        alloc = job.allocation
+        if alloc is not None:
+            self._alloc_jobs.pop(alloc.alloc_id, None)
 
     def _net_release(self, job: Job) -> None:
         """Invalidate the cached fabric pricing for a job about to lose
         its allocation — called while the allocation is still attached so
-        the dirty test can see which pods it loaded."""
+        the dirty test can see which pods it loaded.  Only leaving-the-
+        running-set sites call this (preempt / finish / revoke), so it
+        also retires the job's net-member entry; the resize/migrate paths
+        keep membership until the next recompute closes the job's share."""
         if self.net is not None:
             self.net.mark_dirty(job)
+            self._net_members.pop(id(job), None)
 
     # ------------------------------------------------------------------ #
     # causal attribution (ISSUE 5): blame tagging + cluster sampling
@@ -406,11 +469,16 @@ class Simulator:
         job.speed = speed
         job.overhead_remaining += overhead
         job.epoch += 1
+        self._mut += 1
         if job.first_start_time is None:
             job.first_start_time = self.now
         if job in self.pending:
             self.pending.remove(job)
         self.running.append(job)
+        # running-set insertion ticket: ascending run_seq IS the running
+        # set's iteration order, so indexed subsets (victims, net members)
+        # can reproduce a full sweep's order by sorting on it (ISSUE 9)
+        job.run_seq = next(self._run_tickets)
         self._schedule_completion(job)
         if self.metrics.record_events:
             extra = {"chips": chips, "speed": speed, "overhead": overhead,
@@ -438,6 +506,7 @@ class Simulator:
         track = track_label(job.allocation.detail) if record else None
         job.advance(self.now)
         self._net_release(job)
+        self._unbind_allocation(job)
         self.cluster.free(job.allocation)
         job.allocation = None
         job.allocated_chips = 0
@@ -445,6 +514,7 @@ class Simulator:
         job.locality_factor = 1.0
         job.slow_factor = 1.0
         job.epoch += 1
+        self._mut += 1
         job.preempt_count += 1
         job.state = JobState.SUSPENDED if suspend else JobState.PENDING
         self.running.remove(job)
@@ -475,6 +545,7 @@ class Simulator:
         job.advance(self.now)
         job.speed = speed
         job.epoch += 1
+        self._mut += 1
         self._schedule_completion(job)
         if self.metrics.record_events:
             extra = {"speed": speed, "prog": _prog(job)}
@@ -503,6 +574,7 @@ class Simulator:
         chips, speed = job.allocated_chips, job.speed
         old_detail = job.allocation.detail if job.allocation is not None else None
         job.advance(self.now)
+        self._unbind_allocation(job)
         self.cluster.free(job.allocation)
         alloc = self.cluster.allocate(chips, job=job, hint=placement_hint)
         if alloc is None:  # hint unsatisfiable; restore in place (no cost charged)
@@ -514,6 +586,7 @@ class Simulator:
             # or the stale event computed at the old rate stands
             self._bind_allocation(job, alloc)
             job.epoch += 1
+            self._mut += 1
             self._schedule_completion(job)
             self._emit_rebind(job, old_detail, alloc)
             return False
@@ -523,6 +596,7 @@ class Simulator:
         job.overhead_remaining += overhead
         job.migration_count += 1
         job.epoch += 1
+        self._mut += 1
         self._schedule_completion(job)
         self.metrics.count("migrations")
         if self.metrics.record_events:
@@ -556,6 +630,7 @@ class Simulator:
             return True
         job.advance(self.now)
         old_detail = job.allocation.detail if job.allocation is not None else None
+        self._unbind_allocation(job)
         self.cluster.free(job.allocation)
         alloc = self.cluster.allocate(chips, job=job)
         if alloc is None:
@@ -564,6 +639,7 @@ class Simulator:
                 raise RuntimeError(f"allocation vanished during resize of {job!r}")
             self._bind_allocation(job, alloc)
             job.epoch += 1
+            self._mut += 1
             self._schedule_completion(job)
             self._emit_rebind(job, old_detail, alloc)
             return False
@@ -572,6 +648,7 @@ class Simulator:
         job.speed = speed
         job.overhead_remaining += overhead
         job.epoch += 1
+        self._mut += 1
         self._schedule_completion(job)
         if self.metrics.record_events:
             extra = {"chips": chips, "speed": speed,
@@ -682,11 +759,13 @@ class Simulator:
         job.advance(self.now)
         job.executed_work = job.duration  # absorb float residue
         self._net_release(job)
+        self._unbind_allocation(job)
         self.cluster.free(job.allocation)
         job.allocation = None
         job.allocated_chips = 0
         job.speed = 0.0
         job.epoch += 1
+        self._mut += 1
         job.state = job.end_state
         job.end_time = self.now
         self.running.remove(job)
@@ -723,7 +802,15 @@ class Simulator:
         scan is skipped — nothing could have changed, so no event would
         have been emitted anyway (the pre-incremental engine would have
         re-derived identical shares and fallen through every emit
-        branch)."""
+        branch).
+
+        Member-set scan (ISSUE 9): only running multislice gangs (plus
+        gangs whose stale bandwidth share still needs closing) can make
+        this loop emit or mutate anything — the engine maintains exactly
+        that set at bind/release time (``_net_members``), so a dirty pass
+        costs O(flows), not O(running).  Iterating members in ascending
+        ``run_seq`` reproduces the running-set sweep's order exactly, so
+        every emitted event lands in the same stream position."""
         if self.net.poll(self.now) is not None:
             return
         state = self.net.recompute(self.now, self.running, reuse_flows=True)
@@ -737,14 +824,18 @@ class Simulator:
         if routing:
             routed, self._net_routes = self._net_routes, {}
         priced, self._net_priced = self._net_priced, {}
-        for job in self.running:
+        members = sorted(
+            self._net_members.values(), key=lambda j: j.run_seq
+        )
+        for job in members:
             share = state.shares.get(job.job_id)
             if share is None:
+                # still running but no longer a flow (an elastic shrink/
+                # migration back inside one pod): close its bandwidth in
+                # the stream if it was priced, then retire the membership
+                # — a later multislice re-grow re-registers it at bind
+                del self._net_members[id(job)]
                 if priced.get(job.job_id):
-                    # still running but no longer a flow (an elastic
-                    # shrink/migration back inside one pod): close its
-                    # bandwidth in the stream, or the analyzer would
-                    # integrate the stale share for the rest of the run
                     self.metrics.count("net_reprices")
                     if record:
                         self.metrics.event(
@@ -774,6 +865,7 @@ class Simulator:
                 job.advance(self.now)
                 job.locality_factor = share.factor
                 job.epoch += 1
+                self._mut += 1
                 self._schedule_completion(job)
             self.metrics.count("net_reprices")
             if record:
@@ -831,14 +923,9 @@ class Simulator:
             # duration <= 0 lands in this same batch (kind order puts the
             # repair after the fault), modeling a blip that still revokes
             self._push(self.now + max(0.0, rec.duration), _REPAIR, rec)
-        if victim_ids:
-            ids = set(victim_ids)
-            victims = [
-                j for j in self.running
-                if j.allocation is not None and j.allocation.alloc_id in ids
-            ]
-        else:
-            victims = []
+        # alloc-index victim resolution (ISSUE 9): O(victims) instead of a
+        # running-set sweep; run_seq order IS the sweep's iteration order
+        victims = self._victim_jobs(victim_ids)
         for job in victims:
             self._revoke(job, rec)
         self.policy.on_fault(self, rec, victims)
@@ -898,27 +985,39 @@ class Simulator:
         if mark is None:
             self.metrics.count("straggler_faults_inert")
         else:
-            mark(rec.scope, rec.degrade)
-            self._apply_slow_factors()
+            touched = mark(rec.scope, rec.degrade)
+            self._apply_slow_factors(touched)
         if math.isfinite(rec.duration):
             self._push(self.now + max(0.0, rec.duration), _REPAIR, rec)
         self.policy.on_fault(self, rec, [])
 
-    def _apply_slow_factors(self) -> None:
-        """Re-derive every running gang's straggler multiplier from the
+    def _apply_slow_factors(self, alloc_ids=None) -> None:
+        """Re-derive running gangs' straggler multipliers from the
         cluster's degrade mask after a straggler onset or recovery.
         Factor changes ride the usual re-predict machinery (advance at
         the old rate, bind, epoch bump, reschedule) and are emitted as
         ``slow`` events with the exact progress snapshot, so the
-        analyzer tracks the rate change without replaying the mask."""
+        analyzer tracks the rate change without replaying the mask.
+
+        ``alloc_ids`` (ISSUE 9) scopes the re-derivation to the gangs the
+        cluster reported overlapping the changed scope — a gang's min-
+        over-chips factor can only move when one of ITS chips did, so
+        visiting only those gangs (in run_seq = sweep order) emits the
+        identical events.  ``None`` keeps the full running-set sweep for
+        clusters whose mask cannot report overlap."""
         record = self.metrics.record_events
-        for job in self.running:
+        jobs = (
+            self.running if alloc_ids is None
+            else self._victim_jobs(alloc_ids)
+        )
+        for job in jobs:
             factor = self.cluster.alloc_slow_factor(job.allocation)
             if factor == job.slow_factor:
                 continue
             job.advance(self.now)
             job.slow_factor = factor
             job.epoch += 1
+            self._mut += 1
             self._schedule_completion(job)
             self.metrics.count("straggler_reprices")
             if record:
@@ -946,11 +1045,7 @@ class Simulator:
         but unprotected (``spot_warnings_missed``)."""
         self.metrics.count("spot_warnings")
         peek = getattr(self.cluster, "peek_victims", None)
-        victim_ids = set(peek(rec.scope)) if peek is not None else set()
-        victims = [
-            j for j in self.running
-            if j.allocation is not None and j.allocation.alloc_id in victim_ids
-        ]
+        victims = self._victim_jobs(peek(rec.scope) if peek is not None else ())
         record = self.metrics.record_events
         recovery = self.faults.recovery
         window = rec.time - self.now
@@ -971,6 +1066,7 @@ class Simulator:
             )
             job.overhead_remaining += write
             job.epoch += 1
+            self._mut += 1
             self._schedule_completion(job)
             self._warned_jobs.setdefault(id(rec), set()).add(job.job_id)
             self.metrics.count("emergency_ckpts")
@@ -1013,6 +1109,7 @@ class Simulator:
             job.executed_work -= lost
             job.lost_work += lost
         self._net_release(job)
+        self._unbind_allocation(job)
         self.cluster.free(job.allocation)
         job.allocation = None
         job.allocated_chips = 0
@@ -1020,6 +1117,7 @@ class Simulator:
         job.locality_factor = 1.0
         job.slow_factor = 1.0
         job.epoch += 1
+        self._mut += 1
         job.fault_count += 1
         # the checkpoint restore supersedes any partially burned setup cost
         # (a job faulted mid-resume starts its recovery over)
@@ -1049,6 +1147,18 @@ class Simulator:
                 track=track, prog=_prog(job), **extra,
             )
 
+    def _victim_jobs(self, alloc_ids) -> List[Job]:
+        """Resolve a cluster-reported alloc_id list to the running jobs
+        holding them, in running-set iteration order (ascending run_seq) —
+        the indexed replacement for ``[j for j in self.running if
+        j.allocation.alloc_id in ids]`` (ISSUE 9)."""
+        if not alloc_ids:
+            return []
+        index = self._alloc_jobs
+        victims = [index[a] for a in alloc_ids if a in index]
+        victims.sort(key=lambda j: j.run_seq)
+        return victims
+
     def _drain_batch(self, t: float) -> bool:
         """Pop and apply every event at or before ``t``; True if any event
         changed scheduler-visible state (the policy must then run)."""
@@ -1060,6 +1170,12 @@ class Simulator:
             _, kind, _, payload, epoch = heappop(heap)
             if kind != _TICK and kind != _SAMPLE:
                 self._nonticks -= 1
+            if kind & 1:
+                # spec kinds are exactly the odd ones (_ARRIVAL/_FAULT/
+                # _WARN): popping the cursor's in-heap spec admits the
+                # next one — at an equal timestamp it joins this same
+                # batch, in the old pop order (see _push_next_spec)
+                self._push_next_spec()
             if kind == _SAMPLE:
                 # cluster-side snapshot: emit (when the event stream is on)
                 # and re-arm while real events remain — sampling past the
@@ -1144,10 +1260,10 @@ class Simulator:
                     # the health mask; gangs on the healed unit speed
                     # back up through the same slow-factor re-derivation
                     if hasattr(self.cluster, "clear_degraded"):
-                        self.cluster.clear_degraded(
+                        touched = self.cluster.clear_degraded(
                             payload.scope, payload.degrade
                         )
-                        self._apply_slow_factors()
+                        self._apply_slow_factors(touched)
                 else:
                     self.cluster.repair(payload.scope)
                 self.metrics.count("repairs")
@@ -1229,9 +1345,22 @@ class Simulator:
             return False
         if not self.running:
             return True
-        return not self.pending and all(
+        if self.pending:
+            return False
+        # Memoized endgame scan (ISSUE 9): between heap events nothing can
+        # change a running job's remaining_runtime without bumping _mut (a
+        # job already stalled at rate 0 burns neither work nor its stall:
+        # advance() is a no-op on the answer), so a long tick chain asks
+        # the O(running) question once per mutation instead of per tick.
+        key = (len(self.finished), len(self.running), self._mut)
+        memo = self._stall_memo
+        if memo and memo[0] == key:
+            return memo[1]
+        stalled = all(
             j.remaining_runtime() == math.inf for j in self.running
         )
+        self._stall_memo = (key, stalled)
+        return stalled
 
     def _run_plain(self) -> SimResult:
         # Hot loop (ISSUE 7): every attribute below is fixed for the whole

@@ -89,12 +89,19 @@ STATUS_TO_END_STATE = {
 }
 
 
-@dataclass
+@dataclass(slots=True)
 class Job:
     """A single trace job.
 
     Parameters mirror one trace row; everything after ``status`` is runtime
     state owned by the simulation engine.
+
+    ``slots=True`` (ISSUE 9): a million-job trace holds a million of these
+    alive for the whole replay, and the per-instance ``__dict__`` roughly
+    doubled the footprint; slots also shave the attribute loads off
+    :meth:`advance`, the engine's hottest method.  Every runtime attribute
+    is a declared field — policies get the ``sched`` dict for scratch
+    state, never ad-hoc attributes.
     """
 
     job_id: str
@@ -174,6 +181,14 @@ class Job:
     epoch: int = 0                      # invalidates stale scheduled completions
     arrival_seq: int = 0                # submit-order index assigned by the engine
                                         # (numeric FIFO tie-break; 'j2' < 'j10')
+    run_seq: int = 0                    # monotonic ticket stamped at every gang
+                                        # start (ISSUE 9): the engine's running
+                                        # set iterates in insertion order, which
+                                        # is ascending run_seq — so any indexed
+                                        # subset (fault victims, multislice
+                                        # members) can reproduce the exact sweep
+                                        # order of a full running-set scan by
+                                        # sorting on this ticket
 
     # ---- causal attribution (engine-owned, ISSUE 5) ----
     # None keeps the attribution-off path allocation-free and byte-

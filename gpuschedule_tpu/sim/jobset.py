@@ -13,12 +13,23 @@ Insertion order is a real invariant, not an accident: arrivals enter
 them that way, so a non-preemptive policy (FIFO) can consume ``pending`` in
 arrival order with no per-event sort.  Preemptive policies re-append
 preempted jobs at the tail and impose their own priority order anyway.
+
+The backing store is an ``OrderedDict`` — a real doubly-linked list —
+not a plain dict (ISSUE 9).  A plain dict keeps deleted entries as
+tombstones until an insert-triggered resize compacts them, so the
+front-heavy churn these sets live under (FIFO consumes the head, the
+engine removes finished jobs constantly) makes "first element" and
+iteration scan an ever-growing tombstone run: at million-job scale the
+end-of-trace drain — all deletions, no inserts, so no compaction ever —
+went quadratic in the backlog and dominated the whole replay.  The
+linked list makes head access and iteration O(live entries), always.
 """
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from itertools import islice
-from typing import Dict, Iterable, Iterator, List
+from typing import Iterable, Iterator, List
 
 from gpuschedule_tpu.sim.job import Job
 
@@ -29,10 +40,14 @@ class JobSet:
     __slots__ = ("_jobs",)
 
     def __init__(self, jobs: Iterable[Job] = ()):
-        self._jobs: Dict[int, Job] = {id(j): j for j in jobs}
+        self._jobs: "OrderedDict[int, Job]" = OrderedDict(
+            (id(j), j) for j in jobs
+        )
 
     def append(self, job: Job) -> None:
-        self._jobs[id(job)] = job  # re-append moves nothing: dict keeps first slot
+        # re-append moves nothing: OrderedDict keeps the first position
+        # for an existing key (same contract the plain dict had)
+        self._jobs[id(job)] = job
 
     def remove(self, job: Job) -> None:
         try:

@@ -43,6 +43,62 @@ def test_build_sim_rejects_unknown_config():
         build_sim("bogus", 10)
 
 
+def test_floors_file_is_the_source_of_truth():
+    """The pinned budget lives in tools/engine_bench_floors.json (ISSUE 9
+    satellite): every floored config is a real ladder config with a
+    positive jobs/sec budget, and the loaded FLOORS reflect the file."""
+    import json
+
+    from engine_bench import CONFIGS, FLOORS, FLOORS_PATH
+
+    doc = {k: v for k, v in json.loads(FLOORS_PATH.read_text()).items()
+           if not k.startswith("_")}
+    assert doc == FLOORS
+    assert set(FLOORS) <= set(CONFIGS)
+    assert all(v > 0 for v in FLOORS.values())
+
+
+def test_micro_rung_gate_end_to_end():
+    """Fast tier-1 micro rung (ISSUE 9 satellite): 1k jobs, plain +
+    attrib, through the real pinned-floors gate — an engine hot-path
+    regression below budget fails the SUITE, not just the slow ladder.
+    min-of-2 repeats absorbs the reference box's ~2x CPU-speed swings,
+    and tier-1 halves the floors on top (floor_scale=0.5 → ~12% of the
+    reference rate): a genuinely slower CI host stays green while a
+    catastrophic hot-path loss (a dropped cache, an accidental O(n²))
+    still trips it.  GSTPU_BENCH_STRICT=1 restores the full floors for
+    runs on the reference container."""
+    import os
+
+    from engine_bench import apply_gate, run_ladder, scale_ratios
+
+    rungs = run_ladder((1000,), ("plain", "attrib"), seed=1, repeats=2,
+                       isolate=False)
+    scale = 1.0 if os.environ.get("GSTPU_BENCH_STRICT") == "1" else 0.5
+    gate = apply_gate(rungs, floor_scale=scale)
+    assert gate["ok"], gate
+    for rung in rungs:
+        assert rung["finished"] + rung["unfinished"] == 1000
+        assert rung["events_per_s"] > 0
+        assert rung["rss_peak_mb"] > 0
+    assert scale_ratios(rungs) == {"plain": {}, "attrib": {}}
+
+
+@pytest.mark.slow
+def test_million_job_rung_scale_ratio():
+    """The ISSUE 9 headline at test scale: jobs/sec must no longer decay
+    from 100k to 1M jobs on the plain rung.  The threshold is generous
+    (this box swings 2x between runs; BENCH_ENGINE_r09.json records the
+    interleaved measurement) — the pre-ISSUE-9 engine decayed well below
+    it."""
+    from engine_bench import run_ladder, scale_ratios
+
+    rungs = run_ladder((100_000, 1_000_000), ("plain",), seed=0,
+                       repeats=1, isolate=False)
+    ratio = scale_ratios(rungs)["plain"]["1000000/100000"]
+    assert ratio >= 0.7, rungs
+
+
 @pytest.mark.slow
 def test_engine_bench_tool_gate_exit_codes(tmp_path):
     """Drive one small ladder cell through the CLI twice: a vanishing

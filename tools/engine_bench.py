@@ -33,10 +33,13 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import multiprocessing
 import os
 import platform
+import resource
 import sys
 import time
+from pathlib import Path
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -55,18 +58,24 @@ from gpuschedule_tpu.sim.metrics import MetricsLog  # noqa: E402
 from gpuschedule_tpu.sim.philly import generate_philly_like_trace  # noqa: E402
 
 LADDER_SIZES = (1_000, 10_000, 100_000)
+# the 1M rung (ISSUE 9): minutes even on the optimized engine, so it is
+# opt-in — `--million` appends it; the slow-marked pytest case and the
+# BENCH_ENGINE_r09 before/after ladder run it
+MILLION = 1_000_000
 CONFIGS = ("plain", "faults", "net", "attrib")
 
-# Jobs/sec floors per configuration (the budget gate).  Pinned from the
-# post-ISSUE-7 measurement on the reference container (BENCH_ENGINE_r07.
-# json) at ~25% of the observed slowest-rung rate: generous enough for a
-# loaded CI box, tight enough that losing the incremental re-pricing
-# cache (or an accidental O(n^2) in the batch loop) trips the gate.
+# Jobs/sec floors per configuration (the budget gate), pinned in
+# tools/engine_bench_floors.json (ISSUE 9: a data file so the tier-1
+# micro-rung test and this tool share one source of truth).  Values are
+# ~25% of the post-ISSUE-9 reference measurement: generous for a loaded
+# CI box, tight enough that losing the allocate failure cache, the
+# bitmask slice search, the lazy heap feed, or the re-pricing cache
+# trips the gate.
+FLOORS_PATH = Path(__file__).resolve().parent / "engine_bench_floors.json"
 FLOORS = {
-    "plain": 1160.0,
-    "faults": 260.0,
-    "net": 1010.0,
-    "attrib": 1350.0,
+    k: float(v)
+    for k, v in json.loads(FLOORS_PATH.read_text()).items()
+    if not k.startswith("_")
 }
 
 # Ladder workload shape: one fleet for every configuration so the rungs
@@ -147,20 +156,73 @@ def run_rung(
         "elapsed_s": round(best, 4),
         "jobs_per_s": round(num_jobs / best, 2),
         "events_per_s": round(kept["events"] / best, 2),
+        # peak RSS of this process so far (ru_maxrss is monotonic — under
+        # the default per-rung fork isolation each rung reports its own
+        # true peak; with --no-isolate it is a high-water mark).
+        # ru_maxrss is kilobytes on Linux but BYTES on Darwin.
+        "rss_peak_mb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            / (1024.0 * 1024.0 if sys.platform == "darwin" else 1024.0), 1
+        ),
         **kept,
     }
 
 
+def _rung_task(args) -> dict:
+    """Picklable per-rung entry for the fork-isolated pool."""
+    config, num_jobs, seed, repeats = args
+    return run_rung(config, num_jobs, seed=seed, repeats=repeats)
+
+
 def run_ladder(
-    sizes=LADDER_SIZES, configs=CONFIGS, *, seed: int = 0, repeats: int = 1
+    sizes=LADDER_SIZES, configs=CONFIGS, *, seed: int = 0, repeats: int = 1,
+    isolate: bool = True,
 ) -> list:
+    """The full config x size grid.  ``isolate`` (default) forks a fresh
+    child per rung, so each rung's ``rss_peak_mb`` is its own true peak
+    RSS (ISSUE 9) and no rung inherits another's allocator/GC state —
+    falls back to in-process when fork is unavailable."""
     rungs = []
-    for config in configs:
-        for n in sizes:
-            rung = run_rung(config, n, seed=seed, repeats=repeats)
-            print(json.dumps(rung, sort_keys=True), file=sys.stderr)
-            rungs.append(rung)
+    pool = None
+    if isolate and "fork" in multiprocessing.get_all_start_methods():
+        ctx = multiprocessing.get_context("fork")
+        # maxtasksperchild=1: every rung gets a brand-new child
+        pool = ctx.Pool(processes=1, maxtasksperchild=1)
+    try:
+        for config in configs:
+            for n in sizes:
+                if pool is not None:
+                    rung = pool.apply(_rung_task, ((config, n, seed, repeats),))
+                else:
+                    rung = run_rung(config, n, seed=seed, repeats=repeats)
+                print(json.dumps(rung, sort_keys=True), file=sys.stderr)
+                rungs.append(rung)
+    finally:
+        if pool is not None:
+            pool.terminate()
+            pool.join()
     return rungs
+
+
+def scale_ratios(rungs: list) -> dict:
+    """Per-config jobs/sec ratio between consecutive ladder sizes — the
+    scale-decay signal at a glance (ISSUE 9: a healthy engine holds
+    ratios near 1.0 from 10k through 1M jobs; the pre-ISSUE-9 engine
+    decayed toward ~0.85 per decade)."""
+    by_config: dict = {}
+    for rung in rungs:
+        by_config.setdefault(rung["config"], []).append(
+            (rung["num_jobs"], rung["jobs_per_s"])
+        )
+    out: dict = {}
+    for config, pairs in by_config.items():
+        pairs.sort()
+        ratios = {}
+        for (n0, r0), (n1, r1) in zip(pairs, pairs[1:]):
+            if r0 > 0:
+                ratios[f"{n1}/{n0}"] = round(r1 / r0, 4)
+        out[config] = ratios
+    return out
 
 
 def apply_gate(
@@ -200,16 +262,28 @@ def main(argv=None) -> int:
                         "locally, e.g. after a machine upgrade)")
     p.add_argument("--no-gate", action="store_true",
                    help="measure only; always exit 0")
+    p.add_argument("--no-isolate", action="store_true",
+                   help="run rungs in-process instead of one forked child "
+                        "per rung (rss_peak_mb then becomes a monotonic "
+                        "high-water mark)")
+    p.add_argument("--million", action="store_true",
+                   help="append the slow 1M-job rung to the ladder (the "
+                        "scale-decay headline; minutes per config)")
     p.add_argument("--out", help="also write the JSON document here")
     args = p.parse_args(argv)
 
     sizes = tuple(int(s) for s in args.sizes.split(","))
+    if args.million and MILLION not in sizes:
+        sizes = sizes + (MILLION,)
     configs = tuple(c.strip() for c in args.configs.split(",") if c.strip())
-    rungs = run_ladder(sizes, configs, seed=args.seed, repeats=args.repeats)
+    rungs = run_ladder(sizes, configs, seed=args.seed, repeats=args.repeats,
+                       isolate=not args.no_isolate)
     gate = apply_gate(rungs, floor_scale=args.floor_scale)
+    ratios = scale_ratios(rungs)
     doc = {
         "ladder": rungs,
         "gate": gate,
+        "scale_ratios": ratios,
         "floors_jobs_per_s": {
             k: v * args.floor_scale for k, v in FLOORS.items() if k in configs
         },
@@ -219,6 +293,7 @@ def main(argv=None) -> int:
             "seed": args.seed,
             "repeats": args.repeats,
             "floor_scale": args.floor_scale,
+            "isolate": not args.no_isolate,
             "dims": list(_DIMS),
             "pods": _NUM_PODS,
             "multislice_share": _MULTISLICE_SHARE,
@@ -229,18 +304,24 @@ def main(argv=None) -> int:
         },
     }
     if args.out:
-        from pathlib import Path
-
         out = Path(args.out)
         if out.parent and not out.parent.exists():
             out.parent.mkdir(parents=True, exist_ok=True)
         out.write_text(json.dumps(doc, indent=2, sort_keys=True))
+    # the scale-decay view at a glance: jobs/sec ratios between adjacent
+    # ladder sizes per config (>= ~0.9 per decade = decay eliminated)
+    for config in configs:
+        if ratios.get(config):
+            print(f"scale {config}: " + "  ".join(
+                f"{k} = {v:.3f}" for k, v in sorted(ratios[config].items())
+            ), file=sys.stderr)
     print(json.dumps({
         "ok": gate["ok"],
         "rungs": len(rungs),
         "jobs_per_s": {
             f"{r['config']}/{r['num_jobs']}": r["jobs_per_s"] for r in rungs
         },
+        "scale_ratios": ratios,
     }, sort_keys=True))
     if args.no_gate:
         return 0
