@@ -248,7 +248,16 @@ def cmd_run(args) -> int:
         registry=registry,
         run_meta=run_meta,
         attribution=bool(args.attrib),
+        cache_telemetry=bool(args.cache_stats),
     )
+    # Wall-clock self-profiling (ISSUE 10): --self-profile attaches the
+    # phase profiler and selects the engine's profiled loop body; the
+    # flag off, no clock is ever read (the ≤2% overhead contract).
+    profiler = None
+    if args.self_profile:
+        from gpuschedule_tpu.obs import PhaseProfiler
+
+        profiler = PhaseProfiler()
     sim = Simulator(
         cluster, build_policy(args), jobs,
         metrics=metrics,
@@ -256,12 +265,44 @@ def cmd_run(args) -> int:
         faults=fault_plan,
         net=net_model,
         sample_interval=args.sample_interval,
+        sample_on_change=bool(args.sample_on_change),
+        profiler=profiler,
     )
     # context-manager path: an engine exception still flushes/closes the
     # JSONL sink, leaving an analyzable stream behind (ISSUE 3 satellite)
     with metrics:
         res = sim.run()
     print(json.dumps(res.summary(), sort_keys=True))
+    if profiler is not None:
+        profiler.meta.update({
+            "seed": args.seed,
+            **({"run_id": run_meta["run_id"],
+                "config_hash": run_meta["config_hash"]}
+               if run_meta is not None else {}),
+        })
+        profiler.write(args.self_profile)
+        print(json.dumps(
+            {"selfprof": str(args.self_profile),
+             "total_wall_s": profiler.total_wall_s,
+             "batches": profiler.batches},
+            sort_keys=True), file=sys.stderr)
+    if args.history:
+        # cross-run memory (ISSUE 10): append this invocation's summary
+        # keyed by run identity, so `history trend` can render the
+        # trajectory across invocations
+        from gpuschedule_tpu.obs import HistoryStore
+
+        chash = run_meta["config_hash"] if run_meta else _run_config_hash(args)
+        with HistoryStore(args.history) as store:
+            store.append(
+                "run",
+                run_id=(run_meta["run_id"] if run_meta
+                        else f"{args.policy}-s{args.seed}-{chash}"),
+                config_hash=chash,
+                policy=args.policy,
+                seed=args.seed,
+                metrics=res.summary(),
+            )
     if args.out:
         sim.metrics.write(args.out, prefix=args.prefix)
     else:
@@ -309,18 +350,32 @@ def cmd_report(args) -> int:
     analytics layer; `compare` is the CI half."""
     from gpuschedule_tpu.obs import SchemaError, StreamError, analyze_file, write_report
 
+    selfprof = None
+    if args.selfprof:
+        from gpuschedule_tpu.obs import load_profile
+
+        try:
+            selfprof = load_profile(args.selfprof)
+        except (OSError, ValueError) as e:
+            raise SystemExit(str(e)) from None
     try:
         analysis = analyze_file(args.events, require_header=not args.no_header,
                                 low_memory=args.low_mem)
     except (SchemaError, StreamError) as e:
         raise SystemExit(str(e)) from None
-    out = write_report(analysis, args.out, title=args.title)
+    out = write_report(analysis, args.out, title=args.title, selfprof=selfprof)
     if args.json:
         from pathlib import Path
 
-        Path(args.json).write_text(
-            json.dumps(analysis.to_json(), indent=2, sort_keys=True)
-        )
+        if args.low_mem:
+            # spill-backed JSON dump (ISSUE 10 satellite): stream the
+            # jobs array straight from the sqlite store — byte-identical
+            # to the in-memory serialization, resident memory O(active)
+            analysis.write_json(args.json)
+        else:
+            Path(args.json).write_text(
+                json.dumps(analysis.to_json(), indent=2, sort_keys=True)
+            )
     print(json.dumps({
         "report": str(out),
         "events": analysis.num_events,
@@ -391,6 +446,25 @@ def cmd_compare(args) -> int:
             write_compare_json(result, args.json)
         else:
             write_matrix_json(result, args.json)
+    if args.history:
+        # cross-invocation trend substrate (ISSUE 10, retiring the PR-3
+        # trend-over-history omission): every compared stream's summary
+        # lands in the store under its own header identity, so repeated
+        # compare invocations accumulate per-config trajectories that
+        # `history trend` renders — the TopoOpt search loop's ledger
+        from gpuschedule_tpu.obs import HistoryStore
+
+        with HistoryStore(args.history) as store:
+            for a in analyses:
+                h = a.header
+                store.append(
+                    "compare",
+                    run_id=h.run_id if h else "",
+                    config_hash=h.config_hash if h else "",
+                    policy=h.policy if h else "",
+                    seed=h.seed if h else None,
+                    metrics=a.summary(),
+                )
     return result.exit_code if len(analyses) == 2 else 0
 
 
@@ -458,6 +532,46 @@ def cmd_faults(args) -> int:
         out = Path(args.out)
         out.parent.mkdir(parents=True, exist_ok=True)
         out.write_text(json.dumps(doc, indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_history(args) -> int:
+    """Cross-run history (ISSUE 10): render the store's accumulated
+    run/compare/bench results.  ``trend`` prints a deterministic
+    per-metric trajectory table (same store -> same bytes, however many
+    times it is invoked); ``list`` prints the matching rows."""
+    from pathlib import Path
+
+    from gpuschedule_tpu.obs import HistoryStore, render_trend
+
+    if not Path(args.store).exists():
+        raise SystemExit(f"history store {args.store} does not exist")
+    with HistoryStore(args.store) as store:
+        rows = store.rows(
+            kind=args.kind, config_hash=args.config, label=args.label,
+            last=args.last,
+        )
+    if args.action == "list":
+        for r in rows:
+            print(json.dumps({
+                "seq": r.seq, "kind": r.kind, "run_id": r.run_id,
+                "config_hash": r.config_hash, "policy": r.policy,
+                "seed": r.seed, "label": r.label,
+            }, sort_keys=True))
+        print(f"{len(rows)} rows", file=sys.stderr)
+    else:
+        metrics = args.metric or ["avg_jct"]
+        print(render_trend(rows, metrics))
+    if args.json:
+        Path(args.json).write_text(json.dumps(
+            [{
+                "seq": r.seq, "ts": r.ts, "kind": r.kind,
+                "run_id": r.run_id, "config_hash": r.config_hash,
+                "policy": r.policy, "seed": r.seed, "label": r.label,
+                "metrics": r.metrics,
+            } for r in rows],
+            indent=2, sort_keys=True,
+        ))
     return 0
 
 
@@ -1043,6 +1157,38 @@ def main(argv: Optional[List[str]] = None) -> int:
                      help="write run counters/gauges/histograms in the "
                           "Prometheus text exposition format (with --out, "
                           "metrics.prom/metrics.json are written there too)")
+    run.add_argument("--self-profile", metavar="PATH",
+                     help="profile the replay loop itself: bucket each "
+                          "batch's WALL time into phases (event-apply / "
+                          "policy / net-resolve / fault-dispatch / advance "
+                          "/ metrics / analytics) and write PATH as a "
+                          "ui.perfetto.dev-loadable document with the "
+                          "machine-readable 'selfprof' summary block; "
+                          "phase times sum to total replay wall time "
+                          "exactly.  Replay output is byte-identical with "
+                          "or without the flag")
+    run.add_argument("--cache-stats", action="store_true",
+                     help="unified engine cache telemetry: harvest every "
+                          "PR-7/9 cache's hit/miss/invalidate counts "
+                          "(fabric pricing, flow list, bottleneck groups, "
+                          "TPU allocate caches, bitmask rows, engine "
+                          "memos) into cache_<name>_<outcome> summary "
+                          "keys, the engine_cache_events{cache,outcome} "
+                          "registry family, and a trailing 'cache' stream "
+                          "record the report's Engine-health panel renders")
+    run.add_argument("--sample-on-change", action="store_true",
+                     help="with --sample-interval or alone: additionally "
+                          "emit a cluster 'sample' event whenever a batch "
+                          "changes the health/degrade masks (fault, "
+                          "repair, straggler onset/recovery, domain "
+                          "outage) — state-driven snapshots at exactly "
+                          "the transitions, not just the timer.  Never "
+                          "perturbs the replay")
+    run.add_argument("--history", metavar="STORE",
+                     help="append this run's summary to the sqlite "
+                          "history store at STORE (created if missing), "
+                          "keyed by run_id/config_hash — `history trend` "
+                          "renders trajectories across invocations")
     run.set_defaults(fn=cmd_run)
 
     gen = sub.add_parser("gen-trace", help="write a synthetic trace CSV")
@@ -1111,7 +1257,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                      help="bounded-memory analysis: spill finished job "
                           "records to a sqlite temp store so multi-GB "
                           "streams render at O(active jobs) resident "
-                          "memory; output is byte-identical")
+                          "memory; output (HTML and --json document, now "
+                          "streamed from the store) is byte-identical")
+    rep.add_argument("--selfprof", metavar="PROFILE_JSON",
+                     help="fold a `run --self-profile` document into the "
+                          "report's Engine-health panel (wall-clock "
+                          "phase stacked bar)")
     rep.set_defaults(fn=cmd_report)
 
     cmpr = sub.add_parser(
@@ -1139,7 +1290,38 @@ def main(argv: Optional[List[str]] = None) -> int:
     cmpr.add_argument("--low-mem", action="store_true",
                       help="bounded-memory analysis of each stream (see "
                            "report --low-mem); verdicts byte-identical")
+    cmpr.add_argument("--history", metavar="STORE",
+                      help="append every compared stream's summary to the "
+                           "sqlite history store (keyed by its header "
+                           "identity) so repeated compares accumulate "
+                           "`history trend` trajectories")
     cmpr.set_defaults(fn=cmd_compare)
+
+    hist = sub.add_parser(
+        "history",
+        help="cross-run history store: list appended results and render "
+             "per-metric trajectories across invocations",
+    )
+    hist.add_argument("action", choices=("list", "trend"),
+                      help="list: matching rows; trend: per-metric "
+                           "trajectory table with step deltas")
+    hist.add_argument("--store", required=True, metavar="STORE",
+                      help="sqlite store written by run/compare/"
+                           "engine_bench --history")
+    hist.add_argument("--metric", action="append", metavar="NAME",
+                      help="summary metric(s) to render (trend; "
+                           "repeatable; default avg_jct)")
+    hist.add_argument("--kind", help="filter: run / compare / bench")
+    hist.add_argument("--config", metavar="HASH",
+                      help="filter by config_hash (compare-compatible "
+                           "worlds only)")
+    hist.add_argument("--label", help="filter by bench label, e.g. "
+                                      "plain/1000")
+    hist.add_argument("--last", type=int, metavar="N",
+                      help="only the newest N matching rows")
+    hist.add_argument("--json", metavar="PATH",
+                      help="also write the matching rows as JSON")
+    hist.set_defaults(fn=cmd_history)
 
     cmp_ = sub.add_parser("compare-topology",
                           help="config #5: GPU placement schemes vs TPU slices")

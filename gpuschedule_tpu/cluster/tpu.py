@@ -274,6 +274,19 @@ class TpuCluster(OverlayMixin, ClusterBase):
         self._can_true: set = set()
         self._can_false_version = -1
         self._can_false: set = set()
+        # Cache telemetry (ISSUE 10): per-cache hit/miss/invalidate
+        # counts behind :meth:`cache_stats` — plain int bumps at the
+        # existing branch sites, so a cache that silently stops hitting
+        # (a PR-9-style regression) shows up as a rate, not a hunch.
+        self._cs_fail_hit = 0          # allocate failure-cache refusals
+        self._cs_fail_miss = 0         # trivial allocates past the cache
+        self._cs_fail_inval = 0        # _ease-driven cache clears
+        self._cs_can_hit = 0           # can_allocate memo hits
+        self._cs_can_miss = 0          # memoized fresh derivations
+        self._cs_can_inval = 0         # directional memo clears
+        self._cs_rows_hit = 0          # bitmask row-table reuses
+        self._cs_rows_miss = 0         # row-table rebuilds
+        self._cs_search_fallback = 0   # numpy window scans (hinted/avoid)
         # Bitmask row cache (ISSUE 9): each pod's blocked grid (occupancy
         # | health) packed as one int per torus row, rebuilt lazily after
         # any write to that pod.  The hint-free slice search runs on these
@@ -599,10 +612,13 @@ class TpuCluster(OverlayMixin, ClusterBase):
         if trivial:
             if self._fail_version != self._ease:
                 self._fail_version = self._ease
+                if self._fail_sizes:
+                    self._cs_fail_inval += 1
                 self._fail_sizes.clear()
             else:
                 kind = self._fail_sizes.get(num_chips)
                 if kind is not None:
+                    self._cs_fail_hit += 1
                     if kind == "invalid":
                         self.invalid_size_failures += 1
                     elif num_chips <= self.free_chips:
@@ -610,6 +626,7 @@ class TpuCluster(OverlayMixin, ClusterBase):
                         # blocks: exactly the fresh call's 'frag' path
                         self.fragmentation_failures += 1
                     return None
+            self._cs_fail_miss += 1
         if num_chips > self.pod_chips:
             return self._allocate_multislice(
                 num_chips, job=job, hint=hint, record_fail=trivial
@@ -677,6 +694,7 @@ class TpuCluster(OverlayMixin, ClusterBase):
                         if origin is not None:
                             return self._grant(pod, origin, shape)
                     continue
+                self._cs_search_fallback += 1
                 blocked = (
                     self._blocked_avoiding(pod) if avoiding
                     else self._blocked(pod)
@@ -881,14 +899,21 @@ class TpuCluster(OverlayMixin, ClusterBase):
         tick-driven policies ask the same sizes on every batch."""
         if self._can_true_version != self._harden:
             self._can_true_version = self._harden
+            if self._can_true:
+                self._cs_can_inval += 1
             self._can_true.clear()
         if self._can_false_version != self._ease:
             self._can_false_version = self._ease
+            if self._can_false:
+                self._cs_can_inval += 1
             self._can_false.clear()
         if num_chips in self._can_true:
+            self._cs_can_hit += 1
             return True
         if num_chips in self._can_false:
+            self._cs_can_hit += 1
             return False
+        self._cs_can_miss += 1
         result = self._can_allocate_uncached(num_chips)
         (self._can_true if result else self._can_false).add(num_chips)
         return result
@@ -921,6 +946,7 @@ class TpuCluster(OverlayMixin, ClusterBase):
         occupancy/health write to the pod (ISSUE 9 bitmask search)."""
         rows = self._rows[pod]
         if rows is None:
+            self._cs_rows_miss += 1
             blocked = self._blocked(pod)
             packed = np.packbits(
                 blocked.astype(bool).reshape(-1, self._row_len),
@@ -931,6 +957,8 @@ class TpuCluster(OverlayMixin, ClusterBase):
                 for i in range(packed.shape[0])
             ]
             self._rows[pod] = rows
+        else:
+            self._cs_rows_hit += 1
         return rows
 
     def _scan_pod_rows(self, pod: int, shape: Tuple[int, ...]) -> Optional[Tuple[int, ...]]:
@@ -1020,6 +1048,29 @@ class TpuCluster(OverlayMixin, ClusterBase):
 
     # ------------------------------------------------------------------ #
     # fragmentation / observability
+
+    def cache_stats(self) -> Dict[str, Dict[str, int]]:
+        """Unified cache telemetry (ISSUE 10): the directional allocate-
+        failure cache, the can_allocate memo, and the bitmask row table
+        (``fallback`` counts hinted/avoid-mask numpy window scans that
+        bypass the bitmask search), as ``{cache: {outcome: count}}``."""
+        return {
+            "tpu_alloc_fail": {
+                "hit": self._cs_fail_hit,
+                "miss": self._cs_fail_miss,
+                "invalidate": self._cs_fail_inval,
+            },
+            "tpu_can_allocate": {
+                "hit": self._cs_can_hit,
+                "miss": self._cs_can_miss,
+                "invalidate": self._cs_can_inval,
+            },
+            "tpu_slice_rows": {
+                "hit": self._cs_rows_hit,
+                "miss": self._cs_rows_miss,
+                "fallback": self._cs_search_fallback,
+            },
+        }
 
     def _largest_free_box(self, blocked: np.ndarray, cap: int) -> int:
         """Largest power-of-two slice placeable in one pod's ``blocked``

@@ -238,6 +238,9 @@ class NetModel:
         self._flows: List[Flow] = []
         self._flow_meta: Dict[str, Tuple[int, ...]] = {}
         self._flow_jobs: Dict[str, object] = {}
+        # flow-cache telemetry (ISSUE 10): reuses vs running-set rebuilds
+        self.flow_reuses = 0
+        self.flow_rebuilds = 0
         # Bottleneck-group partial re-solve (ISSUE 9): when the config
         # arms it, recompute() solves per connected component over
         # contended links and reuses cached group solutions whose inputs
@@ -578,10 +581,12 @@ class NetModel:
         demand = self._demand_gbps()
         reused = reuse_flows and not self._flows_dirty
         if reused:
+            self.flow_reuses += 1
             flows = self._flows
             meta = self._flow_meta
             job_by_id = self._flow_jobs
         else:
+            self.flow_rebuilds += 1
             flows = []
             meta = {}
             job_by_id = {}
@@ -717,6 +722,25 @@ class NetModel:
         self._state = state
         self._dirty = False
         return state
+
+    def cache_stats(self) -> Dict[str, Dict[str, int]]:
+        """Unified cache telemetry (ISSUE 10): the incremental-pricing
+        cache (poll hits vs full recomputes), the flow-list cache
+        (reuses vs running-set rebuilds), and — when ``partial`` armed
+        the bottleneck-group solver — group-solution reuses vs fresh
+        group solves."""
+        out = {
+            "net_price": {"hit": self.cache_hits, "miss": self.recomputes},
+            "net_flows": {
+                "hit": self.flow_reuses, "miss": self.flow_rebuilds,
+            },
+        }
+        if self._group_cache is not None:
+            out["net_partial"] = {
+                "hit": self._group_cache.reused,
+                "miss": self._group_cache.solved,
+            }
+        return out
 
     @property
     def partial_solves(self) -> int:

@@ -23,7 +23,15 @@ ROADMAP's production-scale north star):
   polarity-aware thresholds and CI exit codes, plus the n-way
   policy x metric matrix (``compare_matrix``);
 - :mod:`gpuschedule_tpu.obs.report` — one self-contained HTML report
-  (inline CSS/SVG, zero network fetches).
+  (inline CSS/SVG, zero network fetches);
+- :mod:`gpuschedule_tpu.obs.selfprof` — wall-clock phase profiler for the
+  replay loop itself (ISSUE 10): ``run --self-profile`` buckets each
+  batch's wall time into event-apply / policy / net-resolve / fault /
+  metrics / analytics phases, with a Perfetto wall-time track;
+- :mod:`gpuschedule_tpu.obs.history` — append-only sqlite store of run /
+  compare / bench summaries keyed by run_id/config_hash, with the
+  ``history trend`` CLI rendering per-metric trajectories across
+  invocations (ISSUE 10).
 
 Like the sim core, this package must stay jax-free: replay observability
 cannot pull an accelerator stack into the loop (tests/test_overhead.py
@@ -59,6 +67,13 @@ from gpuschedule_tpu.obs.compare import (
     write_matrix_json,
 )
 from gpuschedule_tpu.obs.report import render_report, write_report
+from gpuschedule_tpu.obs.selfprof import PHASES, PhaseProfiler, load_profile
+from gpuschedule_tpu.obs.history import (
+    HistoryRow,
+    HistoryStore,
+    render_trend,
+    trend_delta,
+)
 from gpuschedule_tpu.obs.perfetto import (
     export_chrome_trace,
     load_events_jsonl,
@@ -95,6 +110,13 @@ __all__ = [
     "write_matrix_json",
     "render_report",
     "write_report",
+    "PHASES",
+    "PhaseProfiler",
+    "load_profile",
+    "HistoryRow",
+    "HistoryStore",
+    "render_trend",
+    "trend_delta",
     "export_chrome_trace",
     "load_events_jsonl",
     "trace_events",

@@ -45,6 +45,7 @@ import math
 import os
 import sqlite3
 import tempfile
+from pathlib import Path
 from dataclasses import dataclass, field, fields as dataclass_fields
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
@@ -303,6 +304,9 @@ class JobSpill:
     order) survives because JSON objects preserve insertion order."""
 
     def __init__(self) -> None:
+        # spill-flush telemetry (ISSUE 10): batched INSERT count — the
+        # analyzer-side analogue of the engine caches' hit counters
+        self.flushes = 0
         self._dir = tempfile.TemporaryDirectory(prefix="gstpu-analyze-")
         self._db = sqlite3.connect(os.path.join(self._dir.name, "jobs.sqlite"))
         self._db.execute("PRAGMA journal_mode=OFF")
@@ -334,6 +338,7 @@ class JobSpill:
 
     def flush(self) -> None:
         if self._buf:
+            self.flushes += 1
             self._db.executemany(
                 "INSERT INTO jobs VALUES (?,?,?,?,?,?,?,?,?)", self._buf
             )
@@ -514,6 +519,11 @@ class RunAnalysis:
     # Empty when the run never migrated proactively.
     proactive: Dict[str, float] = field(default_factory=dict)
     mean_phys_occupancy: Optional[float] = None
+    # engine cache telemetry (ISSUE 10): the trailing ``cache`` record a
+    # cache-telemetry-armed run emits — {cache: {outcome: count}}; empty
+    # for runs captured without the flag.  The report's Engine-health
+    # panel renders the hit-rate table from it.
+    cache_stats: Dict[str, Dict[str, int]] = field(default_factory=dict)
     # bounded-memory mode (ISSUE 9): the spill store behind ``jobs`` when
     # the stream was analyzed with one — distributions() then sorts each
     # metric server-side instead of materializing value lists
@@ -771,7 +781,10 @@ class RunAnalysis:
             ),
         }
 
-    def to_json(self) -> dict:
+    def _json_head(self) -> dict:
+        """Everything :meth:`to_json` carries except the ``jobs`` array —
+        the part :meth:`write_json` serializes up front (every value here
+        is already aggregate-sized, never per-job)."""
         return {
             "header": self.header.to_json() if self.header else None,
             "num_events": self.num_events,
@@ -788,9 +801,51 @@ class RunAnalysis:
                 "n": len(self.sample_series),
                 "mean_phys_occupancy": self.mean_phys_occupancy,
             },
+            "cache_stats": self.cache_stats or None,
             "max_progress_drift": self.max_progress_drift,
+        }
+
+    def to_json(self) -> dict:
+        return {
+            **self._json_head(),
             "jobs": [r.to_json() for r in self.jobs],
         }
+
+    # ------------------------------------------------------------------ #
+
+    def write_json(self, path) -> Path:
+        """Write :meth:`to_json` to ``path`` byte-for-byte as
+        ``json.dumps(self.to_json(), indent=2, sort_keys=True)`` would —
+        but with the ``jobs`` array **streamed one record at a time**, so
+        a bounded-memory analysis (the ISSUE 9 spill store) dumps a
+        million-job document without ever materializing the job list or
+        the document string (the last PR-9 streaming gap, ISSUE 10
+        satellite).  Pinned byte-identical by tests/test_analyze_stream.
+
+        Mechanics: the document head is serialized with the ``jobs``
+        value replaced by a sentinel string, split at the sentinel, and
+        each job record is serialized independently and re-indented to
+        the depth the enclosing dump would have used — ``json.dumps``
+        with a fixed ``indent`` is position-independent, so the splice
+        reproduces the monolithic serialization exactly."""
+        sentinel = "__GSTPU_JOBS_STREAM__"
+        head = dict(self._json_head(), jobs=sentinel)
+        text = json.dumps(head, indent=2, sort_keys=True)
+        prefix, suffix = text.split(json.dumps(sentinel), 1)
+        out = Path(path)
+        if out.parent and not out.parent.exists():
+            out.parent.mkdir(parents=True, exist_ok=True)
+        with open(out, "w") as f:
+            f.write(prefix)
+            wrote = False
+            for r in self.jobs:
+                chunk = json.dumps(r.to_json(), indent=2, sort_keys=True)
+                f.write("[\n" if not wrote else ",\n")
+                f.write("\n".join("    " + ln for ln in chunk.splitlines()))
+                wrote = True
+            f.write("\n  ]" if wrote else "[]")
+            f.write(suffix)
+        return out
 
 
 # --------------------------------------------------------------------- #
@@ -866,6 +921,8 @@ def analyze_events(
     samp_acc: Optional[List[float]] = None
     # proactive checkpoint-and-migrate aggregate (ISSUE 8)
     proactive: Dict[str, float] = {}
+    # trailing engine cache-telemetry record (ISSUE 10)
+    cache_stats: Dict[str, dict] = {}
 
     used = running_n = pending_n = 0
     last_t: Optional[float] = None
@@ -1055,6 +1112,14 @@ def analyze_events(
             fault_timeline.append(entry)
             continue
         if kind == "repair":
+            continue
+        if kind == "cache":
+            # trailing cache-telemetry table (ISSUE 10): the engine's
+            # unified {cache: {outcome: count}} harvest — a later record
+            # (one per run in practice) replaces an earlier one wholesale
+            caches = ev.get("caches")
+            if isinstance(caches, dict):
+                cache_stats = caches
             continue
         if kind == "netlink":
             name = str(ev.get("link", "?"))
@@ -1339,6 +1404,7 @@ def analyze_events(
         sample_series=sample_series,
         mean_phys_occupancy=mean_phys,
         proactive=proactive,
+        cache_stats=cache_stats,
         _spill=spill,
     )
     return analysis

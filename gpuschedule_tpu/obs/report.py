@@ -638,6 +638,91 @@ def _slowest_jobs_table(analysis: RunAnalysis, n: int = 10) -> str:
     )
 
 
+def _cache_table(cache_stats: dict) -> str:
+    """The Engine-health cache hit-rate table (ISSUE 10): one row per
+    unified cache with its hit/miss/other counts and the hit rate over
+    hits + misses (invalidate/fallback are listed but excluded from the
+    rate — they are lifecycle events, not lookups)."""
+    rows = []
+    for name in sorted(cache_stats):
+        outcomes = cache_stats[name] or {}
+        hit = float(outcomes.get("hit", 0))
+        miss = float(outcomes.get("miss", 0))
+        other = {
+            k: v for k, v in sorted(outcomes.items())
+            if k not in ("hit", "miss")
+        }
+        lookups = hit + miss
+        rate = (hit / lookups) if lookups > 0 else None
+        other_s = (
+            ", ".join(f"{k} {_fmt_num(float(v))}" for k, v in other.items())
+            or "–"
+        )
+        rows.append(
+            f"<tr><td>{_esc(name)}</td><td>{_esc(_fmt_num(hit))}</td>"
+            f"<td>{_esc(_fmt_num(miss))}</td>"
+            f"<td>{_esc(_fmt_pct(rate))}</td>"
+            f"<td>{_esc(other_s)}</td></tr>"
+        )
+    return (
+        "<table><thead><tr><th>cache</th><th>hits</th><th>misses</th>"
+        "<th>hit rate</th><th>other events</th></tr></thead>"
+        f"<tbody>{''.join(rows)}</tbody></table>"
+    )
+
+
+def _engine_health_panel(
+    analysis: RunAnalysis, selfprof: Optional[dict]
+) -> str:
+    """The engine's view of itself (ISSUE 10): where the replay's *wall*
+    time went (the self-profile phase decomposition) and whether the
+    PR-7/9 caches are still earning their keep (hit-rate table from the
+    run's trailing ``cache`` record).  Absent when the run carried
+    neither signal."""
+    cache_stats = getattr(analysis, "cache_stats", None) or {}
+    if not selfprof and not cache_stats:
+        return ""
+    parts = ['<h2>Engine health</h2>\n<div class="panel">']
+    if selfprof:
+        phases = selfprof.get("phases", {})
+        # pipeline order (obs/selfprof.py PHASES), not the JSON
+        # document's alphabetical key order; unknown names trail
+        from gpuschedule_tpu.obs.selfprof import PHASES as _PHASE_ORDER
+
+        ordered = [p for p in _PHASE_ORDER if p in phases] + [
+            p for p in sorted(phases) if p not in _PHASE_ORDER
+        ]
+        legs = [
+            (name, float(phases[name].get("total_s", 0.0)))
+            for name in ordered
+        ]
+        total = selfprof.get("total_wall_s")
+        batches = selfprof.get("batches")
+        meta = []
+        if total is not None:
+            meta.append(f"replay wall time {_esc(_fmt_dur(float(total)))}")
+        if batches:
+            meta.append(f"{int(batches):,} batches")
+            if total:
+                meta.append(f"{_esc(_fmt_num(batches / total))} batches/s")
+        parts.append(
+            f'<p class="meta">{" · ".join(meta)} — wall-clock phase '
+            f"decomposition (run --self-profile)</p>"
+        )
+        parts.append(_stacked_bar(
+            legs, label="replay wall time by phase", unit="s",
+            empty_note="no wall time recorded",
+        ))
+    if cache_stats:
+        parts.append(
+            '<p class="meta">engine cache telemetry '
+            "(engine_cache_events)</p>"
+        )
+        parts.append(_cache_table(cache_stats))
+    parts.append("</div>")
+    return "\n".join(parts)
+
+
 def _occupancy_chart(
     analysis: RunAnalysis,
     occ_pts: List[Tuple[float, float]],
@@ -669,9 +754,17 @@ def _occupancy_chart(
     )
 
 
-def render_report(analysis: RunAnalysis, *, title: Optional[str] = None) -> str:
+def render_report(
+    analysis: RunAnalysis,
+    *,
+    title: Optional[str] = None,
+    selfprof: Optional[dict] = None,
+) -> str:
     """The whole report as one HTML string (write it anywhere; it never
-    references the network or the filesystem)."""
+    references the network or the filesystem).  ``selfprof`` (the
+    summary block of a ``run --self-profile`` document, via
+    ``report --selfprof``) adds the wall-clock phase bar to the
+    Engine-health panel."""
     h = analysis.header
     s = analysis.summary()
     dists = analysis.distributions()
@@ -885,6 +978,7 @@ def render_report(analysis: RunAnalysis, *, title: Optional[str] = None) -> str:
 {attrib_panel}
 {net_panel}
 {fault_panel}
+{_engine_health_panel(analysis, selfprof)}
 <h2>Distributions</h2>
 <div class="panel">{_dist_table(dists)}</div>
 
@@ -897,9 +991,15 @@ def render_report(analysis: RunAnalysis, *, title: Optional[str] = None) -> str:
 """
 
 
-def write_report(analysis: RunAnalysis, path, *, title: Optional[str] = None) -> Path:
+def write_report(
+    analysis: RunAnalysis,
+    path,
+    *,
+    title: Optional[str] = None,
+    selfprof: Optional[dict] = None,
+) -> Path:
     out = Path(path)
     if out.parent and not out.parent.exists():
         out.parent.mkdir(parents=True, exist_ok=True)
-    out.write_text(render_report(analysis, title=title))
+    out.write_text(render_report(analysis, title=title, selfprof=selfprof))
     return out

@@ -20,6 +20,7 @@ from __future__ import annotations
 import heapq
 import itertools
 import math
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
@@ -83,6 +84,8 @@ class Simulator:
         faults=None,
         net=None,
         sample_interval: Optional[float] = None,
+        sample_on_change: bool = False,
+        profiler=None,
     ):
         self.cluster = cluster
         self.policy = policy
@@ -149,6 +152,36 @@ class Simulator:
                 f"sample_interval must be > 0, got {sample_interval}"
             )
         self.sample_interval = sample_interval
+        # On-change sampling (ISSUE 10 satellite, retiring the PR-5
+        # "sampling is time-driven only" omission): emit a cluster
+        # ``sample`` event whenever a batch changed the health/degrade
+        # masks (fault, repair, straggler onset/recovery, domain outage)
+        # — in addition to (and independent of) the periodic timer.  The
+        # sample lands after the batch's fault/repair records and before
+        # the policy pass's reactions, the same instant the timer-driven
+        # sampler would snapshot; like it, it observes without dirtying,
+        # so the lifecycle stream stays byte-identical modulo the sample
+        # records themselves.
+        self.sample_on_change = bool(sample_on_change)
+        # bumped by every health/degrade-mask transition (chip/domain
+        # fault, straggler onset/recovery, mask repair) — NOT by link
+        # faults (net-model state, no cluster mask moves) or warnings
+        self._mask_mut = 0
+        # Wall-clock phase profiler (ISSUE 10 tentpole): when attached,
+        # run() selects the _run_profiled loop body — the plain loop with
+        # two perf_counter reads per segment; detached (the default) no
+        # code path ever reads a clock (the check_overhead.py contract).
+        self._profiler = profiler
+        # Cache telemetry (ISSUE 10 tentpole): when the metrics log arms
+        # it, the end of the run harvests every PR-7/9 cache's hit/miss
+        # counters (cluster allocate caches, net pricing/flow/group
+        # caches, engine memos) into labeled engine_cache_events metrics,
+        # summary counters, and one trailing "cache" stream record.  Off
+        # (the default) nothing is harvested and the summary/stream stay
+        # byte-identical.
+        self._cache_telemetry = bool(
+            getattr(self.metrics, "cache_telemetry", False)
+        )
         # Observability (obs/): the span tracer is a process singleton whose
         # ``enabled`` flag picks the run loop — the disabled path is the
         # uninstrumented loop verbatim (tools/check_overhead.py guards that
@@ -177,9 +210,12 @@ class Simulator:
         # Keyed by object identity; values iterated in run_seq order.
         self._net_members: Dict[int, Job] = {}
         # engine-mutation counter + memo for the _quiesced endgame scan
-        # (every job.epoch bump increments it; see _quiesced)
+        # (every job.epoch bump increments it; see _quiesced); hit/miss
+        # counts feed the ISSUE 10 cache telemetry
         self._mut = 0
         self._stall_memo: tuple = ()
+        self._stall_hits = 0
+        self._stall_misses = 0
         if self.sample_interval is not None:
             # first sample one interval in (a t=0 sample of an empty
             # cluster carries no information)
@@ -907,6 +943,7 @@ class Simulator:
             self._apply_straggler(rec)
             return
         victim_ids = self.cluster.mark_unhealthy(rec.scope)
+        self._mask_mut += 1  # health mask moved (on-change sampling)
         self.metrics.count("faults")
         self.metrics.count(f"faults_{rec.kind}")
         if self.metrics.record_events:
@@ -986,6 +1023,7 @@ class Simulator:
             self.metrics.count("straggler_faults_inert")
         else:
             touched = mark(rec.scope, rec.degrade)
+            self._mask_mut += 1  # degrade mask moved (on-change sampling)
             self._apply_slow_factors(touched)
         if math.isfinite(rec.duration):
             self._push(self.now + max(0.0, rec.duration), _REPAIR, rec)
@@ -1147,6 +1185,37 @@ class Simulator:
                 track=track, prog=_prog(job), **extra,
             )
 
+    def _apply_repair(self, payload, t: float) -> None:
+        """One repair record: heal the link / degrade mask / health mask
+        (hoisted verbatim out of ``_drain_batch`` so the profiled loop
+        can time it as fault dispatch with one wrapper)."""
+        if payload.scope and payload.scope[0] == "link":
+            # uplink outages live in the net model, not the chip
+            # health mask (nothing was marked unhealthy)
+            if self.net is not None:
+                self.net.repair_link(int(payload.scope[1]),
+                                     payload.degrade,
+                                     key=id(payload))
+        elif payload.kind == "straggler":
+            # straggler recovery lives in the degrade mask, not
+            # the health mask; gangs on the healed unit speed
+            # back up through the same slow-factor re-derivation
+            if hasattr(self.cluster, "clear_degraded"):
+                touched = self.cluster.clear_degraded(
+                    payload.scope, payload.degrade
+                )
+                self._mask_mut += 1
+                self._apply_slow_factors(touched)
+        else:
+            self.cluster.repair(payload.scope)
+            self._mask_mut += 1
+        self.metrics.count("repairs")
+        if self.metrics.record_events:
+            self.metrics.event(
+                "repair", t, None, scope=payload.label,
+                fault=payload.kind, fid=self._fault_ids[id(payload)],
+            )
+
     def _victim_jobs(self, alloc_ids) -> List[Job]:
         """Resolve a cluster-reported alloc_id list to the running jobs
         holding them, in running-set iteration order (ascending run_seq) —
@@ -1159,9 +1228,14 @@ class Simulator:
         victims.sort(key=lambda j: j.run_seq)
         return victims
 
-    def _drain_batch(self, t: float) -> bool:
+    def _drain_batch(self, t: float, prof=None) -> bool:
         """Pop and apply every event at or before ``t``; True if any event
-        changed scheduler-visible state (the policy must then run)."""
+        changed scheduler-visible state (the policy must then run).
+
+        ``prof`` (the profiled loop only) times fault/warning/repair
+        dispatch as its own phase — the cold branches check it once per
+        fault event; the plain/traced loops never pass it, so the hot
+        arrival/completion branches are untouched."""
         dirty = False
         heap = self._heap
         heappop = heapq.heappop
@@ -1240,38 +1314,27 @@ class Simulator:
                 self._finish(job)
                 dirty = True
             elif kind == _FAULT:
-                self._apply_fault(payload)
+                if prof is not None:
+                    with prof.phase("fault_dispatch"):
+                        self._apply_fault(payload)
+                else:
+                    self._apply_fault(payload)
                 dirty = True
             elif kind == _WARN:
                 # spot pre-revoke notice (ISSUE 6): may charge emergency
                 # checkpoint overhead, so the policy gets a pass after it
-                self._apply_warning(payload)
+                if prof is not None:
+                    with prof.phase("fault_dispatch"):
+                        self._apply_warning(payload)
+                else:
+                    self._apply_warning(payload)
                 dirty = True
             elif kind == _REPAIR:
-                if payload.scope and payload.scope[0] == "link":
-                    # uplink outages live in the net model, not the chip
-                    # health mask (nothing was marked unhealthy)
-                    if self.net is not None:
-                        self.net.repair_link(int(payload.scope[1]),
-                                             payload.degrade,
-                                             key=id(payload))
-                elif payload.kind == "straggler":
-                    # straggler recovery lives in the degrade mask, not
-                    # the health mask; gangs on the healed unit speed
-                    # back up through the same slow-factor re-derivation
-                    if hasattr(self.cluster, "clear_degraded"):
-                        touched = self.cluster.clear_degraded(
-                            payload.scope, payload.degrade
-                        )
-                        self._apply_slow_factors(touched)
+                if prof is not None:
+                    with prof.phase("fault_dispatch"):
+                        self._apply_repair(payload, t)
                 else:
-                    self.cluster.repair(payload.scope)
-                self.metrics.count("repairs")
-                if self.metrics.record_events:
-                    self.metrics.event(
-                        "repair", t, None, scope=payload.label,
-                        fault=payload.kind, fid=self._fault_ids[id(payload)],
-                    )
+                    self._apply_repair(payload, t)
                 dirty = True  # restored capacity: waiters may now place
             else:  # _TICK
                 dirty = True
@@ -1280,11 +1343,17 @@ class Simulator:
     def run(self) -> SimResult:
         """Drive the event loop to completion and return summary metrics.
 
-        Two bodies, one behavior: the traced loop wraps each event batch and
-        policy invocation in tracer spans (dual wall/sim clocks); the plain
-        loop is the uninstrumented hot path, selected when the tracer is
-        disabled so replay pays nothing for the telemetry layer's existence
-        (the tools/check_overhead.py contract)."""
+        Three bodies, one behavior: the profiled loop (ISSUE 10) buckets
+        each batch's wall time into replay phases, the traced loop wraps
+        each event batch and policy invocation in tracer spans (dual
+        wall/sim clocks), and the plain loop is the uninstrumented hot
+        path, selected when both are off so replay pays nothing for the
+        telemetry layer's existence (the tools/check_overhead.py
+        contract).  A profiler takes precedence over the tracer — the
+        phase buckets ARE the wall-clock story; per-batch spans on top
+        would double the clock reads they measure."""
+        if self._profiler is not None:
+            return self._run_profiled()
         if self._tracer.enabled:
             return self._run_traced()
         return self._run_plain()
@@ -1355,11 +1424,13 @@ class Simulator:
         key = (len(self.finished), len(self.running), self._mut)
         memo = self._stall_memo
         if memo and memo[0] == key:
+            self._stall_hits += 1
             return memo[1]
         stalled = all(
             j.remaining_runtime() == math.inf for j in self.running
         )
         self._stall_memo = (key, stalled)
+        self._stall_misses += 1
         return stalled
 
     def _run_plain(self) -> SimResult:
@@ -1374,6 +1445,7 @@ class Simulator:
         running, pending = self.running, self.pending
         policy_schedule = self.policy.schedule
         metrics_sample = self.metrics.sample
+        soc = self.sample_on_change
         while heap:
             if self._quiesced():
                 break  # only fault/repair/tick residue past the last job
@@ -1403,7 +1475,14 @@ class Simulator:
                 # chip-second integral is exact piecewise
                 hazard.observe(t, cluster)
             self._advance_running(t)
+            mm = self._mask_mut
             if self._drain_batch(t):
+                if soc and self._mask_mut != mm:
+                    # on-change sample (ISSUE 10 satellite): the batch
+                    # touched a health/degrade mask — snapshot the
+                    # post-fault, pre-policy cluster state, exactly where
+                    # a coinciding timer sample would land
+                    self._emit_sample(t)
                 wakeup = policy_schedule(self)
                 if wakeup is not None:
                     self.request_wakeup(wakeup)
@@ -1413,6 +1492,8 @@ class Simulator:
         if self.net is not None:
             self.net.close(self.now)
         self._close_attribution()
+        if self._cache_telemetry:
+            self._harvest_cache_stats()
         return self.metrics.result(self.jobs, self.now)
 
     def _run_traced(self) -> SimResult:
@@ -1440,8 +1521,11 @@ class Simulator:
                     self.hazard.observe(t, self.cluster)
                 with tracer.span("sim.batch", cat="sim", sim_now=t) as sp:
                     self._advance_running(t)
+                    mm = self._mask_mut
                     dirty = self._drain_batch(t)
                     if dirty:
+                        if self.sample_on_change and self._mask_mut != mm:
+                            self._emit_sample(t)
                         with tracer.span(
                             "policy.schedule", cat="policy", sim_now=t,
                             policy=self.policy.name,
@@ -1465,4 +1549,135 @@ class Simulator:
         if self.net is not None:
             self.net.close(self.now)
         self._close_attribution()
+        if self._cache_telemetry:
+            self._harvest_cache_stats()
         return self.metrics.result(self.jobs, self.now)
+
+    def _run_profiled(self) -> SimResult:
+        """The ISSUE 10 self-profiling loop body: the plain loop's exact
+        call sequence with each segment's wall time charged to a replay
+        phase (obs/selfprof.py PHASES).  Replay behavior is byte-
+        identical to the plain loop — the clock reads observe, they never
+        steer — pinned by tests/test_selfprof.py.
+
+        Phase accounting: fault/warning/repair dispatch is timed inside
+        ``_drain_batch`` (the ``prof`` parameter) and subtracted from the
+        surrounding event-apply segment, so phases are disjoint; the
+        un-segmented residue (heap peeks, the quiescence test, loop
+        dispatch) lands in ``other`` at :meth:`PhaseProfiler.finish`, so
+        the phase totals sum to the measured total exactly."""
+        prof = self._profiler
+        perf = time.perf_counter
+        prof.start(policy=self.policy.name, jobs=len(self.jobs))
+        heap = self._heap
+        max_time = self.max_time
+        net = self.net
+        hazard = self.hazard
+        cluster = self.cluster
+        running, pending = self.running, self.pending
+        policy_schedule = self.policy.schedule
+        metrics_sample = self.metrics.sample
+        soc = self.sample_on_change
+        p_advance = prof.phase("advance")
+        p_policy = prof.phase("policy_schedule")
+        p_net = prof.phase("net_resolve")
+        p_metrics = prof.phase("metrics_emit")
+        fault_totals = prof.totals  # read fault_dispatch between clock reads
+        while heap:
+            if self._quiesced():
+                break  # only fault/repair/tick residue past the last job
+            head = heap[0]
+            t = head[0]
+            if t > max_time:
+                with p_metrics:
+                    self._cutoff_at_horizon()
+                break
+            self.now = t
+            if head[1] == _SAMPLE:
+                # pure-sample batch: same skip as the plain loop (no
+                # advance, no metrics.sample, no policy); sample batches
+                # can never contain faults (_SAMPLE sorts last), so the
+                # whole drain is event application
+                t0 = perf()
+                self._drain_batch(t)
+                prof.add("event_apply", perf() - t0)
+                prof.batch_done()
+                continue
+            with p_advance:
+                if hazard is not None:
+                    hazard.observe(t, cluster)
+                self._advance_running(t)
+            mm = self._mask_mut
+            f0 = fault_totals["fault_dispatch"]
+            t0 = perf()
+            dirty = self._drain_batch(t, prof=prof)
+            prof.add(
+                "event_apply",
+                (perf() - t0) - (fault_totals["fault_dispatch"] - f0),
+            )
+            if dirty:
+                if soc and self._mask_mut != mm:
+                    with p_metrics:
+                        self._emit_sample(t)
+                with p_policy:
+                    wakeup = policy_schedule(self)
+                if wakeup is not None:
+                    self.request_wakeup(wakeup)
+                if net is not None:
+                    with p_net:
+                        self._net_update()
+            with p_metrics:
+                metrics_sample(self.now, cluster, len(running), len(pending))
+            prof.batch_done()
+        if self.net is not None:
+            self.net.close(self.now)
+        with p_metrics:
+            self._close_attribution()
+        if self._cache_telemetry:
+            self._harvest_cache_stats()
+        with prof.phase("analytics"):
+            res = self.metrics.result(self.jobs, self.now)
+        prof.finish()
+        return res
+
+    # ------------------------------------------------------------------ #
+    # cache telemetry (ISSUE 10 tentpole)
+
+    def cache_stats(self) -> Dict[str, Dict[str, int]]:
+        """Every engine-visible cache's event counts, unified as
+        ``{cache: {outcome: count}}`` — the PR-7/9 lattice made
+        observable: the fabric pricing / flow-list / bottleneck-group
+        caches (net/), the TPU allocate-failure cache, can_allocate memo
+        and bitmask row cache (cluster/tpu.py), and the engine's own
+        quiescence memo.  Sources that were never armed (no net model, a
+        non-TPU cluster) simply contribute nothing."""
+        stats: Dict[str, Dict[str, int]] = {}
+        cluster = getattr(self.cluster, "inner", self.cluster)
+        for source in (cluster, self.net):
+            get = getattr(source, "cache_stats", None)
+            if get is not None:
+                for name, outcomes in get().items():
+                    stats[name] = dict(outcomes)
+        stats["quiesce_memo"] = {
+            "hit": self._stall_hits, "miss": self._stall_misses,
+        }
+        return stats
+
+    def _harvest_cache_stats(self) -> None:
+        """End-of-run: fold :meth:`cache_stats` into summary counters
+        (``cache_<name>_<outcome>``), the labeled registry metric
+        (``engine_cache_events{cache,outcome}``), and — when the event
+        stream is on — one trailing ``cache`` record the analyzer turns
+        into the report's Engine-health table."""
+        stats = self.cache_stats()
+        emit = {}
+        for name in sorted(stats):
+            outcomes = stats[name]
+            kept = {k: int(v) for k, v in sorted(outcomes.items()) if v}
+            if not kept:
+                continue
+            emit[name] = kept
+            for outcome, n in kept.items():
+                self.metrics.cache_event(name, outcome, n)
+        if self.metrics.record_events:
+            self.metrics.event("cache", self.now, None, caches=emit)

@@ -162,8 +162,18 @@ class MetricsLog:
         registry=None,
         run_meta: Optional[dict] = None,
         attribution: bool = False,
+        cache_telemetry: bool = False,
     ) -> None:
         self.job_rows: List[dict] = []
+        # Cache telemetry (ISSUE 10): when armed, the engine harvests
+        # every PR-7/9 cache's hit/miss/invalidate counts at the end of
+        # the run through :meth:`cache_event` — summary counters gain
+        # ``cache_<name>_<outcome>`` keys, the registry (when attached)
+        # gains the labeled ``engine_cache_events`` family, and the event
+        # stream a trailing ``cache`` record.  Off (the default) the
+        # summary/stream/registry stay byte-identical to pre-telemetry.
+        self.cache_telemetry = bool(cache_telemetry)
+        self._reg_cache_events = None
         # Causal attribution (ISSUE 5): when True the engine blames every
         # queued interval with its cause, splits running time into
         # slowdown legs (sim/job.py WAIT_CAUSES / RUN_LEGS), and stamps
@@ -261,6 +271,23 @@ class MetricsLog:
                     f"sim_{key}_total", "engine counter (MetricsLog)")
                 self._reg_counters[key] = c
             c.inc(n)
+
+    def cache_event(self, cache: str, outcome: str, n: int = 1) -> None:
+        """One unified cache-telemetry event (ISSUE 10): mirrors into the
+        plain summary counter ``cache_<cache>_<outcome>`` and, with a
+        registry attached, the labeled counter family
+        ``engine_cache_events{cache=...,outcome=...}`` — one surface for
+        what used to be ad-hoc per-subsystem counters."""
+        self.counters[f"cache_{cache}_{outcome}"] += n
+        if self._registry is not None:
+            if self._reg_cache_events is None:
+                self._reg_cache_events = self._registry.counter(
+                    "engine_cache_events",
+                    "engine cache events by cache and outcome "
+                    "(hit / miss / invalidate / fallback)",
+                    labelnames=("cache", "outcome"),
+                )
+            self._reg_cache_events.labels(cache, outcome).inc(n)
 
     def _sink(self) -> Optional[IO]:
         if self._sink_fh is not None:

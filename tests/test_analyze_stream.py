@@ -127,3 +127,20 @@ def test_cli_compare_gzip_streams(stream, tmp_path, capsys):
     sink, gz = stream
     assert main(["compare", str(sink), str(gz), "--low-mem"]) == 0
     capsys.readouterr()
+
+
+def test_write_json_streams_jobs_byte_identical(stream, tmp_path):
+    """The ISSUE 10 spill-backed `report --json` satellite on the
+    feature-loaded stream: the streamed serialization (jobs array
+    written record by record, straight from the sqlite store in low-mem
+    mode) is byte-identical to the monolithic
+    ``json.dumps(to_json(), indent=2, sort_keys=True)`` dump."""
+    sink, _ = stream
+    a = analyze_file(sink)
+    b = analyze_file(sink, low_memory=True)
+    assert isinstance(b.jobs, SpilledJobs)
+    ref = json.dumps(a.to_json(), indent=2, sort_keys=True)
+    pa = a.write_json(tmp_path / "a.json")
+    pb = b.write_json(tmp_path / "b.json")
+    assert pa.read_text() == ref
+    assert pb.read_text() == ref

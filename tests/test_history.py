@@ -1,0 +1,207 @@
+"""Cross-run history store (ISSUE 10): round-trip, config-hash keying,
+trend determinism across separate invocations, the bench trend delta,
+and the spill-backed streaming ``report --json`` satellite."""
+
+from __future__ import annotations
+
+import json
+import math
+
+import pytest
+
+from gpuschedule_tpu.cli import main
+from gpuschedule_tpu.obs.history import (
+    HistoryStore,
+    render_trend,
+    trend_delta,
+    trend_points,
+)
+
+
+# --------------------------------------------------------------------- #
+# store semantics
+
+
+def test_round_trip(tmp_path):
+    path = tmp_path / "h.sqlite"
+    with HistoryStore(path) as store:
+        seq = store.append(
+            "run", run_id="fifo-s0-abc", config_hash="abc", policy="fifo",
+            seed=0, metrics={"avg_jct": 123.456, "num_finished": 10,
+                             "note": "x", "inf_val": math.inf},
+        )
+        assert seq == 1
+    # a separate open reads the identical row (append-only durability)
+    with HistoryStore(path) as store:
+        rows = store.rows()
+        assert len(rows) == 1
+        r = rows[0]
+        assert (r.seq, r.kind, r.run_id, r.config_hash, r.policy, r.seed) == (
+            1, "run", "fifo-s0-abc", "abc", "fifo", 0
+        )
+        assert r.metrics["avg_jct"] == 123.456
+        assert r.metrics["inf_val"] == "inf"  # strict-JSON coercion
+        assert r.metric("avg_jct") == 123.456
+        assert r.metric("note") is None       # non-numeric -> no trend point
+        assert r.metric("missing") is None
+
+
+def test_config_hash_keying(tmp_path):
+    with HistoryStore(tmp_path / "h.sqlite") as store:
+        for i, chash in enumerate(("aaa", "bbb", "aaa")):
+            store.append("run", config_hash=chash, policy="fifo",
+                         metrics={"avg_jct": float(i)})
+        store.append("bench", label="plain/1000",
+                     metrics={"jobs_per_s": 2000.0})
+        aaa = store.rows(config_hash="aaa")
+        assert [r.metric("avg_jct") for r in aaa] == [0.0, 2.0]
+        assert [r.seq for r in aaa] == [1, 3]
+        assert len(store.rows(kind="bench")) == 1
+        assert len(store.rows(kind="run", config_hash="bbb")) == 1
+        assert store.rows(label="plain/1000")[0].metric("jobs_per_s") == 2000.0
+        assert [r.seq for r in store.rows(last=2)] == [3, 4]
+
+
+def test_trend_determinism_across_invocations(tmp_path):
+    path = tmp_path / "h.sqlite"
+    with HistoryStore(path) as store:
+        for v in (10.0, 12.0, 11.0):
+            store.append("run", config_hash="c", policy="dlas",
+                         metrics={"avg_jct": v, "makespan": v * 10})
+    # two fully separate opens render identical bytes
+    with HistoryStore(path) as s1:
+        t1 = render_trend(s1.rows(), ["avg_jct", "makespan"])
+    with HistoryStore(path) as s2:
+        t2 = render_trend(s2.rows(), ["avg_jct", "makespan"])
+    assert t1 == t2
+    lines = t1.splitlines()
+    assert len(lines) == 5  # header + rule + 3 rows
+    # step deltas: 10 -> 12 is +20.0%, 12 -> 11 is -8.3%
+    assert "+20.0" in lines[3] and "-8.3" in lines[4]
+    assert render_trend([], ["avg_jct"]) == "(empty history)"
+
+
+def test_trend_delta_median_arithmetic(tmp_path):
+    with HistoryStore(tmp_path / "h.sqlite") as store:
+        for v in (100.0, 300.0, 200.0, 260.0):
+            store.append("bench", label="plain/1000",
+                         metrics={"jobs_per_s": v})
+        rows = store.rows(label="plain/1000")
+    d = trend_delta(rows, "jobs_per_s", last=3)
+    # prior = [100, 300, 200] -> median 200; newest 260 -> +30%
+    assert d["median"] == 200.0
+    assert d["value"] == 260.0
+    assert d["n_prior"] == 3
+    assert d["delta_frac"] == pytest.approx(0.3)
+    # only one row: no prior history, no delta
+    assert trend_delta(rows[:1], "jobs_per_s") is None
+    assert trend_delta([], "jobs_per_s") is None
+    assert trend_points(rows, "nope") == []
+
+
+# --------------------------------------------------------------------- #
+# CLI surfaces
+
+
+def _run_args(store, seed):
+    return ["run", "--synthetic", "10", "--seed", str(seed),
+            "--cluster", "tpu-v5e", "--dims", "4x4",
+            "--history", str(store)]
+
+
+def test_cli_run_appends_and_history_list(tmp_path, capsys):
+    store = tmp_path / "h.sqlite"
+    assert main(_run_args(store, 1)) == 0
+    assert main(_run_args(store, 2)) == 0
+    capsys.readouterr()
+    out_json = tmp_path / "rows.json"
+    assert main(["history", "list", "--store", str(store),
+                 "--json", str(out_json)]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 2
+    row0 = json.loads(out[0])
+    assert row0["kind"] == "run" and row0["policy"] == "fifo"
+    assert row0["seq"] == 1
+    rows = json.loads(out_json.read_text())
+    assert len(rows) == 2 and rows[0]["metrics"]["num_finished"] >= 0
+    # same seed, same world: config hashes match; different seeds differ
+    assert rows[0]["config_hash"] != rows[1]["config_hash"]
+
+
+def test_cli_history_missing_store_errors(tmp_path):
+    with pytest.raises(SystemExit):
+        main(["history", "trend", "--store", str(tmp_path / "nope.sqlite")])
+
+
+def test_cli_compare_appends_history(tmp_path, capsys):
+    store = tmp_path / "h.sqlite"
+    ev_a = tmp_path / "a.jsonl"
+    ev_b = tmp_path / "b.jsonl"
+    base = ["run", "--synthetic", "30", "--seed", "5",
+            "--cluster", "tpu-v5e", "--dims", "4x4"]
+    assert main(base + ["--events", str(ev_a)]) == 0
+    assert main(base + ["--policy", "srtf", "--events", str(ev_b)]) == 0
+    rc = main(["compare", str(ev_a), str(ev_b),
+               "--threshold", "10.0", "--history", str(store)])
+    assert rc in (0, 1)  # gate verdict either way; history rides along
+    capsys.readouterr()
+    with HistoryStore(store) as s:
+        rows = s.rows(kind="compare")
+    assert len(rows) == 2
+    assert rows[0].policy == "fifo" and rows[1].policy == "srtf"
+    # both streams replayed the same world -> same config hash, so a
+    # config-keyed trend sees both invocations
+    assert rows[0].config_hash == rows[1].config_hash != ""
+    assert main(["history", "trend", "--store", str(store),
+                 "--config", rows[0].config_hash]) == 0
+    t = capsys.readouterr().out
+    assert t.count("\n") >= 4
+
+
+def test_engine_bench_history_trend(tmp_path, capsys):
+    import sys
+    sys.path.insert(0, "tools")
+    try:
+        import engine_bench
+    finally:
+        sys.path.pop(0)
+    store = tmp_path / "bench.sqlite"
+    argv = ["--sizes", "300", "--configs", "plain", "--no-isolate",
+            "--no-gate", "--history", str(store)]
+    assert engine_bench.main(argv) == 0
+    assert engine_bench.main(argv) == 0
+    capsys.readouterr()
+    with HistoryStore(store) as s:
+        rows = s.rows(kind="bench", label="plain/300")
+    assert len(rows) == 2
+    assert all(r.metric("jobs_per_s") > 0 for r in rows)
+    d = trend_delta(rows, "jobs_per_s")
+    assert d is not None and d["n_prior"] == 1
+
+
+# --------------------------------------------------------------------- #
+# spill-backed streaming report --json (ISSUE 10 satellite)
+
+
+def test_report_json_streams_byte_identical(tmp_path, capsys):
+    from gpuschedule_tpu.obs.analyze import analyze_file
+
+    ev = tmp_path / "e.jsonl"
+    assert main(["run", "--synthetic", "40", "--seed", "9",
+                 "--cluster", "tpu-v5e", "--dims", "4x4", "--attrib",
+                 "--faults", "mtbf=20000,repair=1200",
+                 "--events", str(ev)]) == 0
+    capsys.readouterr()
+    j_mem = tmp_path / "mem.json"
+    j_low = tmp_path / "low.json"
+    assert main(["report", "--events", str(ev),
+                 "--out", str(tmp_path / "a.html"), "--json", str(j_mem)]) == 0
+    assert main(["report", "--events", str(ev), "--low-mem",
+                 "--out", str(tmp_path / "b.html"), "--json", str(j_low)]) == 0
+    capsys.readouterr()
+    assert j_mem.read_text() == j_low.read_text()
+    # and both equal the monolithic serialization
+    a = analyze_file(ev)
+    assert j_mem.read_text() == json.dumps(
+        a.to_json(), indent=2, sort_keys=True
+    )

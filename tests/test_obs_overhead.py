@@ -28,7 +28,9 @@ def test_guard_plumbing_smoke():
     assert res["ok"] is True
     for key in ("baseline_s", "disabled_s", "enabled_s", "sampling_s",
                 "disabled_over_baseline", "enabled_over_baseline",
-                "sampling_over_baseline"):
+                "sampling_over_baseline",
+                "selfprof_off_s", "selfprof_off_over_baseline",
+                "selfprof_on_s", "selfprof_on_over_baseline"):
         assert res[key] > 0
     # the guard must leave the process-wide tracer off for later tests
     from gpuschedule_tpu.obs import get_tracer
@@ -38,12 +40,14 @@ def test_guard_plumbing_smoke():
 
 @pytest.mark.slow
 def test_disabled_telemetry_has_no_measurable_overhead():
-    """Acceptance gate: a 1k-job replay with telemetry disabled — and with
-    sampling armed but events off (ISSUE 5) — stays within 2% of the
+    """Acceptance gate: a 1k-job replay with telemetry disabled — with
+    sampling armed but events off (ISSUE 5), and with the self-profile
+    knob at its default-off (ISSUE 10) — stays within 2% of the
     uninstrumented loop body."""
     res = run_guard()
     assert res["ok"], (
         f"telemetry-disabled path is {res['disabled_over_baseline']:.3f}x, "
-        f"sampling path {res['sampling_over_baseline']:.3f}x baseline "
-        f"(tolerance {res['tolerance']}): {res}"
+        f"sampling path {res['sampling_over_baseline']:.3f}x, "
+        f"selfprof-off path {res['selfprof_off_over_baseline']:.3f}x "
+        f"baseline (tolerance {res['tolerance']}): {res}"
     )

@@ -1,0 +1,285 @@
+"""Engine self-observability (ISSUE 10): the wall-clock phase profiler,
+unified cache telemetry, and on-change sampling.
+
+The load-bearing contracts:
+
+- a profiled replay is **byte-identical** to the plain one (the clock
+  reads observe, never steer), and its phase wall times sum to the total
+  replay wall time exactly;
+- cache telemetry off, the summary/stream are byte-identical to
+  pre-telemetry; on, every PR-7/9 cache reports a nonzero hit count on a
+  workload that exercises it;
+- ``--sample-on-change`` adds ``sample`` records at health/degrade-mask
+  transitions without perturbing a single lifecycle record;
+- the tier-1 CLI smoke drives ``run --self-profile`` + ``history trend``
+  end to end on a 12-job trace.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from gpuschedule_tpu.cli import main
+from gpuschedule_tpu.cluster.tpu import TpuCluster
+from gpuschedule_tpu.faults import FaultPlan, RecoveryModel
+from gpuschedule_tpu.faults.schedule import FaultConfig, generate_fault_schedule
+from gpuschedule_tpu.net.model import NetConfig, NetModel
+from gpuschedule_tpu.net.sweep import promote_to_multislice
+from gpuschedule_tpu.obs import PHASES, PhaseProfiler, load_profile
+from gpuschedule_tpu.obs.analyze import analyze_file
+from gpuschedule_tpu.obs.perfetto import validate_chrome_trace
+from gpuschedule_tpu.obs.report import render_report
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.sim import Simulator
+from gpuschedule_tpu.sim.metrics import MetricsLog
+from gpuschedule_tpu.sim.philly import generate_philly_like_trace
+
+
+def _world(seed=11, num_jobs=120, partial=False):
+    """One feature-loaded replay setup: faults + net + multislice share,
+    fresh objects per call (the engine mutates jobs in place)."""
+    c = TpuCluster("v5e", dims=(4, 4), num_pods=4)
+    jobs = promote_to_multislice(
+        generate_philly_like_trace(num_jobs, seed=seed), 0.3,
+        c.pod_chips, seed=seed,
+    )
+    plan = FaultPlan(
+        records=generate_fault_schedule(
+            c, FaultConfig(mtbf=30_000.0, repair=1800.0),
+            horizon=400_000.0, seed=seed),
+        recovery=RecoveryModel(ckpt_interval=1800.0, restore="auto"),
+    )
+    net = NetModel(NetConfig(partial=partial))
+    return c, jobs, plan, net
+
+
+def _run(
+    *, profiler=None, cache_telemetry=False, sample_on_change=False,
+    attribution=True, partial=False, policy="dlas",
+):
+    c, jobs, plan, net = _world(partial=partial)
+    ml = MetricsLog(
+        record_events=True, attribution=attribution,
+        cache_telemetry=cache_telemetry,
+    )
+    kwargs = dict(thresholds=(600.0,)) if policy == "dlas" else {}
+    sim = Simulator(
+        c, make_policy(policy, **kwargs), jobs, metrics=ml,
+        faults=plan, net=net, max_time=400_000.0,
+        profiler=profiler, sample_on_change=sample_on_change,
+    )
+    res = sim.run()
+    return sim, res, ml
+
+
+# --------------------------------------------------------------------- #
+# phase profiler
+
+
+def test_profiled_run_is_byte_identical():
+    _, res_a, ml_a = _run()
+    prof = PhaseProfiler()
+    _, res_b, ml_b = _run(profiler=prof)
+    assert ml_a.events == ml_b.events
+    assert res_a.summary() == res_b.summary()
+    assert ml_a.job_rows == ml_b.job_rows
+    assert prof.batches > 0
+
+
+def test_phases_sum_to_total_wall_time_exactly():
+    prof = PhaseProfiler()
+    _run(profiler=prof)
+    p = prof.profile()
+    assert p["batches"] == prof.batches
+    total = p["total_wall_s"]
+    assert total > 0.0
+    phase_sum = sum(b["total_s"] for b in p["phases"].values())
+    assert phase_sum == pytest.approx(total, abs=1e-12)
+    # a faulted+netted dlas replay exercises every in-loop phase
+    for name in ("event_apply", "policy_schedule", "net_resolve",
+                 "fault_dispatch", "advance", "metrics_emit", "analytics"):
+        assert p["phases"][name]["total_s"] > 0.0, name
+    assert set(p["phases"]) == set(PHASES)
+
+
+def test_profile_document_round_trip(tmp_path):
+    prof = PhaseProfiler(chunk_batches=16)
+    _run(profiler=prof)
+    doc = prof.to_document()
+    assert validate_chrome_trace(doc) == []
+    names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert "event_apply" in names and "policy_schedule" in names
+    out = prof.write(tmp_path / "prof.json")
+    loaded = load_profile(out)
+    assert loaded == doc["selfprof"]
+    with pytest.raises(ValueError):
+        bad = tmp_path / "bad.json"
+        bad.write_text("{}")
+        load_profile(bad)
+
+
+# --------------------------------------------------------------------- #
+# cache telemetry
+
+
+def test_cache_telemetry_off_is_byte_identical():
+    _, res_a, ml_a = _run(cache_telemetry=False)
+    _, res_b, ml_b = _run(cache_telemetry=True)
+    # the ONLY additions: the trailing cache record + cache_* counters
+    assert ml_b.events[-1]["event"] == "cache"
+    assert ml_b.events[:-1] == ml_a.events
+    stripped = {
+        k: v for k, v in res_b.summary().items()
+        if not k.startswith("cache_")
+    }
+    assert stripped == res_a.summary()
+    assert not any(k.startswith("cache_") for k in res_a.summary())
+
+
+def test_every_pr79_cache_reports_hits():
+    sim, res, ml = _run(cache_telemetry=True, partial=True)
+    stats = sim.cache_stats()
+    for cache in ("net_price", "net_flows", "net_partial",
+                  "tpu_alloc_fail", "tpu_slice_rows"):
+        assert stats[cache]["hit"] > 0, cache
+    # the same counts in all three surfaces: summary, stream, stats
+    s = res.summary()
+    caches = ml.events[-1]["caches"]
+    for cache in ("net_price", "net_flows", "net_partial",
+                  "tpu_alloc_fail", "tpu_slice_rows"):
+        assert s[f"cache_{cache}_hit"] == stats[cache]["hit"]
+        assert caches[cache]["hit"] == stats[cache]["hit"]
+
+
+def test_can_allocate_memo_reports_hits():
+    # gandiva is the can_allocate caller (packing probes per tick)
+    sim, _, _ = _run(cache_telemetry=True, policy="gandiva",
+                     attribution=False)
+    assert sim.cache_stats()["tpu_can_allocate"]["hit"] > 0
+
+
+def test_cache_registry_family(tmp_path):
+    from gpuschedule_tpu.obs import MetricsRegistry
+
+    c, jobs, plan, net = _world()
+    reg = MetricsRegistry()
+    ml = MetricsLog(registry=reg, cache_telemetry=True)
+    Simulator(c, make_policy("fifo"), jobs, metrics=ml, faults=plan,
+              net=net, max_time=400_000.0).run()
+    text = reg.prometheus_text()
+    assert 'engine_cache_events{cache="net_price",outcome="hit"}' in text
+    assert 'engine_cache_events{cache="tpu_alloc_fail",outcome="hit"}' in text
+
+
+def test_cache_table_reaches_analyzer_and_report(tmp_path):
+    sink = tmp_path / "e.jsonl"
+    c, jobs, plan, net = _world()
+    ml = MetricsLog(events_sink=sink, cache_telemetry=True, run_meta={
+        "run_id": "r", "seed": 11, "policy": "fifo", "config_hash": "h"})
+    with ml:
+        Simulator(c, make_policy("fifo"), jobs, metrics=ml, faults=plan,
+                  net=net, max_time=400_000.0).run()
+    ml.write(tmp_path)
+    a = analyze_file(sink)
+    assert a.cache_stats and a.cache_stats["net_price"]["hit"] > 0
+    html = render_report(a)
+    assert "Engine health" in html and "net_price" in html
+    # the selfprof block rides the same panel when handed in
+    prof = PhaseProfiler()
+    _run(profiler=prof)
+    html2 = render_report(a, selfprof=prof.profile())
+    assert "replay wall time by phase" in html2
+
+
+def test_jobspill_flush_telemetry(tmp_path):
+    sink = tmp_path / "e.jsonl"
+    c, jobs, plan, net = _world()
+    ml = MetricsLog(events_sink=sink, run_meta={
+        "run_id": "r", "seed": 11, "policy": "fifo", "config_hash": "h"})
+    with ml:
+        Simulator(c, make_policy("fifo"), jobs, metrics=ml, faults=plan,
+                  net=net, max_time=400_000.0).run()
+    ml.write(tmp_path)
+    a = analyze_file(sink, low_memory=True)
+    assert a._spill is not None and a._spill.flushes > 0
+
+
+# --------------------------------------------------------------------- #
+# on-change sampling
+
+
+def _strip_samples(events):
+    return [e for e in events if e.get("event") != "sample"]
+
+
+def test_sample_on_change_off_path_byte_identical():
+    _, res_a, ml_a = _run(sample_on_change=False)
+    _, res_b, ml_b = _run(sample_on_change=True)
+    # lifecycle records identical; only sample records were added
+    assert _strip_samples(ml_b.events) == ml_a.events
+    assert res_a.summary() == res_b.summary()
+    samples = [e for e in ml_b.events if e.get("event") == "sample"]
+    assert samples, "a faulted replay must produce mask transitions"
+    # every on-change sample coincides with a fault/repair batch instant
+    mask_ts = {
+        e["t"] for e in ml_b.events
+        if e.get("event") in ("fault", "repair")
+    }
+    assert all(s["t"] in mask_ts for s in samples)
+
+
+def test_sample_on_change_composes_with_timer():
+    c, jobs, plan, net = _world()
+    ml = MetricsLog(record_events=True)
+    Simulator(c, make_policy("fifo"), jobs, metrics=ml, faults=plan,
+              net=net, max_time=400_000.0, sample_interval=7200.0,
+              sample_on_change=True).run()
+    samples = [e for e in ml.events if e.get("event") == "sample"]
+    mask_ts = {e["t"] for e in ml.events
+               if e.get("event") in ("fault", "repair")}
+    on_change = [s for s in samples if s["t"] in mask_ts]
+    timed = [s for s in samples if s["t"] not in mask_ts]
+    assert on_change and timed
+
+
+# --------------------------------------------------------------------- #
+# tier-1 CLI smoke: run --self-profile + history trend end to end
+
+
+def test_cli_selfprof_and_history_trend_smoke(tmp_path, capsys):
+    prof_path = tmp_path / "prof.json"
+    store = tmp_path / "h.sqlite"
+    events = tmp_path / "e.jsonl"
+    args = [
+        "run", "--synthetic", "12", "--seed", "3", "--cluster", "tpu-v5e",
+        "--dims", "4x4", "--events", str(events),
+        "--self-profile", str(prof_path), "--cache-stats",
+        "--history", str(store),
+    ]
+    assert main(args) == 0
+    capsys.readouterr()
+    # phase times sum to total wall time within tolerance
+    prof = load_profile(prof_path)
+    phase_sum = sum(b["total_s"] for b in prof["phases"].values())
+    assert phase_sum == pytest.approx(prof["total_wall_s"], rel=1e-9)
+    assert prof["batches"] > 0
+    # second invocation joins the store; trend renders identically twice
+    assert main(args) == 0
+    capsys.readouterr()
+    trend_args = ["history", "trend", "--store", str(store),
+                  "--metric", "avg_jct", "--metric", "num_finished"]
+    assert main(trend_args) == 0
+    t1 = capsys.readouterr().out
+    assert main(trend_args) == 0
+    t2 = capsys.readouterr().out
+    assert t1 == t2
+    assert "avg_jct" in t1 and t1.count("\n") >= 4  # header + rule + 2 rows
+    # the report folds the profile into the Engine health panel
+    rep = tmp_path / "r.html"
+    assert main(["report", "--events", str(events), "--out", str(rep),
+                 "--selfprof", str(prof_path)]) == 0
+    capsys.readouterr()
+    html = rep.read_text()
+    assert "Engine health" in html

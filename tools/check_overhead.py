@@ -71,6 +71,7 @@ def _fresh_sim(
     *,
     metrics: MetricsLog | None = None,
     sample_interval: float | None = None,
+    profiler=None,
 ) -> Simulator:
     # fresh Job objects every run: the engine mutates them in place
     jobs = generate_poisson_trace(num_jobs, seed=1234, mean_duration=900.0)
@@ -80,6 +81,7 @@ def _fresh_sim(
         jobs,
         metrics=metrics,
         sample_interval=sample_interval,
+        profiler=profiler,
     )
 
 
@@ -101,6 +103,30 @@ def _time_sampling(num_jobs: int) -> float:
     # sampling armed, event stream off: the ISSUE 5 "sampling-enabled-but-
     # events-off" path — all heap traffic, no payloads
     sim = _fresh_sim(num_jobs, sample_interval=SAMPLE_INTERVAL_S)
+    t0 = time.perf_counter()
+    sim.run()
+    return time.perf_counter() - t0
+
+
+def _time_selfprof_off(num_jobs: int) -> float:
+    # the ISSUE 10 self-profile knob at its default (detached profiler):
+    # run() must select the plain loop with nothing but one None check.
+    # Today profiler=None is byte-for-byte the `disabled` construction,
+    # so this rung is expected to track it exactly — it exists as the
+    # knob-specific tripwire for any future change that grows
+    # constructor-side or dispatch-side work behind the profiler arg,
+    # which the generic disabled rung would not name.
+    sim = _fresh_sim(num_jobs, profiler=None)
+    t0 = time.perf_counter()
+    sim.run()
+    return time.perf_counter() - t0
+
+
+def _time_selfprof_on(num_jobs: int) -> float:
+    # informational (like enabled): what the phase buckets cost when on
+    from gpuschedule_tpu.obs import PhaseProfiler
+
+    sim = _fresh_sim(num_jobs, profiler=PhaseProfiler())
     t0 = time.perf_counter()
     sim.run()
     return time.perf_counter() - t0
@@ -133,19 +159,25 @@ def run_guard(
     result: dict = {}
     for attempt in range(1, max_attempts + 1):
         base_times, dis_times, samp_times = [], [], []
+        prof_times = []
         _time_baseline(num_jobs)  # warm allocator/caches off the record
         _time_disabled(num_jobs)
         _time_sampling(num_jobs)
+        _time_selfprof_off(num_jobs)
         for _ in range(attempt_repeats):  # interleaved: drift hits all alike
             base_times.append(_time_baseline(num_jobs))
             dis_times.append(_time_disabled(num_jobs))
             samp_times.append(_time_sampling(num_jobs))
+            prof_times.append(_time_selfprof_off(num_jobs))
         t_base, t_dis = min(base_times), min(dis_times)
         t_samp = min(samp_times)
+        t_prof_off = min(prof_times)
         ratio = t_dis / t_base if t_base > 0 else float("inf")
         samp_ratio = t_samp / t_base if t_base > 0 else float("inf")
+        prof_ratio = t_prof_off / t_base if t_base > 0 else float("inf")
         result = {
-            "ok": ratio <= tolerance and samp_ratio <= tolerance,
+            "ok": (ratio <= tolerance and samp_ratio <= tolerance
+                   and prof_ratio <= tolerance),
             "attempt": attempt,
             "repeats": attempt_repeats,
             "num_jobs": num_jobs,
@@ -154,6 +186,8 @@ def run_guard(
             "disabled_over_baseline": round(ratio, 4),
             "sampling_s": round(t_samp, 6),
             "sampling_over_baseline": round(samp_ratio, 4),
+            "selfprof_off_s": round(t_prof_off, 6),
+            "selfprof_off_over_baseline": round(prof_ratio, 4),
             "sample_interval_s": SAMPLE_INTERVAL_S,
             "tolerance": tolerance,
         }
@@ -164,6 +198,10 @@ def run_guard(
     result["enabled_s"] = round(_time_enabled(num_jobs), 6)
     result["enabled_over_baseline"] = round(
         result["enabled_s"] / result["baseline_s"], 4
+    )
+    result["selfprof_on_s"] = round(_time_selfprof_on(num_jobs), 6)
+    result["selfprof_on_over_baseline"] = round(
+        result["selfprof_on_s"] / result["baseline_s"], 4
     )
     return result
 

@@ -150,6 +150,15 @@ def run_rung(
                 kept["revocations"] = int(
                     res.counters.get("fault_revocations", 0)
                 )
+            # unified cache telemetry (ISSUE 10): flattened per-rung
+            # counts, so a cache that stopped hitting is visible next to
+            # the jobs/sec number it would otherwise only depress
+            kept["caches"] = {
+                f"{name}.{outcome}": int(n)
+                for name, outcomes in sim.cache_stats().items()
+                for outcome, n in sorted(outcomes.items())
+                if n
+            }
     return {
         "config": config,
         "num_jobs": num_jobs,
@@ -270,6 +279,15 @@ def main(argv=None) -> int:
                    help="append the slow 1M-job rung to the ladder (the "
                         "scale-decay headline; minutes per config)")
     p.add_argument("--out", help="also write the JSON document here")
+    p.add_argument("--history", metavar="STORE",
+                   help="append every rung to the sqlite history store "
+                        "(label <config>/<size>) and print each rung's "
+                        "jobs/sec against the median of its last N prior "
+                        "entries — the 2x box noise read as a "
+                        "distribution instead of one suspect number")
+    p.add_argument("--history-last", type=int, default=5,
+                   help="prior entries per rung the trend delta compares "
+                        "against (default 5)")
     args = p.parse_args(argv)
 
     sizes = tuple(int(s) for s in args.sizes.split(","))
@@ -280,10 +298,48 @@ def main(argv=None) -> int:
                        isolate=not args.no_isolate)
     gate = apply_gate(rungs, floor_scale=args.floor_scale)
     ratios = scale_ratios(rungs)
+    trend = None
+    if args.history:
+        # cross-invocation memory (ISSUE 10): this ladder joins the
+        # store, and each rung's number is positioned inside the
+        # distribution of its own history — the honest read on a box
+        # that swings 2x run to run
+        from gpuschedule_tpu.obs.history import HistoryStore, trend_delta
+
+        trend = {}
+        with HistoryStore(args.history) as store:
+            for rung in rungs:
+                label = f"{rung['config']}/{rung['num_jobs']}"
+                store.append(
+                    "bench", label=label, seed=args.seed,
+                    metrics={
+                        k: v for k, v in rung.items()
+                        if isinstance(v, (int, float))
+                    },
+                )
+                # same-seed rows only: a different --seed is a different
+                # synthetic workload, whose jobs/sec distribution says
+                # nothing about this one
+                rows = [
+                    r for r in store.rows(kind="bench", label=label)
+                    if r.seed == args.seed
+                ]
+                d = trend_delta(rows, "jobs_per_s", last=args.history_last)
+                if d is not None:
+                    trend[label] = d
+                    print(
+                        f"trend {label}: jobs/s {d['value']:.1f} vs "
+                        f"median-of-{d['n_prior']} {d['median']:.1f} "
+                        f"({100.0 * d['delta_frac']:+.1f}%)"
+                        if d["delta_frac"] is not None else
+                        f"trend {label}: jobs/s {d['value']:.1f}",
+                        file=sys.stderr,
+                    )
     doc = {
         "ladder": rungs,
         "gate": gate,
         "scale_ratios": ratios,
+        **({"history_trend": trend} if trend is not None else {}),
         "floors_jobs_per_s": {
             k: v * args.floor_scale for k, v in FLOORS.items() if k in configs
         },
