@@ -150,7 +150,121 @@ def _run_config_hash(args) -> str:
         # therefore its run_id and events header) must stay byte-identical
         # to what it was before the net layer existed
         **({"net": args.net} if getattr(args, "net", None) else {}),
+        # accounting v2 changes the float-summation contract (ISSUE 11:
+        # closure replaces byte-identity), so it IS experiment config and
+        # rides the hash — but only when armed, keeping every historical
+        # v1 hash (and run_id, and events header) byte-identical
+        **({"accounting": "v2"}
+           if getattr(args, "accounting", "v1") == "v2" else {}),
     })
+
+
+def _append_run_history(store_path, run_meta, summary, *, policy, seed,
+                        fallback_hash) -> None:
+    """One history row for a finished replay, keyed by its run identity
+    (ISSUE 10).  Shared by fresh and resumed runs so the row shape
+    cannot drift between the two paths."""
+    from gpuschedule_tpu.obs import HistoryStore
+
+    chash = run_meta["config_hash"] if run_meta else fallback_hash
+    with HistoryStore(store_path) as store:
+        store.append(
+            "run",
+            run_id=(run_meta["run_id"] if run_meta
+                    else f"{policy}-s{seed}-{chash}"),
+            config_hash=chash,
+            policy=policy,
+            seed=seed,
+            metrics=summary,
+        )
+
+
+def _cmd_resume(args) -> int:
+    """``run --resume SNAPSHOT``: reconstruct a mid-replay engine from a
+    ``--snapshot`` file and finish it.  World-building flags (--philly /
+    --synthetic / --cluster / --faults / --net ...) are ignored — the
+    snapshot IS the world; output flags (--out / --events / --prefix),
+    --history / --cache-stats, and the snapshot/self-profile knobs still
+    apply.  --perfetto / --prom / --spans are refused (their collectors
+    are process-bound and cannot cover the pre-snapshot head).  Under v1
+    accounting the finished outputs are byte-identical to the
+    uninterrupted run (the obs registry / metrics.prom is process-bound
+    and counts only the tail — the one documented exception)."""
+    import math
+    from pathlib import Path
+
+    from gpuschedule_tpu.sim import Simulator
+    from gpuschedule_tpu.sim.snapshot import SnapshotError
+
+    if args.events is True and not args.out:
+        raise SystemExit("--events without a PATH requires --out")
+    if bool(args.snapshot) != bool(args.snapshot_every):
+        raise SystemExit("--snapshot PATH and --snapshot-every SECONDS arm together")
+    if args.snapshot_every is not None and not (
+            math.isfinite(float(args.snapshot_every))
+            and float(args.snapshot_every) > 0.0):
+        # the fresh-run path gets this from the Simulator constructor;
+        # the resume re-arm pokes the fields directly, so check here
+        raise SystemExit(
+            f"--snapshot-every must be > 0 seconds, got {args.snapshot_every}"
+        )
+    for armed, name in ((args.perfetto, "--perfetto"), (args.prom, "--prom"),
+                        (args.spans, "--spans")):
+        if armed:
+            raise SystemExit(f"{name} is not supported with --resume")
+    events_sink = None
+    if isinstance(args.events, str):
+        events_sink = Path(args.events)
+    elif args.events:
+        events_sink = Path(args.out) / f"{args.prefix}events.jsonl"
+    profiler = None
+    if args.self_profile:
+        from gpuschedule_tpu.obs import PhaseProfiler
+
+        profiler = PhaseProfiler()
+    try:
+        sim = Simulator.restore(
+            args.resume, events_sink=events_sink, profiler=profiler
+        )
+    except SnapshotError as e:
+        raise SystemExit(str(e)) from None
+    if args.snapshot and args.snapshot_every:
+        # re-arm (or move) periodic snapshotting for the resumed leg:
+        # next strict multiple of the cadence past the restored clock
+        every = float(args.snapshot_every)
+        sim._snap_path = Path(args.snapshot)
+        sim._snap_every = every
+        nxt = every * (math.floor(sim.now / every) + 1.0)
+        while nxt <= sim.now:  # float-rounding guard
+            nxt += every
+        sim._snap_next = nxt
+    if args.cache_stats:
+        # arm (or re-arm) cache telemetry for the resumed leg: restored
+        # caches start empty, so the counters cover exactly the tail —
+        # the same process-bound scope as the obs registry exception
+        sim.metrics.cache_telemetry = True
+        sim._cache_telemetry = True
+    with sim.metrics:
+        res = sim.run()
+    print(json.dumps(res.summary(), sort_keys=True))
+    if profiler is not None:
+        profiler.write(args.self_profile)
+    if args.history:
+        # cross-run memory (ISSUE 10): the resumed leg appends its
+        # summary under the pickled run identity, same as the
+        # uninterrupted run would have
+        rm = sim.metrics.run_meta
+        _append_run_history(
+            args.history, rm, res.summary(),
+            policy=(rm or {}).get("policy", args.policy),
+            seed=(rm or {}).get("seed", args.seed),
+            fallback_hash="resumed",
+        )
+    if args.out:
+        sim.metrics.write(args.out, prefix=args.prefix)
+    else:
+        sim.metrics.close_events()
+    return 0
 
 
 def cmd_run(args) -> int:
@@ -158,6 +272,8 @@ def cmd_run(args) -> int:
 
     from gpuschedule_tpu.sim.metrics import MetricsLog
 
+    if args.resume:
+        return _cmd_resume(args)
     # --events PATH captures anywhere; bare --events keeps the historical
     # behavior (events.jsonl under --out)
     if args.events is True and not args.out:
@@ -258,16 +374,24 @@ def cmd_run(args) -> int:
         from gpuschedule_tpu.obs import PhaseProfiler
 
         profiler = PhaseProfiler()
-    sim = Simulator(
-        cluster, build_policy(args), jobs,
-        metrics=metrics,
-        max_time=args.max_time or float("inf"),
-        faults=fault_plan,
-        net=net_model,
-        sample_interval=args.sample_interval,
-        sample_on_change=bool(args.sample_on_change),
-        profiler=profiler,
-    )
+    if bool(args.snapshot) != bool(args.snapshot_every):
+        raise SystemExit("--snapshot PATH and --snapshot-every SECONDS arm together")
+    try:
+        sim = Simulator(
+            cluster, build_policy(args), jobs,
+            metrics=metrics,
+            max_time=args.max_time or float("inf"),
+            faults=fault_plan,
+            net=net_model,
+            sample_interval=args.sample_interval,
+            sample_on_change=bool(args.sample_on_change),
+            profiler=profiler,
+            accounting=args.accounting,
+            snapshot_every=args.snapshot_every,
+            snapshot_path=Path(args.snapshot) if args.snapshot else None,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
     # context-manager path: an engine exception still flushes/closes the
     # JSONL sink, leaving an analyzable stream behind (ISSUE 3 satellite)
     with metrics:
@@ -290,19 +414,11 @@ def cmd_run(args) -> int:
         # cross-run memory (ISSUE 10): append this invocation's summary
         # keyed by run identity, so `history trend` can render the
         # trajectory across invocations
-        from gpuschedule_tpu.obs import HistoryStore
-
-        chash = run_meta["config_hash"] if run_meta else _run_config_hash(args)
-        with HistoryStore(args.history) as store:
-            store.append(
-                "run",
-                run_id=(run_meta["run_id"] if run_meta
-                        else f"{args.policy}-s{args.seed}-{chash}"),
-                config_hash=chash,
-                policy=args.policy,
-                seed=args.seed,
-                metrics=res.summary(),
-            )
+        _append_run_history(
+            args.history, run_meta, res.summary(),
+            policy=args.policy, seed=args.seed,
+            fallback_hash=(None if run_meta else _run_config_hash(args)),
+        )
     if args.out:
         sim.metrics.write(args.out, prefix=args.prefix)
     else:
@@ -1145,6 +1261,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                           "Adds delay_<cause>_s keys to the summary line; "
                           "off, the run is byte-identical to before this "
                           "flag existed")
+    run.add_argument("--accounting", choices=("v1", "v2"), default="v1",
+                     help="progress-accounting version (ISSUE 11): v1 "
+                          "(default) keeps the historical chunk-per-batch "
+                          "integration and its byte-identity contract; v2 "
+                          "integrates lazily / vectorized under an "
+                          "exact-sum closure contract instead — ~2x "
+                          "jobs/sec on policies that don't read running "
+                          "progress per batch.  v2 rides the config hash")
+    run.add_argument("--snapshot", metavar="PATH",
+                     help="with --snapshot-every: serialize the full "
+                          "engine state here periodically, making the "
+                          "replay crash-resumable (run --resume PATH)")
+    run.add_argument("--snapshot-every", type=float, metavar="SECONDS",
+                     help="sim-seconds between engine snapshots (arms "
+                          "together with --snapshot)")
+    run.add_argument("--resume", metavar="SNAPSHOT",
+                     help="restore a mid-replay engine from a --snapshot "
+                          "file and finish it; under v1 accounting the "
+                          "finished outputs are byte-identical to the "
+                          "uninterrupted run.  World-building flags are "
+                          "ignored — the snapshot is the world")
     run.add_argument("--sample-interval", type=float, metavar="SECONDS",
                      help="emit periodic cluster-side 'sample' events "
                           "(physical occupancy, health-masked chips, per-"

@@ -147,6 +147,19 @@ class ClusterBase:
         nodes); keys are additive, schema stays v1."""
         return {"used": self.used_chips, "unhealthy": self.unhealthy_chips}
 
+    # ---- engine snapshot/restore (sim/snapshot.py, ISSUE 11) ---------- #
+
+    def restored(self) -> None:
+        """Post-restore hook: called once after this cluster is
+        deserialized from an engine snapshot, before the resumed replay
+        touches it.  Flavors with derived caches drop or rebuild them
+        here (or shed them in ``__getstate__``) so a resume never trusts
+        pre-snapshot geometry; the default flat pool carries no caches.
+        Everything else — occupancy, health/degrade masks, counters,
+        allocation ids, placement RNGs — is plain picklable state and
+        rides the snapshot as-is, which is what makes a v1 resume
+        byte-identical to the uninterrupted run."""
+
     def is_satisfiable(self, num_chips: int) -> bool:
         """Could ``num_chips`` EVER be granted on this cluster (ignoring the
         current occupancy)?  The engine rejects unsatisfiable jobs at

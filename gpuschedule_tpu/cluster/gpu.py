@@ -85,6 +85,12 @@ class GpuCluster(ClusterBase):
         # run at its rate (the slowest member paces a synchronous gang).
         self._node_degrade: Dict[NodeId, List[float]] = {}
         self.fragmentation_failures = 0  # topology-strict refusals
+        # Engine snapshot contract (sim/snapshot.py, ISSUE 11): every
+        # field above is authoritative, picklable state with no derived
+        # caches, so this flavor serializes wholesale.  ``_rng`` is part
+        # of that contract — the ``random`` placement scheme's stream
+        # state rides the snapshot, which is what keeps a resumed replay
+        # placing gangs on byte-identical nodes.
 
     # ------------------------------------------------------------------ #
 

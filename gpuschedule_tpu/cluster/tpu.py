@@ -40,11 +40,15 @@ import functools
 import itertools
 import math
 from dataclasses import dataclass
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from gpuschedule_tpu.cluster.base import Allocation, ClusterBase, OverlayMixin
+
+# sentinel for the per-(rows, shape) scan memo: a memoized None is a
+# cached refusal, distinct from "not yet scanned"
+_SCAN_MISS = object()
 
 # Modeled per-generation interconnect constants consumed by the profiler's
 # analytic allreduce term (SURVEY.md §7 "Step-time model fidelity").  Values
@@ -296,6 +300,50 @@ class TpuCluster(OverlayMixin, ClusterBase):
         self._rows: List[Optional[List[int]]] = [None] * self.num_pods
         self._row_len = self.dims[-1]
         self._row_grid = self.dims[:-1]  # outer axes of the row table
+        # C-order strides over the outer axes: the row index of outer
+        # coordinate (c0, .., ck) is sum(ci * stride_i) — what lets
+        # grant/free update the packed rows in place (ISSUE 11) instead
+        # of invalidating and re-packing the whole pod grid
+        strides: List[int] = []
+        acc = 1
+        for d in reversed(self._row_grid):
+            strides.append(acc)
+            acc *= d
+        self._row_strides = tuple(reversed(strides))
+        # Per-(rows, shape) scan memo (ISSUE 11): a bitmask first-fit
+        # result is a pure function of the packed row list and the shape,
+        # so each pod keeps {shape: origin|None} keyed to the IDENTITY of
+        # its current row list — a rebuild swaps the list object, which
+        # invalidates the memo with no extra bookkeeping at any write
+        # site.  Pays in the blocked-FIFO steady state: one free in pod k
+        # re-scans pod k's shapes only; the other pods' refusals replay
+        # from the memo.
+        self._scan_memo: List[Optional[tuple]] = [None] * self.num_pods
+        self._cs_scan_hit = 0          # memoized first-fit answers
+        self._cs_scan_miss = 0         # fresh bitmask scans
+
+    # ------------------------------------------------------------------ #
+    # engine snapshot support (sim/snapshot.py, ISSUE 11)
+
+    def __getstate__(self):
+        """Serialize for an engine snapshot: authoritative state only.
+        The derived caches — bitmask row tables, scan memos, the
+        directional failure/feasibility caches — are shed and rebuilt
+        lazily after restore, so a resumed replay can never trust
+        pre-snapshot geometry (and snapshots stay lean).  Dropping them
+        is behavior-neutral by construction: every cache is a pure
+        function of the occupancy/health grids that DO ride the
+        snapshot."""
+        state = self.__dict__.copy()
+        state["_rows"] = [None] * self.num_pods
+        state["_scan_memo"] = [None] * self.num_pods
+        state["_fail_version"] = -1
+        state["_fail_sizes"] = {}
+        state["_can_true_version"] = -1
+        state["_can_true"] = set()
+        state["_can_false_version"] = -1
+        state["_can_false"] = set()
+        return state
 
     # ------------------------------------------------------------------ #
     # ClusterBase surface
@@ -316,6 +364,17 @@ class TpuCluster(OverlayMixin, ClusterBase):
         if self._unhealthy_cells == 0:
             return 0
         return self._unhealthy_free
+
+    @property
+    def free_chips(self) -> int:
+        # Same arithmetic as the ClusterBase property with the O(1)
+        # constituents inlined — allocate's capacity precheck and the
+        # failure cache's frag/nofree re-derivation read this once or
+        # twice per attempt, which at fleet scale made the base class's
+        # nested property dispatch measurable.
+        if self._unhealthy_cells == 0:
+            return self.total_chips - self._used
+        return self.total_chips - self._used - self._unhealthy_free
 
     # ------------------------------------------------------------------ #
     # fault health mask (faults/)
@@ -597,9 +656,10 @@ class TpuCluster(OverlayMixin, ClusterBase):
             claims.
         """
         self.allocation_attempts += 1
-        overlay = self._try_overlay(num_chips, hint, job)
-        if overlay is not None:
-            return overlay
+        if hint:  # _try_overlay is a no-op without a hint (hot path)
+            overlay = self._try_overlay(num_chips, hint, job)
+            if overlay is not None:
+                return overlay
         if num_chips <= 0:
             return None
         # hint-free failure cache (ISSUE 9): grants and outages only make
@@ -720,10 +780,14 @@ class TpuCluster(OverlayMixin, ClusterBase):
         multislice may claim (single source of the emptiness invariant).
         A pod with any unhealthy chip is not empty: a multislice per-pod
         slice is the full torus, so one broken chip disqualifies it."""
+        pod_used = self._pod_used  # == occ.any() per pod: the counter is
+        # maintained at every occupancy write (grant/free, single +
+        # multislice), so the emptiness test is an int compare instead of
+        # a numpy reduction per pod per multislice attempt (ISSUE 11)
         return [
             p
-            for p, occ in enumerate(self._occ)
-            if not occ.any()
+            for p in range(self.num_pods)
+            if pod_used[p] == 0
             and (self._unhealthy_cells == 0 or not self._health[p].any())
         ]
 
@@ -780,7 +844,7 @@ class TpuCluster(OverlayMixin, ClusterBase):
         for s in slices:
             self._occ[s.pod][...] = 1
             self._pod_used[s.pod] = self.pod_chips
-            self._rows[s.pod] = None
+            self._rows_mark(s.pod, origin, self.dims, True)
         self._harden += 1
         geom = MultiSliceGeometry(
             slices=slices, speed_factor=self._multislice_speed_factor(m, job)
@@ -838,14 +902,16 @@ class TpuCluster(OverlayMixin, ClusterBase):
                     )
                 self._occ[s.pod][...] = 0
                 self._pod_used[s.pod] = 0
-                self._rows[s.pod] = None
+                self._rows_mark(
+                    s.pod, tuple(0 for _ in self.dims), self.dims, False
+                )
         else:
             if count_unhealthy:
                 hbox = self._box(self._health[geom.pod], geom.origin, geom.shape)
                 self._unhealthy_free += int((hbox > 0).sum())
             self._box(self._occ[geom.pod], geom.origin, geom.shape)[...] = 0
             self._pod_used[geom.pod] -= geom.num_chips
-            self._rows[geom.pod] = None
+            self._rows_mark(geom.pod, geom.origin, geom.shape, False)
         self._used -= geom.num_chips
         self._ease += 1
 
@@ -938,7 +1004,58 @@ class TpuCluster(OverlayMixin, ClusterBase):
 
     @staticmethod
     def _box(occ: np.ndarray, origin: Tuple[int, ...], shape: Tuple[int, ...]) -> np.ndarray:
+        if len(origin) == 2:
+            # 2D pod (the common fleet shape): direct slice expression —
+            # grant/free build this view twice per job at fleet scale and
+            # the generic tuple-of-slices genexpr was measurable
+            o0, o1 = origin
+            s0, s1 = shape
+            return occ[o0:o0 + s0, o1:o1 + s1]
         return occ[tuple(slice(o, o + s) for o, s in zip(origin, shape))]
+
+    def _rows_mark(
+        self, pod: int, origin: Tuple[int, ...], shape: Tuple[int, ...],
+        block: bool,
+    ) -> None:
+        """Fold one grant/free box into the pod's packed row table IN
+        PLACE (ISSUE 11): the blocked grid is pure occupancy while no
+        chip is health-masked, so setting/clearing the box's bits yields
+        exactly the ints a full re-pack would — the steady-state
+        grant/free churn stops paying a per-pod numpy re-pack.  Any
+        unhealthy cell anywhere falls back to invalidation (health bits
+        interleave with occupancy in the blocked grid; fault paths also
+        invalidate at every mask transition), as does a not-yet-built
+        table.  The scan memo is identity-keyed to the rows list, so an
+        in-place content change must drop it explicitly."""
+        rows = self._rows[pod]
+        if rows is None:
+            return
+        if self._unhealthy_cells != 0:
+            self._rows[pod] = None
+            return
+        self._scan_memo[pod] = None
+        mask = ((1 << shape[-1]) - 1) << origin[-1]
+        strides = self._row_strides
+        if len(strides) == 1:
+            # 2D pod (one outer axis — the common fleet shape): the row
+            # indices are one arithmetic range, no nested expansion
+            st = strides[0]
+            start = origin[0] * st
+            idxs: Iterable[int] = range(start, start + shape[0] * st, st)
+        elif not strides:
+            rows[0] = (rows[0] | mask) if block else (rows[0] & ~mask)
+            return
+        else:
+            idxs = [0]
+            for o, s, st in zip(origin[:-1], shape[:-1], strides):
+                idxs = [base + (o + k) * st for base in idxs for k in range(s)]
+        if block:
+            for r in idxs:
+                rows[r] |= mask
+        else:
+            inv = ~mask
+            for r in idxs:
+                rows[r] &= inv
 
     def _pod_rows(self, pod: int) -> List[int]:
         """The pod's blocked grid packed as one int per torus row (bit
@@ -972,6 +1089,29 @@ class TpuCluster(OverlayMixin, ClusterBase):
         if any(s > d for s, d in zip(shape, dims)):
             return None
         rows = self._pod_rows(pod)
+        # per-(rows, shape) memo: same row list object => same answer.
+        # The sentinel distinguishes a memoized None (a cached refusal)
+        # from an absent entry.
+        memo = self._scan_memo[pod]
+        if memo is None or memo[0] is not rows:
+            memo = (rows, {})
+            self._scan_memo[pod] = memo
+        else:
+            cached = memo[1].get(shape, _SCAN_MISS)
+            if cached is not _SCAN_MISS:
+                self._cs_scan_hit += 1
+                return cached
+        self._cs_scan_miss += 1
+        origin = self._scan_rows_uncached(rows, shape)
+        memo[1][shape] = origin
+        return origin
+
+    def _scan_rows_uncached(
+        self, rows: List[int], shape: Tuple[int, ...]
+    ) -> Optional[Tuple[int, ...]]:
+        """The raw bitmask first-fit over a packed row list (the memo-free
+        body of :meth:`_scan_pod_rows`; a pure function of its inputs)."""
+        dims = self.dims
         w = shape[-1]
         W = self._row_len
         colmask = (1 << (W - w + 1)) - 1
@@ -1035,15 +1175,16 @@ class TpuCluster(OverlayMixin, ClusterBase):
     def _grant(self, pod: int, origin: Tuple[int, ...], shape: Tuple[int, ...]) -> Allocation:
         # granted boxes never cover unhealthy cells (the search grid masks
         # them), so _unhealthy_free needs no adjustment here
+        n = math.prod(shape)
         self._box(self._occ[pod], origin, shape)[...] = 1
-        self._pod_used[pod] += math.prod(shape)
-        self._rows[pod] = None
+        self._pod_used[pod] += n
+        self._rows_mark(pod, origin, shape, True)
         self._harden += 1
         wrap = tuple(s == d for s, d in zip(shape, self.dims))
         geom = SliceGeometry(pod=pod, origin=origin, shape=shape, wrap_axes=wrap)
-        alloc = Allocation(next(self._ids), geom.num_chips, detail=geom)
+        alloc = Allocation(next(self._ids), n, detail=geom)
         self._live[alloc.alloc_id] = geom
-        self._used += geom.num_chips
+        self._used += n
         return alloc
 
     # ------------------------------------------------------------------ #
@@ -1069,6 +1210,10 @@ class TpuCluster(OverlayMixin, ClusterBase):
                 "hit": self._cs_rows_hit,
                 "miss": self._cs_rows_miss,
                 "fallback": self._cs_search_fallback,
+            },
+            "tpu_scan_memo": {
+                "hit": self._cs_scan_hit,
+                "miss": self._cs_scan_miss,
             },
         }
 

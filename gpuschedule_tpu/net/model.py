@@ -273,6 +273,22 @@ class NetModel:
 
     # ------------------------------------------------------------------ #
 
+    def restored(self) -> None:
+        """Post-restore cache invalidation (engine snapshots, ISSUE 11):
+        a deserialized model keeps its authoritative state — link degrade
+        stacks, the elastic/ingest bookkeeping, the utilization-integral
+        accumulators (whose exact values make a v1 resume's ``netlink``
+        means byte-identical) — but every derived cache is marked for
+        rebuild, so the first post-restore ``poll``/``recompute`` prices
+        from scratch instead of trusting pre-snapshot flow lists, group
+        solves, or route weights."""
+        self._dirty = True
+        self._flows_dirty = True
+        self._state = NetState()
+        self._pod_routes = None
+        if self._group_cache is not None:
+            self._group_cache = GroupCache()
+
     def attach(self, cluster) -> None:
         """Bind to a (possibly placement-wrapped) TpuCluster; idempotent —
         the engine and the CLI may both attach the same cluster."""

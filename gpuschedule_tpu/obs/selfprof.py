@@ -53,6 +53,10 @@ PHASES = (
     "net_resolve",      # _net_update (poll + max-min recompute + emits)
     "fault_dispatch",   # _apply_fault / _apply_warning / repair handling
     "advance",          # progress charging + hazard wear integration
+    "ledger_sync",      # v2 accounting only (ISSUE 11): the JobLedger's
+                        # vectorized per-batch sync replacing the advance
+                        # sweep for progress-reading policies; identically
+                        # zero under v1 and under v2's fully-lazy path
     "metrics_emit",     # utilization sampling, cutoff/attribution emits
     "analytics",        # end-of-run SimResult assembly
     "other",            # loop overhead: heap peeks, quiescence, dispatch

@@ -18,6 +18,18 @@ class Policy:
 
     name: str = "base"
 
+    #: Progress-read declaration (ISSUE 11, v2 accounting).  True (the
+    #: safe default) means ``schedule()`` may read running jobs'
+    #: *integrated* progress state — ``executed_work`` /
+    #: ``attained_service`` / ``remaining_work`` / ``remaining_runtime``
+    #: — so the v2 engine must sync the accounting ledger before every
+    #: policy pass.  A policy that only inspects pending jobs and
+    #: cluster state (FIFO) sets False and the v2 engine skips the
+    #: per-batch sweep entirely: jobs then integrate lazily at their
+    #: next mutation.  Irrelevant under v1 (the default accounting),
+    #: which always advances every running job every batch.
+    reads_progress: bool = True
+
     #: Machine-parseable cause codes (ISSUE 5): maps each human-readable
     #: ``explain()`` rule string to a short stable token.  When a run is
     #: captured with attribution armed (``MetricsLog(attribution=True)``),

@@ -18,6 +18,11 @@ from gpuschedule_tpu.policies.base import Policy
 class FifoPolicy(Policy):
     name = "fifo"
 
+    # FIFO (both variants) orders by submit_time alone and never inspects
+    # a running job's integrated progress — the v2 accounting engine may
+    # skip the per-batch sweep (ISSUE 11; sim/ledger.py)
+    reads_progress = False
+
     # stable cause-code tokens for the attribution layer (ISSUE 5)
     rule_codes = {
         "arrival-order-head": "head",

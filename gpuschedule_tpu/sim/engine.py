@@ -86,9 +86,57 @@ class Simulator:
         sample_interval: Optional[float] = None,
         sample_on_change: bool = False,
         profiler=None,
+        accounting: str = "v1",
+        snapshot_every: Optional[float] = None,
+        snapshot_path=None,
     ):
         self.cluster = cluster
         self.policy = policy
+        # Accounting version (ISSUE 11 tentpole).  "v1" (the default) is
+        # the historical chunk-per-batch integration — every running job
+        # advances at every event batch, and the resulting float sums are
+        # part of the byte-identity contract.  "v2" replaces byte-identity
+        # with exact-sum closure (docs/performance.md): jobs integrate
+        # lazily at mutation/read points (Job.advance is segment-exact for
+        # any dt), the per-batch sweep disappears for policies that never
+        # read running-job progress (Policy.reads_progress False — FIFO),
+        # and becomes the JobLedger's vectorized sync_all for those that
+        # do.  The knob rides the CLI config hash when set to v2.
+        if accounting not in ("v1", "v2"):
+            raise ValueError(
+                f"accounting must be 'v1' or 'v2', got {accounting!r}"
+            )
+        self.accounting = accounting
+        self._lazy = accounting == "v2"
+        self._ledger = None
+        self._lv = None  # the ledger iff it maintains columns (vector mode)
+        if self._lazy:
+            from gpuschedule_tpu.sim.ledger import JobLedger
+
+            self._ledger = JobLedger(
+                attribution=bool(getattr(metrics, "attribution", False)),
+                vector=bool(getattr(policy, "reads_progress", True)),
+            )
+            if self._ledger.vector:
+                self._lv = self._ledger
+        # Periodic engine snapshots (ISSUE 11): every ``snapshot_every``
+        # sim seconds the full engine state is serialized to
+        # ``snapshot_path`` (sim/snapshot.py), making long replays
+        # crash-resumable.  Purely observational: the snapshot lands
+        # between batches, so the replay's own bytes never move.
+        if (snapshot_every is None) != (snapshot_path is None):
+            raise ValueError(
+                "snapshot_every and snapshot_path arm together"
+            )
+        if snapshot_every is not None and snapshot_every <= 0.0:
+            raise ValueError(
+                f"snapshot_every must be > 0, got {snapshot_every}"
+            )
+        self._snap_every = snapshot_every
+        self._snap_path = snapshot_path
+        self._snap_next = snapshot_every if snapshot_every is not None else math.inf
+        self._snap_writes = 0
+        self._snap_restores = 0
         # Shared-fabric contention (net/): a NetModel that re-prices every
         # running multislice job's locality_factor by max-min fair
         # bandwidth sharing whenever the running set or link health
@@ -508,14 +556,15 @@ class Simulator:
         self._mut += 1
         if job.first_start_time is None:
             job.first_start_time = self.now
-        if job in self.pending:
-            self.pending.remove(job)
+        self.pending.discard(job)
         self.running.append(job)
         # running-set insertion ticket: ascending run_seq IS the running
         # set's iteration order, so indexed subsets (victims, net members)
         # can reproduce a full sweep's order by sorting on it (ISSUE 9)
         job.run_seq = next(self._run_tickets)
         self._schedule_completion(job)
+        if self._lv is not None:
+            self._lv.bind(job)
         if self.metrics.record_events:
             extra = {"chips": chips, "speed": speed, "overhead": overhead,
                      "locality": job.locality_factor,
@@ -554,6 +603,8 @@ class Simulator:
         job.preempt_count += 1
         job.state = JobState.SUSPENDED if suspend else JobState.PENDING
         self.running.remove(job)
+        if self._lv is not None:
+            self._lv.release(job)
         self.pending.append(job)
         self.metrics.count("preemptions")
         if self.attribution:
@@ -583,6 +634,8 @@ class Simulator:
         job.epoch += 1
         self._mut += 1
         self._schedule_completion(job)
+        if self._lv is not None:
+            self._lv.refresh(job)
         if self.metrics.record_events:
             extra = {"speed": speed, "prog": _prog(job)}
             if why is not None:
@@ -624,16 +677,22 @@ class Simulator:
             job.epoch += 1
             self._mut += 1
             self._schedule_completion(job)
+            if self._lv is not None:
+                self._lv.refresh(job)
             self._emit_rebind(job, old_detail, alloc)
             return False
         self._bind_allocation(job, alloc)
         if old_detail is not None and alloc.detail == old_detail:
+            if self._lv is not None:
+                self._lv.refresh(job)
             return False  # same slice re-granted: no movement, no cost
         job.overhead_remaining += overhead
         job.migration_count += 1
         job.epoch += 1
         self._mut += 1
         self._schedule_completion(job)
+        if self._lv is not None:
+            self._lv.refresh(job)
         self.metrics.count("migrations")
         if self.metrics.record_events:
             extra = {"overhead": overhead, "locality": job.locality_factor,
@@ -677,6 +736,8 @@ class Simulator:
             job.epoch += 1
             self._mut += 1
             self._schedule_completion(job)
+            if self._lv is not None:
+                self._lv.refresh(job)
             self._emit_rebind(job, old_detail, alloc)
             return False
         self._bind_allocation(job, alloc)
@@ -686,6 +747,8 @@ class Simulator:
         job.epoch += 1
         self._mut += 1
         self._schedule_completion(job)
+        if self._lv is not None:
+            self._lv.refresh(job)
         if self.metrics.record_events:
             extra = {"chips": chips, "speed": speed,
                      "locality": job.locality_factor,
@@ -805,6 +868,8 @@ class Simulator:
         job.state = job.end_state
         job.end_time = self.now
         self.running.remove(job)
+        if self._lv is not None:
+            self._lv.release(job)
         self.finished.append(job)
         self.metrics.record_job(job)
         if record:
@@ -903,6 +968,8 @@ class Simulator:
                 job.epoch += 1
                 self._mut += 1
                 self._schedule_completion(job)
+                if self._lv is not None:
+                    self._lv.refresh(job)
             self.metrics.count("net_reprices")
             if record:
                 self.metrics.event(
@@ -942,6 +1009,12 @@ class Simulator:
         if rec.kind == "straggler":
             self._apply_straggler(rec)
             return
+        if self._lazy and self._lv is None:
+            # v2 lazy accounting: fault dispatch (and the policy hooks it
+            # invokes) may read any running job's progress — bring the
+            # whole set to now first (cold path; v1 already swept it at
+            # the top of the batch, and vector mode's sync_all did too)
+            self._advance_running(self.now)
         victim_ids = self.cluster.mark_unhealthy(rec.scope)
         self._mask_mut += 1  # health mask moved (on-change sampling)
         self.metrics.count("faults")
@@ -981,6 +1054,8 @@ class Simulator:
         cannot change any speed — counted as ``link_faults_inert`` so an
         operator sees the fault spec asked for something the run cannot
         express (run with ``--net``)."""
+        if self._lazy and self._lv is None:
+            self._advance_running(self.now)
         self.metrics.count("faults")
         self.metrics.count(f"faults_{rec.kind}")
         if self.metrics.record_events:
@@ -1009,6 +1084,8 @@ class Simulator:
         link degradation).  Clusters without a degrade mask record the
         fault but cannot slow anyone (``straggler_faults_inert``, the
         link_faults_inert pattern)."""
+        if self._lazy and self._lv is None:
+            self._advance_running(self.now)
         self.metrics.count("faults")
         self.metrics.count(f"faults_{rec.kind}")
         if self.metrics.record_events:
@@ -1057,6 +1134,8 @@ class Simulator:
             job.epoch += 1
             self._mut += 1
             self._schedule_completion(job)
+            if self._lv is not None:
+                self._lv.refresh(job)
             self.metrics.count("straggler_reprices")
             if record:
                 self.metrics.event(
@@ -1081,6 +1160,8 @@ class Simulator:
         loses only the window's tail instead of a full checkpoint
         interval.  Gangs whose write cannot finish in time are notified
         but unprotected (``spot_warnings_missed``)."""
+        if self._lazy and self._lv is None:
+            self._advance_running(self.now)
         self.metrics.count("spot_warnings")
         peek = getattr(self.cluster, "peek_victims", None)
         victims = self._victim_jobs(peek(rec.scope) if peek is not None else ())
@@ -1106,6 +1187,8 @@ class Simulator:
             job.epoch += 1
             self._mut += 1
             self._schedule_completion(job)
+            if self._lv is not None:
+                self._lv.refresh(job)
             self._warned_jobs.setdefault(id(rec), set()).add(job.job_id)
             self.metrics.count("emergency_ckpts")
             if record:
@@ -1162,6 +1245,8 @@ class Simulator:
         job.overhead_remaining = restore
         job.state = JobState.PENDING
         self.running.remove(job)
+        if self._lv is not None:
+            self._lv.release(job)
         self.pending.append(job)
         self.metrics.count("fault_revocations")
         if warned:
@@ -1240,6 +1325,7 @@ class Simulator:
         heap = self._heap
         heappop = heapq.heappop
         metrics = self.metrics
+        lazy = self._lazy
         while heap and heap[0][0] <= t:
             _, kind, _, payload, epoch = heappop(heap)
             if kind != _TICK and kind != _SAMPLE:
@@ -1307,6 +1393,11 @@ class Simulator:
                 job = payload
                 if job.epoch != epoch or job.state is not JobState.RUNNING:
                     continue  # stale prediction from before a preempt/resize
+                if lazy:
+                    # v2: integrate to the completion instant (v1 swept
+                    # the whole running set at the top of the batch; the
+                    # vector sync_all leaves this a dt == 0 no-op)
+                    job.advance(t)
                 if job.remaining_runtime() > self.eps:
                     # speed changed without epoch bump — repredict
                     self._schedule_completion(job)
@@ -1446,6 +1537,14 @@ class Simulator:
         policy_schedule = self.policy.schedule
         metrics_sample = self.metrics.sample
         soc = self.sample_on_change
+        # Per-batch progress sweep (ISSUE 11): v1 = the chunked advance
+        # of every running job (byte-identity contract); v2 vector = the
+        # ledger's masked-array sync_all (policy reads progress every
+        # pass); v2 lazy = nothing at all (jobs integrate at mutations).
+        advance = self._advance_running
+        if self._ledger is not None:
+            advance = self._lv.sync_all if self._lv is not None else None
+        snapping = self._snap_every is not None
         while heap:
             if self._quiesced():
                 break  # only fault/repair/tick residue past the last job
@@ -1454,6 +1553,11 @@ class Simulator:
             if t > max_time:
                 self._cutoff_at_horizon()
                 break
+            if snapping and t >= self._snap_next:
+                # between-batch instant: self.now is still the previous
+                # batch time and every index/heap invariant holds — the
+                # exact state a restore re-enters (sim/snapshot.py)
+                self._snapshot_tick(t)
             self.now = t
             if head[1] == _SAMPLE:
                 # _SAMPLE sorts last at equal timestamps, so a sample on
@@ -1474,7 +1578,8 @@ class Simulator:
                 # between batches occupancy is constant, so the busy
                 # chip-second integral is exact piecewise
                 hazard.observe(t, cluster)
-            self._advance_running(t)
+            if advance is not None:
+                advance(t)
             mm = self._mask_mut
             if self._drain_batch(t):
                 if soc and self._mask_mut != mm:
@@ -1489,6 +1594,10 @@ class Simulator:
                 if net is not None:
                     self._net_update()
             metrics_sample(self.now, cluster, len(running), len(pending))
+        if self._lazy:
+            # v2: the loop never swept progress — bring every still-
+            # running job to the final clock before the summary sums
+            self._advance_running(self.now)
         if self.net is not None:
             self.net.close(self.now)
         self._close_attribution()
@@ -1503,6 +1612,10 @@ class Simulator:
             policy=self.policy.name, jobs=len(self.jobs),
         ) as run_sp:
             n_batches = 0
+            advance = self._advance_running
+            if self._ledger is not None:
+                advance = self._lv.sync_all if self._lv is not None else None
+            snapping = self._snap_every is not None
             while self._heap:
                 if self._quiesced():
                     break  # only fault/repair/tick residue past the last job
@@ -1510,6 +1623,8 @@ class Simulator:
                 if t > self.max_time:
                     self._cutoff_at_horizon()
                     break
+                if snapping and t >= self._snap_next:
+                    self._snapshot_tick(t)
                 self.now = t
                 if self._heap[0][1] == _SAMPLE:
                     # pure-sample batch: same skip as the plain loop (no
@@ -1520,7 +1635,8 @@ class Simulator:
                 if self.hazard is not None:
                     self.hazard.observe(t, self.cluster)
                 with tracer.span("sim.batch", cat="sim", sim_now=t) as sp:
-                    self._advance_running(t)
+                    if advance is not None:
+                        advance(t)
                     mm = self._mask_mut
                     dirty = self._drain_batch(t)
                     if dirty:
@@ -1546,6 +1662,8 @@ class Simulator:
                     self.now, self.cluster, len(self.running), len(self.pending)
                 )
             run_sp.set(batches=n_batches).end_sim(self.now)
+        if self._lazy:
+            self._advance_running(self.now)
         if self.net is not None:
             self.net.close(self.now)
         self._close_attribution()
@@ -1583,6 +1701,19 @@ class Simulator:
         p_net = prof.phase("net_resolve")
         p_metrics = prof.phase("metrics_emit")
         fault_totals = prof.totals  # read fault_dispatch between clock reads
+        # v2 accounting (ISSUE 11): the vector ledger sync is its own
+        # phase (``ledger_sync``) so a v2 profile names the new per-batch
+        # cost; the lazy path has no per-batch sweep at all and charges
+        # nothing here.  Hazard wear integration stays under ``advance``.
+        advance = self._advance_running
+        adv_phase = p_advance
+        if self._ledger is not None:
+            if self._lv is not None:
+                advance = self._lv.sync_all
+                adv_phase = prof.phase("ledger_sync")
+            else:
+                advance = None
+        snapping = self._snap_every is not None
         while heap:
             if self._quiesced():
                 break  # only fault/repair/tick residue past the last job
@@ -1592,6 +1723,8 @@ class Simulator:
                 with p_metrics:
                     self._cutoff_at_horizon()
                 break
+            if snapping and t >= self._snap_next:
+                self._snapshot_tick(t)
             self.now = t
             if head[1] == _SAMPLE:
                 # pure-sample batch: same skip as the plain loop (no
@@ -1603,10 +1736,12 @@ class Simulator:
                 prof.add("event_apply", perf() - t0)
                 prof.batch_done()
                 continue
-            with p_advance:
-                if hazard is not None:
+            if hazard is not None:
+                with p_advance:
                     hazard.observe(t, cluster)
-                self._advance_running(t)
+            if advance is not None:
+                with adv_phase:
+                    advance(t)
             mm = self._mask_mut
             f0 = fault_totals["fault_dispatch"]
             t0 = perf()
@@ -1629,6 +1764,9 @@ class Simulator:
             with p_metrics:
                 metrics_sample(self.now, cluster, len(running), len(pending))
             prof.batch_done()
+        if self._lazy:
+            with p_advance:
+                self._advance_running(self.now)
         if self.net is not None:
             self.net.close(self.now)
         with p_metrics:
@@ -1639,6 +1777,59 @@ class Simulator:
             res = self.metrics.result(self.jobs, self.now)
         prof.finish()
         return res
+
+    # ------------------------------------------------------------------ #
+    # engine snapshot / restore / fork (ISSUE 11 tentpole)
+
+    def _snapshot_tick(self, t: float) -> None:
+        """Periodic snapshot trigger (``--snapshot-every``): serialize the
+        engine between batches — ``self.now`` is still the previous batch
+        time, the heap head at ``t`` is untouched — then re-arm at the
+        next multiple past ``t``.  Cold path; the run loops pay one bool
+        test per batch when disarmed."""
+        self.snapshot(self._snap_path)
+        every = self._snap_every
+        nxt = self._snap_next
+        while nxt <= t:
+            nxt += every
+        self._snap_next = nxt
+
+    def snapshot(self, path) -> None:
+        """Serialize the full engine state (jobset, cluster masks and
+        counters, heap + lazy-feed cursor, net dirty sets, metrics
+        accumulators, event-sink position) to a versioned snapshot file
+        (sim/snapshot.py).  Purely observational — the replay's own
+        bytes never move; RNG-free by construction since every stochastic
+        stream (trace, faults) is pregenerated into specs."""
+        from gpuschedule_tpu.sim.snapshot import save_snapshot
+
+        save_snapshot(self, path)
+        self._snap_writes += 1
+
+    @classmethod
+    def restore(cls, path, *, metrics=None, events_sink=None, profiler=None):
+        """Reconstruct a mid-replay simulator from :meth:`snapshot` in a
+        fresh process; ``run()`` then finishes the replay.  Under v1
+        accounting the resumed tail is byte-identical to the
+        uninterrupted run (events.jsonl / jobs.csv / utilization.csv);
+        under v2 it is closure-exact (docs/performance.md)."""
+        from gpuschedule_tpu.sim.snapshot import load_snapshot
+
+        return load_snapshot(
+            path, metrics=metrics, events_sink=events_sink,
+            profiler=profiler,
+        )
+
+    def fork(self):
+        """In-memory speculative copy (the digital-twin primitive): a
+        fully independent simulator continuing from this engine's exact
+        current state, with the event stream detached so what-if replays
+        never write into the parent's outputs.  Counters and utilization
+        integrals carry over, so the fork's ``result()`` covers the whole
+        history."""
+        from gpuschedule_tpu.sim.snapshot import fork_simulator
+
+        return fork_simulator(self)
 
     # ------------------------------------------------------------------ #
     # cache telemetry (ISSUE 10 tentpole)
@@ -1661,6 +1852,15 @@ class Simulator:
         stats["quiesce_memo"] = {
             "hit": self._stall_hits, "miss": self._stall_misses,
         }
+        if self._ledger is not None:
+            # v2 accounting (ISSUE 11): slot churn served in place vs
+            # capacity growth
+            for name, outcomes in self._ledger.cache_stats().items():
+                stats[name] = dict(outcomes)
+        if self._snap_writes or self._snap_restores:
+            stats["snapshot"] = {
+                "write": self._snap_writes, "restore": self._snap_restores,
+            }
         return stats
 
     def _harvest_cache_stats(self) -> None:

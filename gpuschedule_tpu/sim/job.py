@@ -227,9 +227,13 @@ class Job:
 
     def remaining_runtime(self) -> float:
         """Wall-clock seconds to completion at the current speed (inf if idle)."""
-        if self.effective_speed <= 0.0:
+        # effective_speed inlined (same expression as the property, so the
+        # division sees bit-identical floats) — this is called twice per
+        # completion at fleet scale
+        es = self.speed * self.locality_factor * self.slow_factor
+        if es <= 0.0:
             return float("inf")
-        t = self.overhead_remaining + self.remaining_work / self.effective_speed
+        t = self.overhead_remaining + self.remaining_work / es
         if self.ckpt_write_s > 0.0 and 0.0 < self.ckpt_every < math.inf:
             # priced checkpoint writes stretch the remaining wall time by
             # one write per ckpt_every work-seconds still owed — the same
@@ -237,6 +241,32 @@ class Job:
             # completion instant instead of firing early and re-predicting
             t += self.remaining_work * (self.ckpt_write_s / self.ckpt_every)
         return t
+
+    def _accrue_run_legs(self, a: Dict[str, float], e: float, span: float) -> None:
+        """Charge one productive interval's RUN_LEGS split (work +
+        policy-share + net-degraded + straggler) into the attribution
+        dict — the four-leg arithmetic both :meth:`advance` branches
+        (priced-checkpoint-write and plain) used to repeat verbatim
+        (ISSUE 11 satellite).  Expressions and dict insertion order are
+        identical to the historical inline copies, so every attribution
+        snapshot stays byte-for-byte (pinned by the closure grid in
+        tests/test_attrib.py)."""
+        a["work"] = a.get("work", 0.0) + e * span
+        if self.speed != 1.0:
+            a["policy-share"] = (
+                a.get("policy-share", 0.0) + (1.0 - self.speed) * span
+            )
+        if self.locality_factor != 1.0:
+            a["net-degraded"] = (
+                a.get("net-degraded", 0.0)
+                + self.speed * (1.0 - self.locality_factor) * span
+            )
+        if self.slow_factor != 1.0:
+            a["straggler"] = (
+                a.get("straggler", 0.0)
+                + self.speed * self.locality_factor
+                * (1.0 - self.slow_factor) * span
+            )
 
     def advance(self, now: float) -> None:
         """Integrate progress from ``last_update_time`` to ``now``.
@@ -250,6 +280,15 @@ class Job:
         effective-speed product inlined (same expression as the property,
         so every float is bit-identical) to keep the per-call overhead
         down at Philly scale.
+
+        The arithmetic is **segment-exact for any ``dt``**: between two
+        engine mutations a running job's rates are constant, so one call
+        spanning the whole gap computes the same reals as v1's
+        chunk-per-batch calls (the floats differ only in summation
+        order).  The v2 accounting mode (ISSUE 11) leans on exactly this
+        — it skips the per-batch sweep and advances each job lazily at
+        its next mutation/read point, under the closure (not
+        byte-identity) contract.
         """
         dt = now - self.last_update_time
         if dt < 0:
@@ -289,23 +328,7 @@ class Job:
                 if self.attrib is not None:
                     a = self.attrib
                     a["overhead"] = a.get("overhead", 0.0) + write
-                    a["work"] = a.get("work", 0.0) + e * run
-                    if self.speed != 1.0:
-                        a["policy-share"] = (
-                            a.get("policy-share", 0.0)
-                            + (1.0 - self.speed) * run
-                        )
-                    if self.locality_factor != 1.0:
-                        a["net-degraded"] = (
-                            a.get("net-degraded", 0.0)
-                            + self.speed * (1.0 - self.locality_factor) * run
-                        )
-                    if self.slow_factor != 1.0:
-                        a["straggler"] = (
-                            a.get("straggler", 0.0)
-                            + self.speed * self.locality_factor
-                            * (1.0 - self.slow_factor) * run
-                        )
+                    self._accrue_run_legs(a, e, run)
                 return
             e = self.speed * self.locality_factor * self.slow_factor
             self.executed_work += e * dt
@@ -316,23 +339,7 @@ class Job:
                 # arithmetic (s*l*f + (1-s) + s*(1-l) + s*l*(1-f) == 1);
                 # the decomposition's own ordered sum absorbs the float
                 # dust
-                a = self.attrib
-                a["work"] = a.get("work", 0.0) + e * dt
-                if self.speed != 1.0:
-                    a["policy-share"] = (
-                        a.get("policy-share", 0.0) + (1.0 - self.speed) * dt
-                    )
-                if self.locality_factor != 1.0:
-                    a["net-degraded"] = (
-                        a.get("net-degraded", 0.0)
-                        + self.speed * (1.0 - self.locality_factor) * dt
-                    )
-                if self.slow_factor != 1.0:
-                    a["straggler"] = (
-                        a.get("straggler", 0.0)
-                        + self.speed * self.locality_factor
-                        * (1.0 - self.slow_factor) * dt
-                    )
+                self._accrue_run_legs(self.attrib, e, dt)
 
     def jct(self) -> Optional[float]:
         """Job completion time (end - submit), once finished."""

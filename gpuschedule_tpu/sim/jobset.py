@@ -55,6 +55,11 @@ class JobSet:
         except KeyError:
             raise ValueError(f"{job!r} not in JobSet") from None
 
+    def discard(self, job: Job) -> None:
+        """Remove ``job`` if present (one dict op — the engine's start
+        path replaces its contains-then-remove pair with this)."""
+        self._jobs.pop(id(job), None)
+
     def __contains__(self, job: Job) -> bool:
         return id(job) in self._jobs
 
@@ -68,8 +73,11 @@ class JobSet:
         return bool(self._jobs)
 
     def __getitem__(self, index: int) -> Job:
-        """Positional access in insertion order (O(index); used by tests and
-        debugging, never by the engine hot path)."""
+        """Positional access in insertion order.  Index 0 is O(1) — FIFO's
+        head-of-line peek reads it once per start attempt; other indices
+        are O(index) (tests and debugging only)."""
+        if index == 0 and self._jobs:
+            return next(iter(self._jobs.values()))
         n = len(self._jobs)
         if index < 0:
             index += n
@@ -83,6 +91,13 @@ class JobSet:
 
     def __radd__(self, other: Iterable[Job]) -> List[Job]:
         return [*other, *self]
+
+    def __reduce__(self):
+        """Pickle as the ordered job list (engine snapshots, ISSUE 11):
+        the backing store is keyed by ``id(job)``, which is meaningless in
+        another process — reconstruction re-keys the same jobs (identity
+        preserved by the enclosing pickle graph) in the same order."""
+        return (JobSet, (list(self),))
 
     def __repr__(self) -> str:
         return f"JobSet({[j.job_id for j in self]})"

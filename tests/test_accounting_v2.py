@@ -1,0 +1,321 @@
+"""The v1-vs-v2 accounting oracle (ISSUE 11 tentpole).
+
+``--accounting v2`` replaces the v1 byte-identity contract (chunk-per-
+batch float sums) with **exact-sum closure**: every per-job metric and
+summary key must agree with v1 to <= 1e-9 relative (the reals are
+identical — only the float summation order moves), and the goodput /
+attribution decompositions must still close bit-exactly against
+``SimResult`` under the v2 summation order.
+
+The oracle runs the full 8-policy grid (``POLICY_CONFIGS``, the fault-
+sweep suite) x {plain, faults, net, attrib} on a seeded Philly-like
+world, replaying each cell under both accounting versions and comparing:
+
+- every ``summary()`` key (1e-9 rel),
+- every numeric per-job field the accounting integrates (1e-9 rel),
+- exact equality on the discrete fields (states, counts, event counts) —
+  a v2 replay that *schedules differently* is a bug, not float dust,
+- the analyzer's closure identities, bit-exact under v2's own sums.
+
+Non-vacuity: the v2 cells assert the lazy/vector machinery actually
+engaged (FIFO runs ledger-free lazy accounting; progress-reading
+policies run the vectorized ``JobLedger.sync_all`` with nonzero
+``ledger_rebuild`` telemetry).
+"""
+
+import pytest
+
+from gpuschedule_tpu.cluster.tpu import TpuCluster
+from gpuschedule_tpu.faults.recovery import FaultPlan, RecoveryModel
+from gpuschedule_tpu.faults.schedule import (
+    FaultConfig,
+    fault_horizon,
+    generate_fault_schedule,
+)
+from gpuschedule_tpu.faults.sweep import POLICY_CONFIGS
+from gpuschedule_tpu.net.model import NetModel
+from gpuschedule_tpu.net.sweep import promote_to_multislice
+from gpuschedule_tpu.obs.analyze import analyze_events
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.sim import Simulator
+from gpuschedule_tpu.sim.metrics import MetricsLog
+from gpuschedule_tpu.sim.philly import generate_philly_like_trace
+
+REL = 1e-9
+ARMS = ("plain", "faults", "net", "attrib")
+
+# numeric Job fields the accounting core integrates / mutates; compared
+# at 1e-9 rel between the two versions
+_JOB_FLOATS = (
+    "executed_work", "attained_service", "overhead_service",
+    "overhead_remaining", "lost_work", "lost_service", "last_update_time",
+)
+# timestamps: an ulp-shifted completion *prediction* legitimately moves
+# every later event time by float dust, so these compare at 1e-9 rel too
+_JOB_TIMES = ("first_start_time", "end_time")
+# discrete per-job outcomes: must match exactly — a v2 replay that
+# *decides* differently is broken, whatever the floats say
+_JOB_EXACT = (
+    "state", "preempt_count", "migration_count", "fault_count",
+    "allocated_chips",
+)
+
+
+def _rel_close(a, b):
+    return abs(a - b) <= REL * max(1.0, abs(a), abs(b))
+
+
+def _build_cell(policy_key: str, arm: str, accounting: str, seed: int = 7):
+    name, kwargs = POLICY_CONFIGS[policy_key]
+    net = None
+    if arm == "net":
+        cluster = TpuCluster("v5e", dims=(4, 4), num_pods=2)
+        jobs = promote_to_multislice(
+            generate_philly_like_trace(60, seed=seed),
+            0.15, cluster.pod_chips, seed=seed,
+        )
+        net = NetModel()
+    else:
+        cluster = TpuCluster("v5e", dims=(4, 4))
+        jobs = generate_philly_like_trace(60, seed=seed)
+    plan = None
+    if arm == "faults":
+        plan = FaultPlan(
+            records=generate_fault_schedule(
+                cluster, FaultConfig(mtbf=6 * 3600.0, repair=1800.0),
+                horizon=fault_horizon(jobs), seed=seed,
+            ),
+            recovery=RecoveryModel(ckpt_interval=900.0, restore=30.0),
+        )
+    metrics = MetricsLog(
+        record_events=True, attribution=(arm == "attrib"),
+        run_meta={"run_id": "t", "seed": seed, "policy": policy_key,
+                  "config_hash": "c"},
+    )
+    sim = Simulator(
+        cluster, make_policy(name, **kwargs), jobs,
+        metrics=metrics, faults=plan, net=net,
+        accounting=accounting,
+    )
+    return sim, metrics
+
+
+def _run_cell(policy_key: str, arm: str, accounting: str, seed: int = 7):
+    sim, metrics = _build_cell(policy_key, arm, accounting, seed=seed)
+    res = sim.run()
+    return sim, metrics, res
+
+
+# --------------------------------------------------------------------- #
+# the oracle grid
+
+
+def _assert_cells_equivalent(sim1, m1, res1, sim2, m2, res2):
+    s1, s2 = res1.summary(), res2.summary()
+    assert set(s1) == set(s2)
+    for key in s1:
+        a, b = s1[key], s2[key]
+        if isinstance(a, float) or isinstance(b, float):
+            assert _rel_close(a, b), f"summary[{key}]: {a} vs {b}"
+        else:
+            assert a == b, f"summary[{key}]: {a} vs {b}"
+    # the two replays made identical discrete decisions
+    assert len(m1.events) == len(m2.events)
+    assert [e.get("event") for e in m1.events] == \
+        [e.get("event") for e in m2.events]
+    jobs1 = sorted(sim1.jobs, key=lambda j: j.job_id)
+    jobs2 = sorted(sim2.jobs, key=lambda j: j.job_id)
+    assert [j.job_id for j in jobs1] == [j.job_id for j in jobs2]
+    for j1, j2 in zip(jobs1, jobs2):
+        for f in _JOB_EXACT:
+            assert getattr(j1, f) == getattr(j2, f), (j1.job_id, f)
+        for f in _JOB_FLOATS:
+            a, b = getattr(j1, f), getattr(j2, f)
+            assert _rel_close(a, b), (j1.job_id, f, a, b)
+        for f in _JOB_TIMES:
+            a, b = getattr(j1, f), getattr(j2, f)
+            assert (a is None) == (b is None), (j1.job_id, f)
+            if a is not None:
+                assert _rel_close(a, b), (j1.job_id, f, a, b)
+        if j1.attrib or j2.attrib:
+            assert set(j1.attrib) == set(j2.attrib), (j1.job_id, "legs")
+            for leg in j1.attrib:
+                assert _rel_close(j1.attrib[leg], j2.attrib[leg]), \
+                    (j1.job_id, leg)
+
+
+@pytest.mark.parametrize("arm", ARMS)
+@pytest.mark.parametrize("policy_key", sorted(POLICY_CONFIGS))
+def test_v1_v2_oracle(policy_key, arm):
+    sim1, m1, res1 = _run_cell(policy_key, arm, "v1")
+    sim2, m2, res2 = _run_cell(policy_key, arm, "v2")
+    _assert_cells_equivalent(sim1, m1, res1, sim2, m2, res2)
+    # non-vacuity: v2 actually ran the lazy/vector machinery
+    assert sim2._lazy and sim2._ledger is not None
+    reads = bool(getattr(sim2.policy, "reads_progress", True))
+    assert sim2._ledger.vector is reads
+    if reads:
+        assert sim2._ledger.rebuild_hits + sim2._ledger.rebuild_misses > 0
+    assert sim1._ledger is None  # v1 untouched by the ledger code
+
+
+def test_v1_v2_oracle_vector_branch_wide_running_set(monkeypatch):
+    """The numpy branch of ``JobLedger.sync_all`` (n >= SCALAR_CUTOVER).
+
+    The grid cells above run 16-chip worlds whose running sets never
+    reach the cutover, so they pin only the scalar fallback.  This cell
+    runs a 256-chip world that holds > SCALAR_CUTOVER concurrent jobs
+    with faults, priced checkpoint writes, AND attribution armed — every
+    vector leg (overhead burn, write split, attrib scatter) live — and
+    spies on ``sync_all`` to prove the masked-array path executed with
+    those legs active, at the same oracle tolerance."""
+    from gpuschedule_tpu.sim import ledger as ledger_mod
+
+    seen = {"peak": 0, "vector": 0, "overhead": 0, "priced": 0}
+    orig = ledger_mod.JobLedger.sync_all
+
+    def spy(self, t):
+        seen["peak"] = max(seen["peak"], self._n)
+        if self._n >= ledger_mod.SCALAR_CUTOVER:
+            seen["vector"] += 1
+            if bool(self._ov[:self._n].any()):
+                seen["overhead"] += 1
+            if bool(self._cw[:self._n].any()):
+                seen["priced"] += 1
+        return orig(self, t)
+
+    monkeypatch.setattr(ledger_mod.JobLedger, "sync_all", spy)
+
+    def cell(accounting):
+        seed = 11
+        cluster = TpuCluster("v5e", dims=(16, 16))
+        jobs = generate_philly_like_trace(200, seed=seed)
+        plan = FaultPlan(
+            records=generate_fault_schedule(
+                cluster, FaultConfig(mtbf=4 * 3600.0, repair=1800.0),
+                horizon=fault_horizon(jobs), seed=seed,
+            ),
+            recovery=RecoveryModel(
+                ckpt_interval=900.0, restore=30.0, ckpt_write=12.0,
+            ),
+        )
+        metrics = MetricsLog(
+            record_events=True, attribution=True,
+            run_meta={"run_id": "t", "seed": seed, "policy": "dlas",
+                      "config_hash": "c"},
+        )
+        sim = Simulator(
+            cluster, make_policy("dlas"), jobs, metrics=metrics,
+            faults=plan, accounting=accounting,
+        )
+        return sim, metrics, sim.run()
+
+    sim1, m1, res1 = cell("v1")
+    sim2, m2, res2 = cell("v2")
+    _assert_cells_equivalent(sim1, m1, res1, sim2, m2, res2)
+    # the point of this cell: the vector branch ran, legs armed
+    assert seen["peak"] >= ledger_mod.SCALAR_CUTOVER
+    assert seen["vector"] > 0
+    assert seen["overhead"] > 0
+    assert seen["priced"] > 0
+
+
+# --------------------------------------------------------------------- #
+# v2's own closure contract (bit-exact under the v2 summation order)
+
+
+@pytest.mark.parametrize("policy_key", ["fifo", "dlas"])
+def test_v2_closure_exact(policy_key):
+    """Goodput and attribution close bit-for-bit against SimResult under
+    v2's own sums — closure (not v1-byte-identity) is the v2 contract."""
+    sim, metrics, res = _run_cell(policy_key, "attrib", "v2")
+    an = analyze_events(iter(metrics.events))
+    assert an.goodput() == res.goodput
+    assert an.delay_by_cause() == res.delay_by_cause
+    at = an.attribution()
+    assert at["lost_chip_s"] == res.goodput["lost_chip_s"]
+    assert at["restart_overhead_chip_s"] == \
+        res.goodput["restart_overhead_chip_s"]
+
+
+def test_v2_faulted_closure_exact():
+    sim, metrics, res = _run_cell("srtf-ckpt", "faults", "v2")
+    an = analyze_events(iter(metrics.events))
+    assert an.goodput() == res.goodput
+    assert an.delay_by_cause() == res.delay_by_cause
+
+
+# --------------------------------------------------------------------- #
+# knob semantics
+
+
+def test_accounting_rejects_unknown_version():
+    cluster = TpuCluster("v5e", dims=(4, 4))
+    jobs = generate_philly_like_trace(5, seed=1)
+    with pytest.raises(ValueError, match="accounting"):
+        Simulator(cluster, make_policy("fifo"), jobs, accounting="v3")
+
+
+def test_v2_rides_config_hash():
+    """v2 is experiment config (the float contract changes), so it moves
+    the run hash; the v1 default leaves every historical hash untouched."""
+    import argparse
+
+    from gpuschedule_tpu.cli import _run_config_hash
+
+    def ns(**kw):
+        base = dict(
+            cluster="simple", chips=64, dims=None, pods=None,
+            gpu_shape=None, placement=None, placement_seed=None,
+            philly=None, trace=None, synthetic=20, seed=3,
+            arrival_rate=None, mean_duration=None, failure_rate=None,
+            util_min=None, max_job_chips=None, max_time=None, faults=None,
+        )
+        base.update(kw)
+        return argparse.Namespace(**base)
+
+    h_default = _run_config_hash(ns())
+    assert _run_config_hash(ns(accounting="v1")) == h_default
+    assert _run_config_hash(ns(accounting="v2")) != h_default
+
+
+def test_v2_profiled_ledger_sync_phase():
+    """obs/selfprof.py satellite: under v2 a progress-reading policy's
+    per-batch sync is its own ``ledger_sync`` phase, phases still sum to
+    total wall time exactly, and the v1 ``advance`` phase stays the home
+    of the end-of-run lazy sweep only."""
+    from gpuschedule_tpu.obs import PhaseProfiler
+
+    cluster = TpuCluster("v5e", dims=(4, 4))
+    jobs = generate_philly_like_trace(60, seed=3)
+    prof = PhaseProfiler()
+    Simulator(
+        cluster, make_policy("dlas"), jobs, accounting="v2", profiler=prof,
+    ).run()
+    p = prof.profile()
+    assert p["phases"]["ledger_sync"]["total_s"] > 0.0
+    phase_sum = sum(b["total_s"] for b in p["phases"].values())
+    assert phase_sum == pytest.approx(p["total_wall_s"], abs=1e-12)
+
+
+def test_ledger_rebuild_telemetry_surfaces():
+    """run --cache-stats coverage (ISSUE 11 satellite): a vector-ledger
+    v2 run exposes ledger_rebuild hit/miss through the unified
+    cache-telemetry family."""
+    cluster = TpuCluster("v5e", dims=(4, 4))
+    jobs = generate_philly_like_trace(60, seed=3)
+    metrics = MetricsLog(cache_telemetry=True)
+    sim = Simulator(
+        cluster, make_policy("dlas"), jobs, metrics=metrics,
+        accounting="v2",
+    )
+    res = sim.run()
+    stats = sim.cache_stats()
+    assert "ledger_rebuild" in stats
+    assert stats["ledger_rebuild"]["hit"] > 0
+    # growth beyond the initial capacity re-packs (miss) only when the
+    # running set outgrows it; either way the counters are consistent
+    assert stats["ledger_rebuild"]["miss"] >= 0
+    summary = res.summary()
+    assert summary["cache_ledger_rebuild_hit"] == \
+        float(stats["ledger_rebuild"]["hit"])

@@ -45,7 +45,8 @@ def test_build_sim_rejects_unknown_config():
 
 def test_floors_file_is_the_source_of_truth():
     """The pinned budget lives in tools/engine_bench_floors.json (ISSUE 9
-    satellite): every floored config is a real ladder config with a
+    satellite): every floored config is a real ladder config — a base
+    config or its ``-v2`` accounting variant (ISSUE 11) — with a
     positive jobs/sec budget, and the loaded FLOORS reflect the file."""
     import json
 
@@ -54,7 +55,8 @@ def test_floors_file_is_the_source_of_truth():
     doc = {k: v for k, v in json.loads(FLOORS_PATH.read_text()).items()
            if not k.startswith("_")}
     assert doc == FLOORS
-    assert set(FLOORS) <= set(CONFIGS)
+    bases = {c[: -len("-v2")] if c.endswith("-v2") else c for c in FLOORS}
+    assert bases <= set(CONFIGS)
     assert all(v > 0 for v in FLOORS.values())
 
 

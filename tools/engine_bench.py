@@ -63,6 +63,13 @@ LADDER_SIZES = (1_000, 10_000, 100_000)
 # BENCH_ENGINE_r09 before/after ladder run it
 MILLION = 1_000_000
 CONFIGS = ("plain", "faults", "net", "attrib")
+# v2-accounting rungs (ISSUE 11): any base config takes a ``-v2`` suffix
+# (``--accounting v2`` rewrites a whole ladder); the default ladder
+# carries the plain/attrib pair — the two rungs the >= 2x acceptance
+# criterion is pinned on (FIFO never reads running progress, so v2 runs
+# the fully-lazy path there)
+V2_PAIR = ("plain-v2", "attrib-v2")
+DEFAULT_CONFIGS = CONFIGS + V2_PAIR
 
 # Jobs/sec floors per configuration (the budget gate), pinned in
 # tools/engine_bench_floors.json (ISSUE 9: a data file so the tier-1
@@ -91,9 +98,18 @@ _MULTISLICE_SHARE = 0.5  # net rung: fraction promoted to 2-pod gangs
 
 def build_sim(config: str, num_jobs: int, *, seed: int = 0) -> Simulator:
     """One fresh, fully seeded replay for a ladder rung.  Fresh Job
-    objects every call — the engine mutates them in place."""
+    objects every call — the engine mutates them in place.  A ``-v2``
+    suffix (``plain-v2``) runs the same seeded world under v2 accounting
+    (ISSUE 11) — identical trace/cluster/schedule, closure-equivalent
+    sums, so the v1/v2 rung pair isolates the accounting core."""
+    accounting = "v1"
+    if config.endswith("-v2"):
+        accounting = "v2"
+        config = config[: -len("-v2")]
     if config not in CONFIGS:
-        raise ValueError(f"unknown config {config!r}; known: {CONFIGS}")
+        raise ValueError(
+            f"unknown config {config!r}; known: {CONFIGS} (+ '-v2' suffix)"
+        )
     cluster = TpuCluster("v5e", dims=_DIMS, num_pods=_NUM_PODS)
     jobs = generate_philly_like_trace(num_jobs, seed=seed)
     policy = make_policy("fifo")
@@ -117,7 +133,7 @@ def build_sim(config: str, num_jobs: int, *, seed: int = 0) -> Simulator:
         )
     elif config == "attrib":
         kwargs["metrics"] = MetricsLog(attribution=True)
-    return Simulator(cluster, policy, jobs, **kwargs)
+    return Simulator(cluster, policy, jobs, accounting=accounting, **kwargs)
 
 
 def run_rung(
@@ -259,8 +275,15 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--sizes", default=",".join(str(s) for s in LADDER_SIZES),
                    help="comma list of ladder trace lengths")
-    p.add_argument("--configs", default=",".join(CONFIGS),
-                   help=f"comma list from {CONFIGS}")
+    p.add_argument("--configs", default=",".join(DEFAULT_CONFIGS),
+                   help=f"comma list from {CONFIGS}, each optionally "
+                        f"'-v2'-suffixed (v2 accounting); default adds "
+                        f"the {V2_PAIR} pair")
+    p.add_argument("--accounting", choices=("v1", "v2"), default=None,
+                   help="force one accounting version across the whole "
+                        "ladder: v2 rewrites every config to its '-v2' "
+                        "form, v1 strips the suffix (ISSUE 11 "
+                        "passthrough; default = run configs as named)")
     p.add_argument("--seed", type=int, default=0,
                    help="governs trace, promotion AND fault streams")
     p.add_argument("--repeats", type=int, default=1,
@@ -294,6 +317,16 @@ def main(argv=None) -> int:
     if args.million and MILLION not in sizes:
         sizes = sizes + (MILLION,)
     configs = tuple(c.strip() for c in args.configs.split(",") if c.strip())
+    if args.accounting == "v2":
+        configs = tuple(
+            c if c.endswith("-v2") else c + "-v2" for c in configs
+        )
+    elif args.accounting == "v1":
+        configs = tuple(
+            c[: -len("-v2")] if c.endswith("-v2") else c for c in configs
+        )
+    # a forced version can collapse pairs (plain + plain-v2 -> plain)
+    configs = tuple(dict.fromkeys(configs))
     rungs = run_ladder(sizes, configs, seed=args.seed, repeats=args.repeats,
                        isolate=not args.no_isolate)
     gate = apply_gate(rungs, floor_scale=args.floor_scale)
