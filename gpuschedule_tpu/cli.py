@@ -130,6 +130,39 @@ def build_policy(args):
     return make_policy(args.policy, **kwargs)
 
 
+def build_fault_plan(args, cluster, jobs):
+    """Fault injection (faults/): one ``--seed`` governs every stochastic
+    stream in the run — trace synthesis keeps the bare seed (unchanged
+    from before faults existed), while each fault process derives its own
+    independent ``random.Random(f"{seed}:faults:<process>")`` stream, so
+    the same seed reproduces byte-identical trace AND fault schedules,
+    and changing the fault config never perturbs the trace (the
+    seed-split rule, documented in faults/schedule.py).  Shared by
+    ``run`` and ``whatif`` so the mirrored world is built identically."""
+    if not args.faults:
+        return None
+    from gpuschedule_tpu.faults import (
+        fault_horizon,
+        make_fault_plan,
+        parse_fault_spec,
+    )
+
+    try:
+        fconfig, frecovery = parse_fault_spec(args.faults)
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+    horizon = args.max_time if args.max_time else fault_horizon(jobs)
+    try:
+        return make_fault_plan(
+            cluster, fconfig, frecovery, horizon=horizon, seed=args.seed
+        )
+    except ValueError as e:
+        # config-vs-cluster mismatches (e.g. a domain weight naming a
+        # level this topology has no domains for) are user errors,
+        # not tracebacks
+        raise SystemExit(str(e)) from None
+
+
 def _run_config_hash(args) -> str:
     """Digest of the *experiment* config — cluster + trace + fault spec,
     deliberately not the policy — so `compare` accepts policy-A-vs-B runs
@@ -302,35 +335,7 @@ def cmd_run(args) -> int:
         )
     cluster = build_cluster(args, net=net_model)
     jobs = load_jobs(args)
-    # Fault injection (faults/): one --seed governs every stochastic stream
-    # in the run — trace synthesis keeps the bare seed (unchanged from
-    # before faults existed), while each fault process derives its own
-    # independent random.Random(f"{seed}:faults:<process>") stream, so the
-    # same seed reproduces byte-identical trace AND fault schedules, and
-    # changing the fault config never perturbs the trace (the seed-split
-    # rule, documented in faults/schedule.py).
-    fault_plan = None
-    if args.faults:
-        from gpuschedule_tpu.faults import (
-            fault_horizon,
-            make_fault_plan,
-            parse_fault_spec,
-        )
-
-        try:
-            fconfig, frecovery = parse_fault_spec(args.faults)
-        except ValueError as e:
-            raise SystemExit(str(e)) from None
-        horizon = args.max_time if args.max_time else fault_horizon(jobs)
-        try:
-            fault_plan = make_fault_plan(
-                cluster, fconfig, frecovery, horizon=horizon, seed=args.seed
-            )
-        except ValueError as e:
-            # config-vs-cluster mismatches (e.g. a domain weight naming a
-            # level this topology has no domains for) are user errors,
-            # not tracebacks
-            raise SystemExit(str(e)) from None
+    fault_plan = build_fault_plan(args, cluster, jobs)
     # With --events the stream goes straight to its JSONL sink (constant
     # memory at Philly scale): to the given PATH, or events.jsonl under
     # --out for the bare flag; --perfetto alone buffers events in RAM just
@@ -688,6 +693,140 @@ def cmd_history(args) -> int:
             } for r in rows],
             indent=2, sort_keys=True,
         ))
+    return 0
+
+
+def cmd_whatif(args) -> int:
+    """Interactive what-if queries against a mirrored replay (ISSUE 12):
+    build the world exactly like ``run``, advance the engine to ``--at``
+    and pause it there — a live mirror of cluster state — then answer
+    admit / drain / policy-swap queries by speculative forks (optionally
+    across a persistent worker pool), each returning the attributed
+    delta against a mutation-free baseline fork of the same bounded
+    horizon."""
+    from pathlib import Path
+
+    from gpuschedule_tpu.faults.sweep import jsonable
+    from gpuschedule_tpu.obs import MetricsRegistry
+    from gpuschedule_tpu.sim.metrics import MetricsLog
+    from gpuschedule_tpu.sim.whatif import (
+        WhatIfService,
+        append_history,
+        latency_summary,
+        parse_admit_spec,
+        parse_drain_spec,
+    )
+
+    net_model = build_net(args)
+    if args.placement == "contention" and net_model is None:
+        raise SystemExit(
+            "--placement contention scores pods by residual DCN bandwidth "
+            "and needs the fabric model: add --net"
+        )
+    cluster = build_cluster(args, net=net_model)
+    jobs = load_jobs(args)
+    fault_plan = build_fault_plan(args, cluster, jobs)
+    queries = []
+    try:
+        for spec in args.admit or []:
+            queries.extend(parse_admit_spec(spec))
+        for spec in args.drain or []:
+            queries.append(parse_drain_spec(spec))
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+    for name in args.swap_policy or []:
+        queries.append({"kind": "policy-swap", "policy": name})
+    if not queries:
+        raise SystemExit(
+            "whatif needs at least one --admit / --drain / --swap-policy "
+            "query"
+        )
+    if args.at < 0.0:
+        raise SystemExit(f"--at must be >= 0, got {args.at}")
+    # the mirror runs with attribution armed so every speculative delta
+    # decomposes by cause (the PR-5 machinery); whatif has no byte-compat
+    # surface of its own to preserve
+    metrics = MetricsLog(attribution=True)
+    try:
+        sim = Simulator(
+            cluster, build_policy(args), jobs,
+            metrics=metrics,
+            max_time=args.max_time or float("inf"),
+            faults=fault_plan,
+            net=net_model,
+            accounting=args.accounting,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+    sim.run_until(args.at)
+    # deterministic user errors must exit cleanly BEFORE evaluation — a
+    # pooled worker would otherwise retry them with backoff and surface
+    # a raw traceback
+    for q in queries:
+        at = q.get("at")
+        if at is None:
+            continue
+        if at < sim.now:
+            raise SystemExit(
+                f"query at={at} is before the mirror instant "
+                f"(the engine paused at t={sim.now}); speculative "
+                "mutations cannot land in the replayed past"
+            )
+        if at > min(sim.now + args.horizon, sim.max_time):
+            raise SystemExit(
+                f"query at={at} is beyond the bounded replay window "
+                f"(mirror t={sim.now} + horizon {args.horizon}, capped "
+                f"by --max-time {sim.max_time}); it would never be "
+                "applied — raise --horizon or move it earlier"
+            )
+    registry = MetricsRegistry()
+    try:
+        service = WhatIfService(
+            sim, horizon=args.horizon, workers=args.pool, registry=registry,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+    try:
+        results = service.evaluate(queries)
+    except ValueError as e:
+        # belt and braces: any remaining deterministic query error (the
+        # evaluator re-validates against the fork's actual bound) is a
+        # user error, not a traceback
+        raise SystemExit(str(e)) from None
+    finally:
+        service.close()
+    chash = _run_config_hash(args)
+    run_meta = {
+        "run_id": f"{args.policy}-s{args.seed}-{chash}",
+        "seed": args.seed, "policy": args.policy, "config_hash": chash,
+    }
+    doc = jsonable({
+        "at_s": sim.now,
+        "requested_at_s": args.at,
+        "horizon_s": args.horizon,
+        "pool": args.pool,
+        "policy": args.policy,
+        "run_id": run_meta["run_id"],
+        "config_hash": chash,
+        "mirror": {
+            "running": len(sim.running),
+            "pending": len(sim.pending),
+            "finished": len(sim.finished),
+        },
+        "latency_ms": latency_summary(results),
+        "queries": results,
+    })
+    print(json.dumps(doc, sort_keys=True))
+    if args.history:
+        n = append_history(args.history, results, run_meta=run_meta)
+        print(f"{n} whatif history rows -> {args.history}", file=sys.stderr)
+    if args.out:
+        out = Path(args.out)
+        if out.parent and not out.parent.exists():
+            out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(doc, indent=2, sort_keys=True))
+    if args.prom:
+        registry.write(prom_path=args.prom)
     return 0
 
 
@@ -1156,42 +1295,101 @@ def _apply_platform_override() -> None:
     jax.config.update("jax_platforms", plat)
 
 
+def _add_world_args(p) -> None:
+    """The world-building flags, defined ONCE and shared by every
+    subcommand that builds a seeded world (``run``, ``whatif``): the
+    builder helpers (build_cluster / load_jobs / build_policy /
+    build_fault_plan / build_net / _run_config_hash) read them by
+    attribute, so semantics — and the config hash — cannot drift
+    between subcommands."""
+    p.add_argument("--policy", choices=available(), default="fifo")
+    p.add_argument("--policy-arg", action="append", metavar="K=V",
+                   help="policy constructor kwarg (JSON values)")
+    p.add_argument("--cluster", default="tpu-v5e",
+                   choices=("simple", "tpu-v5e", "tpu-v5p", "gpu"))
+    p.add_argument("--chips", type=int, default=64,
+                   help="simple cluster size")
+    p.add_argument("--dims", help="TPU pod dims, e.g. 16x16 / 8x8x4")
+    p.add_argument("--pods", type=int, default=1)
+    p.add_argument("--gpu-shape", default="2x4x8",
+                   help="switches x nodes x gpus for --cluster gpu")
+    p.add_argument("--placement", default="consolidated",
+                   help="consolidated|random|greedy|topology (gpu) / "
+                        "consolidated|random|spread|contention|health "
+                        "(tpu; contention needs --net, health steers "
+                        "away from degraded/high-hazard chips)")
+    p.add_argument("--placement-seed", type=int, default=0)
+    p.add_argument("--philly", help="Philly-schema trace CSV")
+    p.add_argument("--trace", help="native-schema trace CSV")
+    p.add_argument("--synthetic", type=int, metavar="N",
+                   help="generate N-job Poisson trace")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--arrival-rate", type=float, default=1.0 / 60.0)
+    p.add_argument("--mean-duration", type=float, default=3600.0)
+    p.add_argument("--failure-rate", type=float, default=0.0)
+    p.add_argument("--util-min", type=float, default=1.0)
+    p.add_argument("--max-job-chips", type=int, default=256)
+    p.add_argument("--max-time", type=float)
+    p.add_argument("--curves", help="goodput curve cache (optimus)")
+    p.add_argument("--online", action="store_true",
+                   help="profile unseen models live (optimus)")
+    p.add_argument("--faults", metavar="SPEC",
+                   help="inject hardware faults: k=v pairs, e.g. "
+                        "mtbf=86400,repair=3600,ckpt=1800 (keys: mtbf, "
+                        "repair, maintenance, maintenance_duration, spot, "
+                        "spot_mtbf, spot_outage, spot_warning (pre-revoke "
+                        "notice window: emergency checkpoints when it "
+                        "covers the write cost), domain_mtbf / "
+                        "domain_repair (correlated host/rack/pod "
+                        "outages), domain_host / domain_rack / "
+                        "domain_pod (per-level outage-rate multipliers), "
+                        "hazard_shape (Weibull shape; 1 = memoryless), "
+                        "hazard_util (wear-driven aging weight), "
+                        "migrate_threshold (proactive checkpoint-and-"
+                        "migrate trigger), straggler_mtbf / "
+                        "straggler_repair / "
+                        "straggler_degrade (slow chips pacing their "
+                        "gangs), link_mtbf / link_repair / link_degrade, "
+                        "ckpt, restore, ckpt_write (priced periodic "
+                        "checkpoint writes; 'auto' sizes from model "
+                        "state); seconds, inf ok, restore=auto derives "
+                        "cost from the model size).  The fault schedule "
+                        "derives from --seed via independent RNG "
+                        "streams, so trace and faults reproduce together")
+    p.add_argument("--net", nargs="?", const=True, default=None,
+                   metavar="SPEC",
+                   help="model the shared DCN fabric (net/): multislice "
+                        "jobs get max-min fair bandwidth shares instead "
+                        "of the static isolated-fabric speed factor, "
+                        "re-priced on every running-set change.  SPEC is "
+                        "k=v pairs: os (core oversubscription ratio, "
+                        "default 4), ingest (Gbps per occupied chip, "
+                        "default 0.05), uplinks (redundant sibling "
+                        "uplinks per pod, default 1; >1 arms adaptive "
+                        "routing around degraded links), partial "
+                        "(bottleneck-group partial re-solve with the "
+                        "hierarchical core tier, default 0).  TPU "
+                        "clusters only; enables the "
+                        "'contention' placement scheme's residual-"
+                        "bandwidth scoring and ('link', pod) fault "
+                        "degradation")
+    p.add_argument("--accounting", choices=("v1", "v2"), default="v1",
+                   help="progress-accounting version (ISSUE 11): v1 "
+                        "(default) keeps the historical chunk-per-batch "
+                        "integration and its byte-identity contract; v2 "
+                        "integrates lazily / vectorized under an "
+                        "exact-sum closure contract instead — ~2x "
+                        "jobs/sec on policies that don't read running "
+                        "progress per batch.  v2 rides the config hash")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     _apply_platform_override()
     p = argparse.ArgumentParser(prog="gpuschedule_tpu", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
 
     run = sub.add_parser("run", help="replay a trace under a policy")
-    run.add_argument("--policy", choices=available(), default="fifo")
-    run.add_argument("--policy-arg", action="append", metavar="K=V",
-                     help="policy constructor kwarg (JSON values)")
-    run.add_argument("--cluster", default="tpu-v5e",
-                     choices=("simple", "tpu-v5e", "tpu-v5p", "gpu"))
-    run.add_argument("--chips", type=int, default=64, help="simple cluster size")
-    run.add_argument("--dims", help="TPU pod dims, e.g. 16x16 / 8x8x4")
-    run.add_argument("--pods", type=int, default=1)
-    run.add_argument("--gpu-shape", default="2x4x8",
-                     help="switches x nodes x gpus for --cluster gpu")
-    run.add_argument("--placement", default="consolidated",
-                     help="consolidated|random|greedy|topology (gpu) / "
-                          "consolidated|random|spread|contention|health "
-                          "(tpu; contention needs --net, health steers "
-                          "away from degraded/high-hazard chips)")
-    run.add_argument("--placement-seed", type=int, default=0)
-    run.add_argument("--philly", help="Philly-schema trace CSV")
-    run.add_argument("--trace", help="native-schema trace CSV")
-    run.add_argument("--synthetic", type=int, metavar="N",
-                     help="generate N-job Poisson trace")
-    run.add_argument("--seed", type=int, default=0)
-    run.add_argument("--arrival-rate", type=float, default=1.0 / 60.0)
-    run.add_argument("--mean-duration", type=float, default=3600.0)
-    run.add_argument("--failure-rate", type=float, default=0.0)
-    run.add_argument("--util-min", type=float, default=1.0)
-    run.add_argument("--max-job-chips", type=int, default=256)
-    run.add_argument("--max-time", type=float)
-    run.add_argument("--curves", help="goodput curve cache (optimus)")
-    run.add_argument("--online", action="store_true",
-                     help="profile unseen models live (optimus)")
+    _add_world_args(run)
     run.add_argument("--out", help="directory for jobs/utilization CSVs")
     run.add_argument("--prefix", default="")
     run.add_argument("--events", nargs="?", const=True, default=None,
@@ -1211,44 +1409,6 @@ def main(argv: Optional[List[str]] = None) -> int:
                      help="enable the obs span tracer (engine batches + "
                           "policy invocations); writes spans.trace.json "
                           "under --out and prints a span summary to stderr")
-    run.add_argument("--faults", metavar="SPEC",
-                     help="inject hardware faults: k=v pairs, e.g. "
-                          "mtbf=86400,repair=3600,ckpt=1800 (keys: mtbf, "
-                          "repair, maintenance, maintenance_duration, spot, "
-                          "spot_mtbf, spot_outage, spot_warning (pre-revoke "
-                          "notice window: emergency checkpoints when it "
-                          "covers the write cost), domain_mtbf / "
-                          "domain_repair (correlated host/rack/pod "
-                          "outages), domain_host / domain_rack / "
-                          "domain_pod (per-level outage-rate multipliers), "
-                          "hazard_shape (Weibull shape; 1 = memoryless), "
-                          "hazard_util (wear-driven aging weight), "
-                          "migrate_threshold (proactive checkpoint-and-"
-                          "migrate trigger), straggler_mtbf / "
-                          "straggler_repair / "
-                          "straggler_degrade (slow chips pacing their "
-                          "gangs), link_mtbf / link_repair / link_degrade, "
-                          "ckpt, restore, ckpt_write (priced periodic "
-                          "checkpoint writes; 'auto' sizes from model "
-                          "state); seconds, inf ok, restore=auto derives "
-                          "cost from the model size).  The fault schedule "
-                          "derives from --seed via independent RNG "
-                          "streams, so trace and faults reproduce together")
-    run.add_argument("--net", nargs="?", const=True, default=None,
-                     metavar="SPEC",
-                     help="model the shared DCN fabric (net/): multislice "
-                          "jobs get max-min fair bandwidth shares instead "
-                          "of the static isolated-fabric speed factor, "
-                          "re-priced on every running-set change.  SPEC is "
-                          "k=v pairs: os (core oversubscription ratio, "
-                          "default 4), ingest (Gbps per occupied chip, "
-                          "default 0.05), uplinks (redundant sibling "
-                          "uplinks per pod, default 1; >1 arms adaptive "
-                          "routing around degraded links).  TPU clusters "
-                          "only; enables the "
-                          "'contention' placement scheme's residual-"
-                          "bandwidth scoring and ('link', pod) fault "
-                          "degradation")
     run.add_argument("--attrib", action="store_true",
                      help="causal slowdown attribution: blame every queued "
                           "interval with its cause (capacity / policy-"
@@ -1261,14 +1421,6 @@ def main(argv: Optional[List[str]] = None) -> int:
                           "Adds delay_<cause>_s keys to the summary line; "
                           "off, the run is byte-identical to before this "
                           "flag existed")
-    run.add_argument("--accounting", choices=("v1", "v2"), default="v1",
-                     help="progress-accounting version (ISSUE 11): v1 "
-                          "(default) keeps the historical chunk-per-batch "
-                          "integration and its byte-identity contract; v2 "
-                          "integrates lazily / vectorized under an "
-                          "exact-sum closure contract instead — ~2x "
-                          "jobs/sec on policies that don't read running "
-                          "progress per batch.  v2 rides the config hash")
     run.add_argument("--snapshot", metavar="PATH",
                      help="with --snapshot-every: serialize the full "
                           "engine state here periodically, making the "
@@ -1327,6 +1479,51 @@ def main(argv: Optional[List[str]] = None) -> int:
                           "keyed by run_id/config_hash — `history trend` "
                           "renders trajectories across invocations")
     run.set_defaults(fn=cmd_run)
+
+    wi = sub.add_parser(
+        "whatif",
+        help="interactive what-if queries against a mirrored replay: "
+             "pause the world at --at, then answer admit / drain / "
+             "policy-swap questions by speculative forks with "
+             "attributed deltas (ISSUE 12)",
+    )
+    _add_world_args(wi)
+    wi.add_argument("--at", type=float, required=True, metavar="SECONDS",
+                    help="sim time to mirror the world at: the engine "
+                         "replays to the last batch at or before this "
+                         "instant and pauses there")
+    wi.add_argument("--horizon", type=float, default=86_400.0,
+                    metavar="SECONDS",
+                    help="bounded speculative-replay horizon per query "
+                         "(default: one day of sim time); deltas compare "
+                         "variant vs baseline forks at at+horizon")
+    wi.add_argument("--pool", type=int, default=0, metavar="N",
+                    help="persistent worker processes serving queries "
+                         "concurrently (each restores the mirror once, "
+                         "then forks per query); 0 (default) evaluates "
+                         "in-process")
+    wi.add_argument("--admit", action="append", metavar="SPEC",
+                    help="admit query: chips=8,duration=3600"
+                         "[,model=M][,at=T][,pods=0:2:5] — one candidate "
+                         "evaluation per pod in pods (omitted: the "
+                         "policy places it); repeatable")
+    wi.add_argument("--drain", action="append", metavar="SPEC",
+                    help="drain query: pod=7[,at=T][,duration=S] "
+                         "(duration defaults to permanent); repeatable")
+    wi.add_argument("--swap-policy", action="append", metavar="NAME",
+                    choices=available(),
+                    help="policy-swap query: rerun the future under "
+                         "NAME; repeatable")
+    wi.add_argument("--out", metavar="PATH",
+                    help="also write the full result document here")
+    wi.add_argument("--history", metavar="STORE",
+                    help="append one history row per query (kind "
+                         "'whatif') to the sqlite store at STORE")
+    wi.add_argument("--prom", metavar="PATH",
+                    help="write the query-latency histogram "
+                         "(whatif_query_latency_ms{kind}) in Prometheus "
+                         "text format")
+    wi.set_defaults(fn=cmd_whatif)
 
     gen = sub.add_parser("gen-trace", help="write a synthetic trace CSV")
     gen.add_argument("--num-jobs", type=int, required=True)

@@ -285,15 +285,21 @@ def grid_cells(
     ``workers > 1``.
 
     Crash resilience (ISSUE 8 satellite): a cell whose worker crashed or
-    was killed (OOM-killer, a BrokenProcessPool taking its poolmates
-    down with it) is retried up to ``max_retries`` times with
-    exponential backoff (``backoff_s * 2^round``) in a fresh pool before
-    the grid fails; only the failed cells re-run, and results still
-    reassemble in grid order, so a transiently-killed worker cannot
-    perturb the artifact.  The serial path retries raising cells the
-    same way.  ``retry_log`` (when given) collects one
-    ``{"cell": [key, index], "round": n}`` record per retried cell —
-    ``tools/fault_chaos.py`` reports them."""
+    was killed (OOM-killer, a hard ``os._exit``) is retried up to
+    ``max_retries`` times with exponential backoff
+    (``backoff_s * 2^round``) before the grid fails; only the failed
+    cells re-run, and results still reassemble in grid order, so a
+    transiently-killed worker cannot perturb the artifact.  The serial
+    path retries raising cells the same way.  ``retry_log`` (when given)
+    collects one ``{"cell": [key, index], "round": n}`` record per
+    retried cell — ``tools/fault_chaos.py`` reports them.
+
+    ISSUE 12: the parallel path rides the shared persistent
+    :class:`~gpuschedule_tpu.sim.pool.WorkerPool` — one long-lived set
+    of warm workers for the whole grid, a crash respawning exactly the
+    dead worker instead of a fresh pool per retry round.  Cells are
+    independent seeded replays either way, so the artifact stays
+    byte-identical to the serial one."""
     import time
 
     def note_retries(cells, rnd: int) -> None:
@@ -317,34 +323,20 @@ def grid_cells(
                         time.sleep(backoff_s * (2 ** attempt))
             out[key] = row
         return out
-    from concurrent.futures import ProcessPoolExecutor
+    from gpuschedule_tpu.sim.pool import WorkerPool
 
-    pending = {(key, i): pt for key in keys for i, pt in enumerate(points)}
-    results: Dict[Tuple[str, int], dict] = {}
-    rnd = 0
-    while True:
-        # a fresh pool per round: a killed worker breaks its whole pool,
-        # so the survivors of a crash cannot be resubmitted to it
-        with ProcessPoolExecutor(max_workers=workers) as pool:
-            futures = {
-                cell: pool.submit(run_one, cell[0], pt)
-                for cell, pt in pending.items()
-            }
-            failed: List[Tuple[str, int]] = []
-            for cell, fut in futures.items():
-                try:
-                    results[cell] = fut.result()
-                except Exception as exc:  # BrokenProcessPool included
-                    failed.append(cell)
-                    last_exc = exc
-        if not failed:
-            break
-        if rnd >= max_retries:
-            raise last_exc
-        rnd += 1
-        note_retries(failed, rnd)
-        time.sleep(backoff_s * (2 ** (rnd - 1)))
-        pending = {cell: pending[cell] for cell in failed}
+    cells = [(key, i) for key in keys for i in range(len(points))]
+    tasks = [(key, points[i]) for key, i in cells]
+
+    def on_retry(idx: int, attempt: int) -> None:
+        note_retries([cells[idx]], attempt)
+
+    with WorkerPool(
+        workers, max_retries=max_retries, backoff_s=backoff_s,
+        on_retry=on_retry,
+    ) as pool:
+        flat = pool.map(run_one, tasks)
+    results = dict(zip(cells, flat))
     return {
         key: [results[(key, i)] for i in range(len(points))] for key in keys
     }

@@ -152,15 +152,114 @@ class GroupCache:
         self.solved = 0
 
 
+def _components(
+    active: List[Flow], contended: set
+) -> Tuple[Dict[int, List[Flow]], List[Flow]]:
+    """Connected components of ``active`` over shared ``contended`` links
+    (union-find), plus the *free* flows — those loading no contended link
+    at all, which the fill would raise straight to their demand.  Both
+    outputs are in deterministic key order (``active`` is pre-sorted)."""
+    parent = list(range(len(active)))
+
+    def find(i: int) -> int:
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    anchor: Dict[str, int] = {}
+    for i, f in enumerate(active):
+        for link, w in f.links:
+            if link in contended:
+                j = anchor.setdefault(link, i)
+                if j != i:
+                    ri, rj = find(i), find(j)
+                    if ri != rj:
+                        parent[ri] = rj
+
+    comps: Dict[int, List[Flow]] = {}
+    free: List[Flow] = []
+    for i, f in enumerate(active):
+        if not any(link in contended for link, _ in f.links):
+            free.append(f)
+            continue
+        comps.setdefault(find(i), []).append(f)
+    return comps, free
+
+
+def _solve_groups(
+    comps: Dict[int, List[Flow]],
+    capacity_gbps: Dict[str, float],
+    rate: Dict[str, float],
+    cache: Optional[GroupCache],
+    *,
+    exclude: Optional[str] = None,
+) -> None:
+    """Solve each component with the verbatim fill over its member flows
+    and every link they load (slack ones included, at full capacity —
+    they never bind, but keeping them preserves the flat loop's shape),
+    reusing any cached :class:`GroupSolve` whose inputs are bitwise
+    unchanged.  ``exclude`` drops one link from every solve — the
+    hierarchical path's top tier, which is applied afterwards as a
+    water-level clamp instead of riding each group's fill."""
+    new_groups: Dict[Tuple[str, ...], GroupSolve] = {}
+    for members in comps.values():
+        key = tuple(f.key for f in members)   # members are in key order
+        links = sorted({
+            link for f in members for link, _ in f.links if link != exclude
+        })
+        caps = tuple((link, float(capacity_gbps[link])) for link in links)
+        flows_t = tuple(members)
+        hit = cache.groups.get(key) if cache is not None else None
+        if hit is not None and hit.flows == flows_t and hit.caps == caps:
+            rate.update(hit.rates)
+            solve = hit
+            cache.reused += 1
+        else:
+            grate = {f.key: 0.0 for f in members}
+            headroom = {link: max(0.0, c) for link, c in caps}
+            sat_floor = {
+                link: _EPS * (1.0 + headroom[link]) for link in headroom
+            }
+            if exclude is None:
+                fill_members = {f.key: f for f in members}
+            else:
+                fill_members = {
+                    f.key: Flow(
+                        f.key,
+                        tuple(
+                            (link, w) for link, w in f.links
+                            if link != exclude
+                        ),
+                        f.demand,
+                    )
+                    for f in members
+                }
+            _progressive_fill(fill_members, grate, headroom, sat_floor)
+            rate.update(grate)
+            solve = GroupSolve(flows_t, caps, grate)
+            if cache is not None:
+                cache.solved += 1
+        if cache is not None:
+            new_groups[key] = solve
+    if cache is not None:
+        # only current components stay cached: a group that dissolved
+        # (membership changed) can never be reused under the bitwise
+        # signature anyway
+        cache.groups = new_groups
+
+
 def maxmin_allocate_grouped(
     flows: Iterable[Flow],
     capacity_gbps: Dict[str, float],
     *,
     cache: Optional[GroupCache] = None,
     validate: bool = True,
+    top: Optional[str] = None,
 ) -> Dict[str, float]:
     """Max-min fair rates by **bottleneck-group decomposition** — the
-    ISSUE 9 partial re-solve.
+    ISSUE 9 partial re-solve, extended with the ISSUE 12 **hierarchical
+    top tier**.
 
     Links that cannot bind — offered load comfortably below capacity, so
     progressive filling could never saturate them — are *slack*; flows
@@ -172,19 +271,53 @@ def maxmin_allocate_grouped(
     but keeping them preserves the flat loop's shape), and a flow none of
     whose links are contended takes its full demand outright.
 
+    ``top`` names the fabric's single globally-shared link (the
+    oversubscribed aggregation core).  Without it, a contended core
+    couples every flow into one monolithic component and the
+    decomposition gets nothing — the carried PR-9 omission.  With it,
+    when the top link is contended the solve goes **hierarchical**:
+
+    1. components form over the contended links *beneath* the top tier
+       (per-pod uplink groups), each solved locally with the top link
+       removed — progressive filling's dynamics cannot feel a constraint
+       until it saturates, so below the core's waterline the local
+       trajectories ARE the global ones;
+    2. each local solve's final rates are the flows' *freeze levels*
+       ``mu``; the core then binds every flow still active at its
+       waterline ``lam`` — the unique level where
+       ``sum(w_top * min(mu, lam)) == top capacity`` — and the global
+       max-min rates are exactly ``min(mu, lam)`` in real arithmetic;
+    3. the per-group local solves cache and reuse like any other group
+       (a single-pod dirty set re-solves only that pod's group; the core
+       clamp itself is a cheap exact re-derivation every pass).
+
+    When the top tier never binds (slack by the 2x offered-load margin),
+    when some active flow does not cross the top link (the clamp is only
+    exact under the fabric invariant that ALL traffic transits the
+    core), or when one local component spans every active flow anyway
+    (nothing to decompose), the solve falls back to the non-hierarchical
+    path —
+    so slack-core fabrics and single-pod worlds keep their historical
+    grouped arithmetic bit for bit, including the "one group spanning
+    every flow reproduces the flat loop exactly" property.
+
     With a :class:`GroupCache`, a group whose inputs (member flows and
-    all loaded-link capacities) are bitwise unchanged since its last
-    solve reuses the cached rates — the deterministic pure fill would
-    redo identical arithmetic — so a dirty set touching one group
-    re-solves only that group.  ``cache=None`` solves every group fresh:
-    the equivalence comparator, byte-identical by construction.
+    all loaded-link capacities; the top link's capacity excluded for
+    hierarchical groups — ingest churn moves it every pass) are bitwise
+    unchanged since its last solve reuses the cached rates — the
+    deterministic pure fill would redo identical arithmetic — so a dirty
+    set touching one group re-solves only that group.  ``cache=None``
+    solves every group fresh: the equivalence comparator, byte-identical
+    by construction.
 
     The decomposition equals the flat solver exactly in real arithmetic
-    and reproduces it bit-for-bit whenever one group spans every flow;
-    across multiple groups the flat solver's global increment chunking
-    re-associates float sums, so rates may differ in the last ulp — which
-    is why the grouped arithmetic is an opt-in (``NetConfig.partial``)
-    and the flat pass remains the no-flag fallback and oracle."""
+    (the hierarchical clamp to saturation-tolerance level, since the
+    flat loop freezes the core within ``_EPS`` of capacity while the
+    waterline is exact); across multiple groups the flat solver's global
+    increment chunking re-associates float sums, so rates may differ in
+    the last ulp — which is why the grouped arithmetic is an opt-in
+    (``NetConfig.partial``) and the flat pass remains the no-flag
+    fallback and oracle."""
     flows = sorted(flows, key=lambda f: f.key)
     if validate:
         _validate_flows(flows, capacity_gbps)
@@ -206,63 +339,87 @@ def maxmin_allocate_grouped(
         if cap - ld < 2.0 * _EPS * (1.0 + cap):
             contended.add(link)
 
-    # connected components over shared contended links (union-find)
-    parent = list(range(len(active)))
+    # the hierarchical tier is exact only when EVERY active flow crosses
+    # the top link (the fabric model's invariant: all traffic transits
+    # the core).  A flow bypassing a contended top while sharing a
+    # contended local link with a core-clamped flow could, in the flat
+    # loop, keep filling the capacity the clamp freed — the water-level
+    # clamp can only lower rates, never redistribute — so such instances
+    # take the non-hierarchical path, which has no exactness caveat.
+    if (
+        top is not None
+        and top in contended
+        and all(any(link == top for link, _ in f.links) for f in active)
+    ):
+        local = contended - {top}
+        comps, free = _components(active, local)
+        if free or len(comps) > 1:
+            # hierarchical: local solves beneath the top tier, then the
+            # top tier's exact water-level clamp
+            mu: Dict[str, float] = {}
+            for f in free:
+                mu[f.key] = f.demand
+            _solve_groups(comps, capacity_gbps, mu, cache, exclude=top)
+            return _clamp_to_top(active, mu, capacity_gbps, top, rate)
+        # one component spans every active flow: nothing decomposes —
+        # fall through to the non-hierarchical path, whose single group
+        # (coupled via the contended top) IS the flat loop bit for bit
 
-    def find(i: int) -> int:
-        while parent[i] != i:
-            parent[i] = parent[parent[i]]
-            i = parent[i]
-        return i
+    comps, free = _components(active, contended)
+    for f in free:
+        # every link this flow loads can carry the whole offered load:
+        # the fill would raise it straight to its demand
+        rate[f.key] = f.demand
+    _solve_groups(comps, capacity_gbps, rate, cache)
+    return rate
 
-    anchor: Dict[str, int] = {}
-    for i, f in enumerate(active):
-        for link, w in f.links:
-            if link in contended:
-                j = anchor.setdefault(link, i)
-                if j != i:
-                    ri, rj = find(i), find(j)
-                    if ri != rj:
-                        parent[ri] = rj
 
-    comps: Dict[int, List[Flow]] = {}
-    for i, f in enumerate(active):
-        if not any(link in contended for link, _ in f.links):
-            # every link this flow loads can carry the whole offered load:
-            # the fill would raise it straight to its demand
-            rate[f.key] = f.demand
-            continue
-        comps.setdefault(find(i), []).append(f)
-
-    new_groups: Dict[Tuple[str, ...], GroupSolve] = {}
-    for members in comps.values():
-        key = tuple(f.key for f in members)   # members are in key order
-        links = sorted({link for f in members for link, _ in f.links})
-        caps = tuple((link, float(capacity_gbps[link])) for link in links)
-        flows_t = tuple(members)
-        hit = cache.groups.get(key) if cache is not None else None
-        if hit is not None and hit.flows == flows_t and hit.caps == caps:
-            rate.update(hit.rates)
-            solve = hit
-            cache.reused += 1
-        else:
-            grate = {f.key: 0.0 for f in members}
-            headroom = {link: max(0.0, c) for link, c in caps}
-            sat_floor = {
-                link: _EPS * (1.0 + headroom[link]) for link in headroom
-            }
-            _progressive_fill(
-                {f.key: f for f in members}, grate, headroom, sat_floor
-            )
-            rate.update(grate)
-            solve = GroupSolve(flows_t, caps, grate)
-            if cache is not None:
-                cache.solved += 1
-        if cache is not None:
-            new_groups[key] = solve
-    if cache is not None:
-        # only current components stay cached: a group that dissolved
-        # (membership changed) can never be reused under the bitwise
-        # signature anyway
-        cache.groups = new_groups
+def _clamp_to_top(
+    active: List[Flow],
+    mu: Dict[str, float],
+    capacity_gbps: Dict[str, float],
+    top: str,
+    rate: Dict[str, float],
+) -> Dict[str, float]:
+    """Apply the top tier as a water-level clamp over the local freeze
+    levels ``mu``: find the unique ``lam`` where the top link's consumed
+    capacity ``sum(w * min(mu_f, lam))`` meets its capacity, and clamp
+    every top-crossing flow there.  Flows not crossing the top link (and
+    every flow, when the offered ``mu`` load fits outright) keep their
+    local levels.  Deterministic: flows walk in ascending
+    ``(mu, key)`` order, so every float sum has one canonical chunking —
+    what makes cache-on and cache-off solves byte-identical."""
+    weight: Dict[str, float] = {}
+    for f in active:
+        w = 0.0
+        for link, lw in f.links:
+            if link == top:
+                w += lw
+        weight[f.key] = w
+    order = sorted((mu[f.key], f.key) for f in active if weight[f.key] > 0.0)
+    top_cap = max(0.0, float(capacity_gbps[top]))
+    total = 0.0
+    wsum = 0.0
+    for m, k in order:
+        total += weight[k] * m
+        wsum += weight[k]
+    if total <= top_cap:
+        # the top tier never binds at these freeze levels
+        for f in active:
+            rate[f.key] = mu[f.key]
+        return rate
+    below = 0.0
+    wrem = wsum
+    lam = order[-1][0] if order else 0.0
+    for m, k in order:
+        if below + m * wrem >= top_cap:
+            lam = (top_cap - below) / wrem
+            break
+        below += weight[k] * m
+        wrem -= weight[k]
+    lam = max(0.0, lam)
+    for f in active:
+        k = f.key
+        m = mu[k]
+        rate[k] = min(m, lam) if weight[k] > 0.0 else m
     return rate

@@ -676,11 +676,16 @@ class NetModel:
         if self._group_cache is not None:
             # bottleneck-group solve (ISSUE 9): group reuse only through
             # the cache; partial_cache=False solves every group fresh
-            # with identical arithmetic (the equivalence comparator)
+            # with identical arithmetic (the equivalence comparator).
+            # top=CORE (ISSUE 12) arms the hierarchical tier: a contended
+            # oversubscribed core no longer couples every flow into one
+            # monolithic group — per-pod groups solve (and cache) beneath
+            # it, with the core applied as an exact water-level clamp.
             rates = maxmin_allocate_grouped(
                 flows, capacity,
                 cache=self._group_cache if self.partial_cache else None,
                 validate=not reused,
+                top=CORE,
             )
         else:
             rates = maxmin_allocate(flows, capacity, validate=not reused)

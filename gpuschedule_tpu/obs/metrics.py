@@ -35,6 +35,14 @@ DEFAULT_BUCKETS = (
     0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0, 600.0, math.inf
 )
 
+# Millisecond-scale buckets for interactive-query latency (the what-if
+# service's per-query histogram, ISSUE 12): sub-ms through tens of
+# seconds, dense around the 100-500 ms budget the digital twin serves in.
+LATENCY_BUCKETS_MS = (
+    1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 350.0, 500.0, 750.0,
+    1000.0, 2000.0, 5000.0, 10_000.0, 30_000.0, math.inf
+)
+
 _VALID_FIRST = set("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ_:")
 _VALID_REST = _VALID_FIRST | set("0123456789")
 

@@ -45,6 +45,16 @@ from gpuschedule_tpu.sim.metrics import MetricsLog, SimResult
 _COMPLETION, _ARRIVAL, _TICK, _FAULT, _REPAIR, _WARN, _SAMPLE = (
     0, 1, 2, 3, 4, 5, 6
 )
+# Injected what-if mutations (ISSUE 12, sim/whatif.py): sorts after
+# everything at an equal timestamp — INCLUDING _SAMPLE, so the run
+# loops' samples-only fast path is gated on `_whatif_pending == 0` (a
+# sample on top no longer proves the batch is all samples while a
+# mutation is in flight).  Critically EVEN: the lazy spec feed treats
+# odd kinds as cursor-fed specs (popping one admits the next), so an
+# injected event must never wear an odd kind or it would double-feed
+# the cursor.  Only present in speculative forks / direct API use;
+# ordinary replays never push it.
+_WHATIF = 8
 
 
 def _prog(job: Job) -> dict:
@@ -71,6 +81,16 @@ class Simulator:
     :meth:`preempt`, :meth:`set_speed`, :meth:`migrate`), which keeps
     progress accounting and completion prediction consistent.
     """
+
+    # count of injected-but-unapplied what-if events in the heap (class
+    # default so restored pre-ISSUE-12 snapshots read 0).  _WHATIF sorts
+    # after _SAMPLE, so the run loops' "sample on top means the whole
+    # batch is samples" fast path is only sound while this is zero —
+    # with a mutation pending, sample-topped batches take the full path
+    # (pre-advance, fault dispatch, policy pass, net update).  Ordinary
+    # replays never inject, so the fast path — and its byte-identity
+    # contract — is untouched outside speculative forks.
+    _whatif_pending = 0
 
     def __init__(
         self,
@@ -541,6 +561,13 @@ class Simulator:
             # pausing-in-place is expressed via preempt(suspend=True) instead.
             raise ValueError(f"try_start requires speed > 0, got {speed}")
         chips = chips if chips is not None else job.num_chips
+        if job.pin_hint is not None:
+            # what-if placement pin (ISSUE 12): the injected candidate's
+            # hint wins over the policy's on key conflicts
+            placement_hint = (
+                {**placement_hint, **job.pin_hint} if placement_hint
+                else job.pin_hint
+            )
         alloc = self.cluster.allocate(chips, job=job, hint=placement_hint)
         if alloc is None:
             return False
@@ -1427,6 +1454,11 @@ class Simulator:
                 else:
                     self._apply_repair(payload, t)
                 dirty = True  # restored capacity: waiters may now place
+            elif kind == _WHATIF:
+                # injected what-if mutation (cold path: only speculative
+                # forks ever push these)
+                self._apply_whatif(payload, t)
+                dirty = True
             else:  # _TICK
                 dirty = True
         return dirty
@@ -1559,7 +1591,7 @@ class Simulator:
                 # exact state a restore re-enters (sim/snapshot.py)
                 self._snapshot_tick(t)
             self.now = t
-            if head[1] == _SAMPLE:
+            if head[1] == _SAMPLE and not self._whatif_pending:
                 # _SAMPLE sorts last at equal timestamps, so a sample on
                 # top means the whole batch is samples: nothing scheduler-
                 # visible changes and no progress needs integrating.
@@ -1626,7 +1658,7 @@ class Simulator:
                 if snapping and t >= self._snap_next:
                     self._snapshot_tick(t)
                 self.now = t
-                if self._heap[0][1] == _SAMPLE:
+                if self._heap[0][1] == _SAMPLE and not self._whatif_pending:
                     # pure-sample batch: same skip as the plain loop (no
                     # advance, no metrics.sample, no policy, no span —
                     # the sampler observes, the replay must not feel it)
@@ -1726,7 +1758,7 @@ class Simulator:
             if snapping and t >= self._snap_next:
                 self._snapshot_tick(t)
             self.now = t
-            if head[1] == _SAMPLE:
+            if head[1] == _SAMPLE and not self._whatif_pending:
                 # pure-sample batch: same skip as the plain loop (no
                 # advance, no metrics.sample, no policy); sample batches
                 # can never contain faults (_SAMPLE sorts last), so the
@@ -1777,6 +1809,199 @@ class Simulator:
             res = self.metrics.result(self.jobs, self.now)
         prof.finish()
         return res
+
+    # ------------------------------------------------------------------ #
+    # what-if speculation (ISSUE 12, sim/whatif.py)
+
+    def run_until(self, t: float) -> None:
+        """Advance the replay through every batch at time <= ``t``, then
+        pause *between batches* — exactly the instant :meth:`snapshot` /
+        :meth:`fork` capture, so a paused engine is a live mirror to
+        speculate from.  The loop body is the plain loop's exact call
+        sequence; pausing never finalizes (no horizon cutoff, no
+        attribution close, no summary — those belong to :meth:`run`,
+        which picks up seamlessly), so ``run_until(t)`` followed by
+        ``run()`` replays byte-identically to an uninterrupted ``run()``
+        (pinned by tests/test_whatif.py)."""
+        heap = self._heap
+        max_time = self.max_time
+        net = self.net
+        hazard = self.hazard
+        cluster = self.cluster
+        running, pending = self.running, self.pending
+        policy_schedule = self.policy.schedule
+        metrics_sample = self.metrics.sample
+        soc = self.sample_on_change
+        advance = self._advance_running
+        if self._ledger is not None:
+            advance = self._lv.sync_all if self._lv is not None else None
+        snapping = self._snap_every is not None
+        while heap:
+            if self._quiesced():
+                break
+            head = heap[0]
+            bt = head[0]
+            if bt > t or bt > max_time:
+                break
+            if snapping and bt >= self._snap_next:
+                self._snapshot_tick(bt)
+            self.now = bt
+            if head[1] == _SAMPLE and not self._whatif_pending:
+                self._drain_batch(bt)
+                continue
+            if hazard is not None:
+                hazard.observe(bt, cluster)
+            if advance is not None:
+                advance(bt)
+            mm = self._mask_mut
+            if self._drain_batch(bt):
+                if soc and self._mask_mut != mm:
+                    self._emit_sample(bt)
+                wakeup = policy_schedule(self)
+                if wakeup is not None:
+                    self.request_wakeup(wakeup)
+                if net is not None:
+                    self._net_update()
+            metrics_sample(self.now, cluster, len(running), len(pending))
+
+    def inject_admit(self, job: Job, *, t: Optional[float] = None,
+                     pin: Optional[dict] = None) -> Job:
+        """Queue a synthetic arrival — the "admit this job (where)?"
+        what-if mutation.  ``job`` joins the trace at ``t`` (default:
+        now) through the ordinary arrival path (admission control, blame
+        tagging, policy pass); ``pin`` (an allocation hint, e.g.
+        ``{"pod": 3}``) rides the job as :attr:`Job.pin_hint` and wins
+        over the policy's placement hints, so candidate placements are
+        comparable across forks.  Meant for speculative forks; calling
+        it on a live run legitimately extends that run's trace."""
+        at = self.now if t is None else float(t)
+        if at < self.now:
+            raise ValueError(
+                f"inject_admit at {at} is in the past (now={self.now})"
+            )
+        job.submit_time = at
+        job.arrival_seq = len(self.jobs)
+        if pin:
+            job.pin_hint = dict(pin)
+        if self.attribution:
+            job.attrib = {}
+        if self.faults is not None and self.faults.recovery is not None:
+            recovery = self.faults.recovery
+            if getattr(recovery, "writes_cost", lambda: False)():
+                interval = recovery.checkpoint_interval(job)
+                if 0.0 < interval < math.inf:
+                    job.ckpt_write_s = recovery.ckpt_write_seconds(
+                        job, self.cluster
+                    )
+                    job.ckpt_every = interval
+        self.jobs.append(job)
+        self._whatif_pending += 1
+        self._push(at, _WHATIF, ("admit", job))
+        return job
+
+    def inject_drain(self, scope, *, t: Optional[float] = None,
+                     duration: float = math.inf):
+        """Schedule a what-if drain: every chip under ``scope`` (e.g.
+        ``("pod", 7)``) leaves service at ``t`` (default: now) for
+        ``duration`` seconds, as a synthetic ``maintenance`` outage
+        riding the ordinary fault path — running gangs revoke with
+        checkpoint recovery priced by the armed RecoveryModel (a default
+        one is armed when the run had no fault plan), capacity returns
+        at the repair.  Answers "drain pod 7 now or at the maintenance
+        window?" by forked replay of both variants."""
+        at = self.now if t is None else float(t)
+        if at < self.now:
+            raise ValueError(
+                f"inject_drain at {at} is in the past (now={self.now})"
+            )
+        from gpuschedule_tpu.faults.recovery import FaultPlan
+        from gpuschedule_tpu.faults.schedule import FaultRecord
+
+        rec = FaultRecord(at, tuple(scope), float(duration), "maintenance")
+        if self.faults is None:
+            self.faults = FaultPlan(records=[rec])
+        else:
+            self.faults.records.append(rec)
+        # registered like a scheduled record: snapshot/fork remap the
+        # id()-keyed index through the records list, injected or not
+        self._fault_ids[id(rec)] = len(self.faults.records) - 1
+        self._drain_faults = True
+        self._whatif_pending += 1
+        self._push(at, _WHATIF, ("fault", rec))
+        return rec
+
+    def swap_policy(self, policy) -> None:
+        """Replace the scheduling policy mid-replay — the "what if we
+        ran SRTF instead?" mutation.  Per-job policy scratch
+        (``Job.sched``) is cleared for live jobs so the incoming policy
+        derives its own state lazily; engine-owned accounting (progress,
+        attained service, attribution legs) carries over untouched.
+        Under v2 accounting the ledger rebuilds for the new policy's
+        ``reads_progress`` declaration.  A tick is pushed at the swap
+        instant so the incoming policy gets an immediate scheduling pass
+        — without it the swap would lie dormant until the next dirty
+        batch (hours of sim time away on a quiet heap), and a
+        policy-swap what-if would under-measure its own delta."""
+        for job in self.pending:
+            job.sched.clear()
+        for job in self.running:
+            job.sched.clear()
+        self.policy = policy
+        if self._lazy:
+            from gpuschedule_tpu.sim.ledger import JobLedger
+
+            self._ledger = JobLedger(
+                attribution=self.attribution,
+                vector=bool(getattr(policy, "reads_progress", True)),
+            )
+            self._lv = self._ledger if self._ledger.vector else None
+            if self._lv is not None:
+                for job in self.running:
+                    self._lv.bind(job)
+        policy.attach(self)
+        # request_wakeup drops same-instant ticks; the swap wants one NOW
+        self._push(self.now, _TICK)
+
+    def _apply_whatif(self, payload, t: float) -> None:
+        """Apply one injected what-if mutation: a synthetic arrival
+        (mirroring the _ARRIVAL branch — kept inline there for the hot
+        path) or a drain record dispatched down the ordinary fault
+        path."""
+        self._whatif_pending -= 1
+        kind = payload[0]
+        if kind == "admit":
+            job: Job = payload[1]
+            job.last_update_time = t
+            self.metrics.count("arrivals")
+            self.metrics.count("whatif_admits")
+            if not self.cluster.is_satisfiable(job.num_chips):
+                job.state = JobState.REJECTED
+                job.end_time = t
+                self.finished.append(job)
+                self.metrics.record_job(job)
+                self.metrics.count("rejected_unsatisfiable")
+                if self.metrics.record_events:
+                    self.metrics.event("reject", t, job, chips=job.num_chips)
+                return
+            self.pending.append(job)
+            cause = None
+            if self.attribution:
+                cause = self._queue_cause(job)
+                self._open_blame(job, cause)
+            if self.metrics.record_events:
+                extra = {"chips": job.num_chips, "duration": job.duration,
+                         "status": job.status}
+                if job.ckpt_write_s > 0.0:
+                    extra["ckpt_write_s"] = job.ckpt_write_s
+                    extra["ckpt_every"] = job.ckpt_every
+                if cause is not None:
+                    extra["cause"] = cause
+                self.metrics.event("arrival", t, job, **extra)
+        elif kind == "fault":
+            self.metrics.count("whatif_drains")
+            self._apply_fault(payload[1])
+        else:
+            raise ValueError(f"unknown what-if mutation {kind!r}")
 
     # ------------------------------------------------------------------ #
     # engine snapshot / restore / fork (ISSUE 11 tentpole)

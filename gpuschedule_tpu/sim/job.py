@@ -202,6 +202,12 @@ class Job:
     # scratch space for policies (queue index, profiling state, ...)
     sched: dict = field(default_factory=dict)
 
+    # what-if placement pin (ISSUE 12): a per-job allocation hint the
+    # engine merges into every try_start for this job — how an injected
+    # "admit this job WHERE?" candidate forces its placement.  None (the
+    # default) keeps try_start's hint handling byte-identical.
+    pin_hint: Optional[dict] = None
+
     # ------------------------------------------------------------------ #
 
     @property

@@ -1,0 +1,313 @@
+"""Persistent process worker pool (ISSUE 12 tentpole).
+
+The PR-7 sweep grids fan out through a fresh ``ProcessPoolExecutor`` per
+call (and, after PR 8's crash resilience, a fresh pool per *retry
+round*): fine for minutes-long sweep cells, hopeless for the digital
+twin's what-if queries, where a worker must restore a mirrored engine
+snapshot ONCE and then answer many sub-second queries against it.  This
+module is the long-lived generalization both callers share:
+
+- **warm workers**: each worker is one long-lived process with its own
+  request queue; :meth:`WorkerPool.broadcast` runs a load function on
+  every worker (shipping e.g. snapshot bytes) and the pool remembers the
+  load so a respawned worker is re-warmed before it serves anything;
+- **deterministic reassembly**: :meth:`WorkerPool.map` returns results
+  in task order whatever the completion interleaving — the serial-vs-
+  parallel byte-identity rule of docs/performance.md;
+- **crash/retry semantics** (the PR-8 contract): a task whose worker
+  crashed (OOM-kill, hard ``os._exit``) or raised is retried up to
+  ``max_retries`` times with exponential backoff; only the failed task
+  re-runs, on a freshly respawned (and re-warmed) worker when the old
+  one died — no fresh-pool-per-round churn, surviving workers keep
+  serving;
+- **per-task fault isolation**: one dead worker takes down exactly its
+  in-flight task, never its poolmates' (a ``ProcessPoolExecutor`` breaks
+  the whole pool).
+
+Tasks and their results cross process boundaries by pickle: task
+functions must be module-level, and results must be picklable.  Pure
+stdlib, jax-free (sim-core rule).
+"""
+
+from __future__ import annotations
+
+import itertools
+import multiprocessing
+import pickle
+import queue as queue_mod
+import time
+import traceback
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+class WorkerCrashError(RuntimeError):
+    """A worker process died (hard exit / kill) while running a task."""
+
+
+class RemoteError(RuntimeError):
+    """A task raised an exception that could not itself be pickled back;
+    carries the remote traceback text."""
+
+
+_POLL_S = 0.05  # response-queue poll granularity (liveness check cadence)
+
+
+def _worker_main(wid: int, req_q, res_q) -> None:
+    """Worker loop: apply ``fn(*args)`` per request, ship back
+    ``(wid, task_id, ok, payload)``.  Warm state lives in the task
+    functions' own module globals (see sim/whatif.py) — the pool itself
+    is payload-agnostic."""
+    while True:
+        msg = req_q.get()
+        if msg is None:
+            break
+        task_id, fn, args = msg
+        try:
+            out = fn(*args)
+            ok = True
+        except BaseException as e:  # noqa: BLE001 — everything crosses back
+            out = e
+            ok = False
+        try:
+            res_q.put((wid, task_id, ok, out))
+        except Exception:
+            # unpicklable result/exception: degrade to a text-carrying
+            # error instead of wedging the parent's result loop
+            res_q.put((wid, task_id, False, RemoteError(
+                f"task {task_id} result not picklable: "
+                f"{traceback.format_exc()}"
+            )))
+
+
+class _Worker:
+    __slots__ = ("proc", "req_q")
+
+    def __init__(self, proc, req_q):
+        self.proc = proc
+        self.req_q = req_q
+
+
+class WorkerPool:
+    """A persistent pool of ``workers`` warm processes.
+
+    ``max_retries`` / ``backoff_s`` follow the PR-8 grid semantics: a
+    failed task (worker crash or task exception) is retried up to
+    ``max_retries`` times, sleeping ``backoff_s * 2^(attempt-1)``
+    between attempts; exhausting the budget re-raises the last error.
+    ``on_retry(task_index, attempt)`` (when given) is invoked once per
+    retry — the hook :func:`gpuschedule_tpu.faults.sweep.grid_cells`
+    adapts onto its ``retry_log`` contract.
+    """
+
+    def __init__(
+        self,
+        workers: int,
+        *,
+        max_retries: int = 2,
+        backoff_s: float = 1.0,
+        on_retry: Optional[Callable[[int, int], None]] = None,
+        mp_context=None,
+    ):
+        if workers < 1:
+            raise ValueError(f"workers must be >= 1, got {workers}")
+        self._ctx = mp_context or multiprocessing.get_context()
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.on_retry = on_retry
+        self._res_q = self._ctx.Queue()
+        self._task_ids = itertools.count()
+        self._workers: Dict[int, _Worker] = {}
+        # warm-state loads, replayed (in order) into every respawned
+        # worker before it serves tasks: the "restore once" contract
+        self._loads: List[Tuple[Callable, tuple]] = []
+        self._closed = False
+        self.respawns = 0
+        for wid in range(int(workers)):
+            self._spawn(wid)
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+
+    def _spawn(self, wid: int) -> None:
+        req_q = self._ctx.Queue()
+        proc = self._ctx.Process(
+            target=_worker_main, args=(wid, req_q, self._res_q), daemon=True
+        )
+        proc.start()
+        self._workers[wid] = _Worker(proc, req_q)
+
+    def close(self) -> None:
+        """Stop every worker (sentinel, then terminate stragglers)."""
+        if self._closed:
+            return
+        self._closed = True
+        for w in self._workers.values():
+            try:
+                w.req_q.put(None)
+            except Exception:
+                pass
+        deadline = time.monotonic() + 2.0
+        for w in self._workers.values():
+            w.proc.join(timeout=max(0.0, deadline - time.monotonic()))
+            if w.proc.is_alive():
+                w.proc.terminate()
+                w.proc.join(timeout=1.0)
+        self._workers.clear()
+
+    def __enter__(self) -> "WorkerPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @property
+    def workers(self) -> int:
+        return len(self._workers)
+
+    # ------------------------------------------------------------------ #
+    # scheduling core
+
+    def _send(self, wid: int, fn: Callable, args: tuple) -> int:
+        task_id = next(self._task_ids)
+        self._workers[wid].req_q.put((task_id, fn, args))
+        return task_id
+
+    def _revive(self, wid: int) -> None:
+        """Respawn a dead worker and replay the warm-state loads into its
+        queue ahead of any task (FIFO per worker: the loads run first).
+        Load acks are awaited lazily by the caller's result loop."""
+        w = self._workers.get(wid)
+        if w is not None:
+            w.proc.join(timeout=0.1)
+        self._spawn(wid)
+        self.respawns += 1
+        for fn, args in self._loads:
+            # fire-and-forget: a failing replayed load surfaces when the
+            # worker's next task crashes or errors, which retries it
+            self._workers[wid].req_q.put((next(self._task_ids), fn, args))
+
+    def broadcast(self, fn: Callable, *args) -> None:
+        """Run ``fn(*args)`` on EVERY worker (warm-state load), blocking
+        until each acknowledged.  The load is remembered and replayed
+        into any worker respawned later, so warm state survives crashes.
+        A worker whose load keeps failing after ``max_retries`` respawns
+        takes the pool down (without its state the pool would silently
+        serve from cold workers)."""
+        if self._closed:
+            raise RuntimeError("broadcast on a closed pool")
+        pending: Dict[int, int] = {}   # task_id -> wid
+        attempts: Dict[int, int] = dict.fromkeys(self._workers, 0)
+        for wid in sorted(self._workers):
+            pending[self._send(wid, fn, args)] = wid
+        while pending:
+            try:
+                wid, task_id, ok, payload = self._res_q.get(timeout=_POLL_S)
+            except queue_mod.Empty:
+                for task_id, wid in list(pending.items()):
+                    if not self._workers[wid].proc.is_alive():
+                        del pending[task_id]
+                        attempts[wid] += 1
+                        if attempts[wid] > self.max_retries:
+                            raise WorkerCrashError(
+                                f"worker {wid} died {attempts[wid]}x "
+                                "during warm-state load"
+                            )
+                        time.sleep(
+                            self.backoff_s * (2 ** (attempts[wid] - 1))
+                        )
+                        self._revive(wid)
+                        pending[self._send(wid, fn, args)] = wid
+                continue
+            if task_id not in pending:
+                continue  # stale ack from a replaced incarnation
+            del pending[task_id]
+            if not ok:
+                attempts[wid] += 1
+                if attempts[wid] > self.max_retries:
+                    raise payload
+                time.sleep(self.backoff_s * (2 ** (attempts[wid] - 1)))
+                pending[self._send(wid, fn, args)] = wid
+        self._loads.append((fn, args))
+
+    def map(
+        self,
+        fn: Callable,
+        items: Sequence[tuple],
+        *,
+        on_retry: Optional[Callable[[int, int], None]] = None,
+    ) -> list:
+        """``[fn(*item) for item in items]`` across the pool, results in
+        item order.  Retries follow the pool's crash/retry semantics; a
+        task exhausting its budget re-raises and abandons the rest."""
+        if self._closed:
+            raise RuntimeError("map on a closed pool")
+        on_retry = on_retry or self.on_retry
+        n = len(items)
+        results: list = [None] * n
+        done = 0
+        next_item = 0
+        attempts = [0] * n
+        running: Dict[int, Tuple[int, int]] = {}  # task_id -> (index, wid)
+        busy: Dict[int, int] = {}                 # wid -> task_id
+        retry_at: List[Tuple[float, int]] = []    # (eligible time, index)
+        ready: List[int] = []                     # indices eligible now
+
+        def fill_workers() -> None:
+            nonlocal next_item
+            now = time.monotonic()
+            while retry_at and retry_at[0][0] <= now:
+                ready.append(retry_at.pop(0)[1])
+            for wid in sorted(self._workers):
+                if wid in busy:
+                    continue
+                if ready:
+                    idx = ready.pop(0)
+                elif next_item < n:
+                    idx = next_item
+                    next_item += 1
+                else:
+                    return
+                task_id = self._send(wid, fn, tuple(items[idx]))
+                running[task_id] = (idx, wid)
+                busy[wid] = task_id
+
+        def fail(task_id: int, idx: int, wid: int, error: Exception) -> None:
+            running.pop(task_id, None)
+            if busy.get(wid) == task_id:
+                del busy[wid]
+            attempts[idx] += 1
+            if attempts[idx] > self.max_retries:
+                raise error
+            if on_retry is not None:
+                on_retry(idx, attempts[idx])
+            delay = self.backoff_s * (2 ** (attempts[idx] - 1))
+            retry_at.append((time.monotonic() + delay, idx))
+            retry_at.sort()
+
+        fill_workers()
+        while done < n:
+            try:
+                wid, task_id, ok, payload = self._res_q.get(timeout=_POLL_S)
+            except queue_mod.Empty:
+                for task_id, (idx, wid) in list(running.items()):
+                    if not self._workers[wid].proc.is_alive():
+                        fail(task_id, idx, wid, WorkerCrashError(
+                            f"worker {wid} died running task {idx}"
+                        ))
+                        self._revive(wid)
+                fill_workers()
+                continue
+            entry = running.get(task_id)
+            if entry is None:
+                continue  # warm-load ack or a retired incarnation's task
+            idx, twid = entry
+            if ok:
+                del running[task_id]
+                if busy.get(twid) == task_id:
+                    del busy[twid]
+                results[idx] = payload
+                done += 1
+            else:
+                fail(task_id, idx, twid, payload)
+            fill_workers()
+        return results

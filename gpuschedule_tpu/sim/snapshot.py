@@ -179,27 +179,73 @@ def load_snapshot(path, *, metrics=None, events_sink=None, profiler=None):
                     profiler=profiler)
 
 
-def fork_simulator(sim):
-    """In-memory deep copy via the same state capture (identity-preserving
-    pickle round trip), with the event stream detached: the fork carries
-    the full accounting history but writes nowhere."""
+def state_to_bytes(sim) -> bytes:
+    """One simulator's complete state as a transportable byte string —
+    what the what-if worker pool ships to each worker so it can mirror
+    the live engine once and then serve many :func:`fork`-per-query
+    replays (ISSUE 12).  The parent's sink stays attached and unflushed;
+    clones built from these bytes always detach it."""
     state = snapshot_state(sim, flush_sink=False)
     buf = io.BytesIO()
     pickle.dump(state, buf, protocol=4)
-    buf.seek(0)
-    state = pickle.load(buf)
+    return buf.getvalue()
+
+
+def clone_from_state_bytes(data: bytes):
+    """Reconstruct a fully independent, silently-observing simulator
+    from :func:`state_to_bytes` output — in this process or another.
+    The clone carries the full accounting history but writes nowhere:
+    no event stream, buffered events dropped, periodic snapshotting
+    disarmed (a speculative replay must never overwrite the parent's
+    checkpoint file).
+
+    This is the what-if service's per-query fork: a paused mirror's
+    state bytes are invariant across queries, so each worker serializes
+    once and clones by unpickle alone — half the full dump+load round
+    trip, and fork latency IS query latency (ISSUE 12).  The collector
+    pauses across the load (burst allocation trips gc generations for
+    ~15% of the latency; nothing here creates cycles)."""
+    import gc
+
+    paused = gc.isenabled()
+    if paused:
+        gc.disable()
+    try:
+        state = pickle.loads(data)
+    finally:
+        if paused:
+            gc.enable()
     state["sink_path"] = None
     state["sink_offset"] = None
-    fork = _restore(state, metrics=None, events_sink=False, profiler=None)
-    # the fork observes silently: no stream, buffered events dropped,
-    # and periodic snapshotting disarmed — a speculative replay must
-    # never overwrite the parent's checkpoint file
-    fork.metrics.record_events = False
-    fork.metrics.events = []
-    fork._snap_path = None
-    fork._snap_every = None
-    fork._snap_next = math.inf
-    return fork
+    clone = _restore(state, metrics=None, events_sink=False, profiler=None)
+    clone.metrics.record_events = False
+    clone.metrics.events = []
+    clone._snap_path = None
+    clone._snap_every = None
+    clone._snap_next = math.inf
+    return clone
+
+
+def fork_simulator(sim):
+    """In-memory deep copy via the same state capture (identity-preserving
+    pickle round trip), with the event stream detached: the fork carries
+    the full accounting history but writes nowhere.
+
+    The collector is paused across the dump half too (the load half
+    pauses inside :func:`clone_from_state_bytes`): pickling a
+    100k-object graph allocates in bursts that trip gc generations
+    several times, ~15% of fork latency."""
+    import gc
+
+    paused = gc.isenabled()
+    if paused:
+        gc.disable()
+    try:
+        data = state_to_bytes(sim)
+    finally:
+        if paused:
+            gc.enable()
+    return clone_from_state_bytes(data)
 
 
 # --------------------------------------------------------------------- #

@@ -50,13 +50,13 @@ def test_floors_file_is_the_source_of_truth():
     positive jobs/sec budget, and the loaded FLOORS reflect the file."""
     import json
 
-    from engine_bench import CONFIGS, FLOORS, FLOORS_PATH
+    from engine_bench import CONFIGS, FLOORS, FLOORS_PATH, SNAPSHOT
 
     doc = {k: v for k, v in json.loads(FLOORS_PATH.read_text()).items()
            if not k.startswith("_")}
     assert doc == FLOORS
     bases = {c[: -len("-v2")] if c.endswith("-v2") else c for c in FLOORS}
-    assert bases <= set(CONFIGS)
+    assert bases <= set(CONFIGS) | {SNAPSHOT}
     assert all(v > 0 for v in FLOORS.values())
 
 
@@ -84,6 +84,28 @@ def test_micro_rung_gate_end_to_end():
         assert rung["events_per_s"] > 0
         assert rung["rss_peak_mb"] > 0
     assert scale_ratios(rungs) == {"plain": {}, "attrib": {}}
+
+
+def test_snapshot_rung_gate_end_to_end():
+    """The ISSUE 12 fork-cost gate at micro scale: 1k jobs through the
+    snapshot rung (write + restore + fork round trip on a paused
+    mid-replay engine) against the real pinned floor — fork cost is the
+    what-if latency floor, so a persistence regression fails the suite.
+    Same tier-1 floor_scale=0.5 slack as the replay micro rung."""
+    import os
+
+    from engine_bench import apply_gate, run_snapshot_rung
+
+    rung = run_snapshot_rung(1000, seed=1, repeats=2)
+    scale = 1.0 if os.environ.get("GSTPU_BENCH_STRICT") == "1" else 0.5
+    gate = apply_gate([rung], floor_scale=scale)
+    assert gate["ok"], gate
+    assert rung["config"] == "snapshot"
+    assert rung["snapshot_bytes"] > 0
+    assert rung["write_s"] > 0 and rung["restore_s"] > 0
+    assert rung["fork_s"] > 0
+    # the rung pauses mid-trace: a live mirror, not an empty endgame
+    assert rung["running"] + rung["pending"] > 0
 
 
 @pytest.mark.slow

@@ -111,6 +111,144 @@ def test_single_group_is_bitwise_flat():
     assert maxmin_allocate_grouped(flows, caps) == maxmin_allocate(flows, caps)
 
 
+# --------------------------------------------------------------------- #
+# hierarchical top tier (ISSUE 12): the contended core decomposes
+
+
+def _core_instance(rng):
+    """A NetModel-shaped instance: per-pod uplinks loaded at full demand
+    (always contended) + one shared core, usually oversubscribed enough
+    to bind — the regime the hierarchical tier exists for.  Mostly
+    single-pod flows (the fleet's multislice share is the minority), so
+    pods don't all union into one local component — that's what keeps a
+    healthy fraction of instances genuinely decomposable."""
+    npods = rng.randint(2, 8)
+    caps = {f"u{p}": rng.choice([10.0, 20.0, 40.0]) for p in range(npods)}
+    caps["core"] = (
+        rng.choice([0.25, 0.5, 1.0, 2.0]) * sum(caps.values()) / 4.0
+    )
+    flows = []
+    for i in range(rng.randint(1, 14)):
+        k = rng.randint(2, min(3, npods)) if rng.random() < 0.25 else 1
+        pods = sorted(rng.sample(range(npods), k))
+        links = tuple((f"u{p}", 1.0) for p in pods) + (("core", float(k)),)
+        flows.append(Flow(f"f{i}", links, rng.choice([5.0, 10.0, 20.0, 40.0])))
+    return flows, caps
+
+
+def test_hierarchical_matches_flat_oracle_randomized():
+    """With the core as the top tier, the hierarchical solve (per-pod
+    local groups + exact core water-level clamp) equals the flat loop in
+    real arithmetic over randomized contended-core instances — and a
+    bitwise-identical repeat reuses every cached group."""
+    rng = random.Random(31)
+    reused_trials = 0
+    decomposed = 0
+    for _ in range(400):
+        flows, caps = _core_instance(rng)
+        flat = maxmin_allocate(flows, caps)
+        cache = GroupCache()
+        hier = maxmin_allocate_grouped(flows, caps, cache=cache, top="core")
+        for k, v in flat.items():
+            assert hier[k] == pytest.approx(v, rel=1e-9, abs=1e-9)
+        if len(cache.groups) > 1:
+            decomposed += 1
+        again = maxmin_allocate_grouped(flows, caps, cache=cache, top="core")
+        assert again == hier  # bitwise cache reuse
+        if cache.reused > 0:
+            reused_trials += 1
+    # the oracle must actually exercise the hierarchical path
+    assert decomposed > 100
+    assert reused_trials > 100
+
+
+def test_hierarchical_per_pod_reuse_under_contended_core():
+    """The ISSUE 12 acceptance shape: under a binding core, a single-pod
+    dirty set re-solves only that pod's group, and a core-capacity-only
+    change (the per-batch ingest churn) re-solves NOTHING — the water-
+    level clamp re-derives exactly from cached local solves."""
+    caps = {"u0": 10.0, "u1": 10.0, "u2": 10.0, "core": 8.0}
+    flows = [
+        Flow("a", (("u0", 1.0), ("core", 1.0)), 10.0),
+        Flow("b", (("u0", 1.0), ("core", 1.0)), 10.0),
+        Flow("c", (("u1", 1.0), ("core", 1.0)), 10.0),
+        Flow("d", (("u2", 1.0), ("core", 1.0)), 10.0),
+    ]
+    cache = GroupCache()
+    r1 = maxmin_allocate_grouped(flows, caps, cache=cache, top="core")
+    # core binds: 4 unit-weight flows on an 8-Gbps core -> 2.0 each
+    assert r1 == {"a": 2.0, "b": 2.0, "c": 2.0, "d": 2.0}
+    assert cache.solved == 3  # {a,b} via u0, {c}, {d}
+    # pod-1 uplink churn: only c's group re-solves
+    caps["u1"] = 5.0
+    before = cache.solved
+    maxmin_allocate_grouped(flows, caps, cache=cache, top="core")
+    assert cache.solved == before + 1
+    # core-capacity-only churn: zero group re-solves, rates re-clamp
+    caps["core"] = 6.0
+    before = cache.solved
+    r3 = maxmin_allocate_grouped(flows, caps, cache=cache, top="core")
+    assert cache.solved == before
+    assert r3 == {"a": 1.5, "b": 1.5, "c": 1.5, "d": 1.5}
+
+
+def test_hierarchical_single_component_falls_back_to_flat():
+    """One local component spanning every flow (a single-pod fabric)
+    cannot decompose: the solve falls back to the historical mono-group
+    path, which IS the flat loop bit for bit."""
+    caps = {"u0": 10.0, "core": 3.0}
+    flows = [
+        Flow("a", (("u0", 1.0), ("core", 1.0)), 10.0),
+        Flow("b", (("u0", 1.0), ("core", 1.0)), 10.0),
+    ]
+    assert (
+        maxmin_allocate_grouped(flows, caps, top="core")
+        == maxmin_allocate(flows, caps)
+    )
+
+
+def test_hierarchical_requires_every_flow_to_cross_top():
+    """A flow bypassing a contended top while sharing a contended local
+    link with a core-clamped flow: the water-level clamp could only
+    lower rates, never hand the bypassing flow the capacity the clamp
+    freed — so the solve must take the non-hierarchical path and match
+    the flat loop exactly.  (Unreachable through NetModel, whose flows
+    all transit the core; pinned for direct API users.)"""
+    caps = {"u0": 10.0, "u1": 10.0, "core": 3.0}
+    flows = [
+        Flow("a", (("u0", 1.0), ("core", 1.0)), 10.0),
+        Flow("b", (("u0", 1.0),), 10.0),  # does NOT cross the core
+        Flow("c", (("u1", 1.0), ("core", 1.0)), 10.0),
+    ]
+    flat = maxmin_allocate(flows, caps)
+    # a and c freeze at the core waterline 1.5; b takes what a left
+    assert flat == pytest.approx({"a": 1.5, "b": 8.5, "c": 1.5})
+    hier = maxmin_allocate_grouped(flows, caps, top="core")
+    assert hier == flat
+
+
+def test_hierarchical_slack_top_is_historical_grouped():
+    """A slack top tier (offered core load comfortably under capacity)
+    must not engage the hierarchical branch: ``top="core"`` and
+    ``top=None`` are bitwise identical — slack-core fabrics keep the
+    historical grouped arithmetic."""
+    rng = random.Random(77)
+    checked = 0
+    for _ in range(80):
+        flows, caps = _core_instance(rng)
+        # inflate the core past any possible offered load: slack by miles
+        caps["core"] = 10.0 * sum(
+            w * f.demand for f in flows for link, w in f.links
+            if link == "core"
+        ) + 100.0
+        assert (
+            maxmin_allocate_grouped(flows, caps, top="core")
+            == maxmin_allocate_grouped(flows, caps, top=None)
+        )
+        checked += 1
+    assert checked == 80
+
+
 def test_parse_net_spec_partial():
     assert parse_net_spec("partial=1").partial is True
     assert parse_net_spec("partial=0").partial is False
@@ -227,6 +365,45 @@ def _scenario_randomized_churn(cls, sink, out):
     return res, net
 
 
+def _cfg_core():
+    # the DEFAULT oversubscribed fabric (os=4, ingest armed): the core
+    # binds, which pre-ISSUE-12 coupled every flow into one monolithic
+    # group — the hierarchical tier must decompose it per pod while the
+    # cache stays observably absent
+    return NetConfig(oversubscription=4.0, ingest_gbps_per_chip=0.05,
+                     partial=True)
+
+
+def _scenario_contended_core_churn(cls, sink, out):
+    """The ISSUE 12 acceptance scenario: the randomized-churn world on
+    the default oversubscribed core — promoted multislice share, chip +
+    link faults, attribution, ingest — where only the hierarchical tier
+    gives the group cache anything to reuse."""
+    c = _fleet(pods=8, dims=(4, 4))
+    net = cls(_cfg_core())
+    jobs = promote_to_multislice(
+        generate_philly_like_trace(140, seed=23), 0.25, c.pod_chips, seed=23)
+    plan = FaultPlan(
+        records=generate_fault_schedule(
+            c,
+            FaultConfig(mtbf=45_000.0, repair=1800.0,
+                        link_mtbf=20_000.0, link_repair=900.0,
+                        link_degrade=0.3),
+            horizon=600_000.0, seed=23,
+        ),
+        recovery=RecoveryModel(ckpt_interval=1800.0, restore="auto"),
+    )
+    ml = MetricsLog(events_sink=sink, attribution=True, run_meta={
+        "run_id": "corechurn", "seed": 23, "policy": "dlas",
+        "config_hash": "x"})
+    with ml:
+        res = Simulator(c, make_policy("dlas", thresholds=(600.0,)), jobs,
+                        metrics=ml, net=net, faults=plan,
+                        max_time=600_000.0).run()
+    ml.write(out)
+    return res, net
+
+
 def test_partial_matches_full_disjoint_whales(tmp_path):
     _pair(_scenario_disjoint_whales, tmp_path)
 
@@ -235,6 +412,47 @@ def test_partial_matches_full_randomized_churn(tmp_path):
     res = _pair(_scenario_randomized_churn, tmp_path)
     assert res.num_finished > 0
     assert res.delay_by_cause  # attribution closures survive the cache
+
+
+def test_partial_matches_full_contended_core_churn(tmp_path):
+    """ISSUE 12 acceptance: under the DEFAULT oversubscribed core with
+    partial=1, streams/jobs.csv/goodput are byte-equal between the
+    cached hierarchical solve and the fresh-solve oracle path, with
+    ``partial_solves > 0`` — per-pod groups reuse beneath the binding
+    core (pre-ISSUE-12 this scenario could never reuse a group)."""
+    res = _pair(_scenario_contended_core_churn, tmp_path)
+    assert res.num_finished > 0
+    assert res.delay_by_cause
+
+
+def test_contended_core_partial_tracks_flat_results(tmp_path):
+    """The hierarchical arithmetic vs the no-flag flat fallback on the
+    contended-core world: last-ulp float chunking may differ (why
+    ``partial`` rides the config hash), but every headline metric must
+    agree to oracle tolerance."""
+    def run(partial: bool, tag: str):
+        c = _fleet(pods=8, dims=(4, 4))
+        net = NetModel(NetConfig(oversubscription=4.0,
+                                 ingest_gbps_per_chip=0.05,
+                                 partial=partial))
+        jobs = promote_to_multislice(
+            generate_philly_like_trace(120, seed=9), 0.3, c.pod_chips,
+            seed=9)
+        res = Simulator(c, make_policy("fifo", backfill=True), jobs,
+                        net=net, max_time=500_000.0).run()
+        return res, net
+
+    res_h, net_h = run(True, "hier")
+    res_f, net_f = run(False, "flat")
+    assert net_h.partial_solves > 0  # the decomposition engaged
+    assert res_h.num_finished == res_f.num_finished
+    assert res_h.avg_jct == pytest.approx(res_f.avg_jct, rel=1e-6)
+    assert res_h.makespan == pytest.approx(res_f.makespan, rel=1e-6)
+    for leg, v in res_f.goodput.items():
+        assert res_h.goodput[leg] == pytest.approx(v, rel=1e-6, abs=1e-6)
+    mu_f = net_f.mean_utilization()
+    for link, v in net_h.mean_utilization().items():
+        assert v == pytest.approx(mu_f[link], rel=1e-6, abs=1e-9)
 
 
 def test_partial_off_is_flat_solver(tmp_path):

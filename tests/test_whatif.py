@@ -1,0 +1,478 @@
+"""Interactive what-if replay (ISSUE 12): pool semantics, fork
+divergence, serial-vs-pool identity, and the tier-1 CLI smoke.
+
+The contracts under test:
+
+- ``Simulator.run_until(t)`` pauses between batches without finalizing,
+  so ``run_until`` + ``run`` replays BYTE-IDENTICALLY to an
+  uninterrupted ``run`` (the mirror is observational);
+- forking a paused engine twice and mutating each fork differently
+  leaves the parent's subsequent replay byte-identical to an unforked
+  run, while the two children diverge deterministically (seeded: the
+  same mutations reproduce the same divergent results);
+- queries are deterministic, so serial (``workers=0``) and pooled
+  evaluation return identical result documents modulo latency readings;
+- :class:`~gpuschedule_tpu.sim.pool.WorkerPool` keeps the PR-8
+  crash/retry semantics (hard-killed worker -> respawn + replayed warm
+  state + per-task retry, deterministic result order) without
+  fresh-pool-per-round churn;
+- the ``whatif`` CLI subcommand drives admit + drain queries end-to-end
+  on the 12-job feature-loaded world with ``--pool 2``, non-empty
+  latency histograms, and history rows written (the tier-1 smoke).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+from pathlib import Path
+
+import pytest
+
+from gpuschedule_tpu.cli import main
+from gpuschedule_tpu.cluster.tpu import TpuCluster
+from gpuschedule_tpu.faults.recovery import FaultPlan, RecoveryModel
+from gpuschedule_tpu.faults.schedule import (
+    FaultConfig,
+    generate_fault_schedule,
+)
+from gpuschedule_tpu.net.model import NetConfig, NetModel
+from gpuschedule_tpu.obs import MetricsRegistry
+from gpuschedule_tpu.obs.history import HistoryStore
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.sim import Simulator
+from gpuschedule_tpu.sim.job import Job
+from gpuschedule_tpu.sim.metrics import MetricsLog
+from gpuschedule_tpu.sim.philly import generate_philly_like_trace
+from gpuschedule_tpu.sim.pool import WorkerPool
+from gpuschedule_tpu.sim.whatif import (
+    WhatIfService,
+    parse_admit_spec,
+    parse_drain_spec,
+    validate_query,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, os.path.join(str(REPO), "tools"))
+
+OUTPUTS = ("events.jsonl", "jobs.csv", "utilization.csv", "counters.json")
+
+
+def _sha(p: Path) -> str:
+    return hashlib.sha256(p.read_bytes()).hexdigest()
+
+
+def _world(sink=None, *, jobs=30, seed=11):
+    """A feature-loaded world (faults + net + attribution), small enough
+    for tier-1 but busy at the midpoint — the state a mirror pauses in."""
+    c = TpuCluster("v5e", dims=(4, 4), num_pods=4)
+    trace = generate_philly_like_trace(jobs, seed=seed)
+    plan = FaultPlan(
+        records=generate_fault_schedule(
+            c, FaultConfig(mtbf=60_000.0, repair=1200.0),
+            horizon=400_000.0, seed=seed,
+        ),
+        recovery=RecoveryModel(ckpt_interval=1800.0, restore="auto"),
+    )
+    ml = MetricsLog(events_sink=sink, attribution=True, run_meta={
+        "run_id": "whatif-test", "seed": seed, "policy": "fifo",
+        "config_hash": "x"})
+    sim = Simulator(
+        c, make_policy("fifo"), trace, metrics=ml,
+        net=NetModel(NetConfig(oversubscription=2.0)), faults=plan,
+        max_time=400_000.0,
+    )
+    return sim, ml
+
+
+def _mid_time(sim) -> float:
+    return sim.jobs[len(sim.jobs) // 2].submit_time
+
+
+# --------------------------------------------------------------------- #
+# run_until / fork semantics (the mirror must be observational)
+
+
+def test_run_until_then_run_is_byte_identical(tmp_path):
+    a, b = tmp_path / "a", tmp_path / "b"
+    a.mkdir(), b.mkdir()
+    sim, ml = _world(a / "events.jsonl")
+    with ml:
+        sim.run()
+    ml.write(a)
+
+    sim2, ml2 = _world(b / "events.jsonl")
+    t = _mid_time(sim2)
+    with ml2:
+        sim2.run_until(t)
+        assert sim2.now <= t
+        # mid-replay: a live mirror, not an empty endgame
+        assert len(sim2.running) + len(sim2.pending) > 0
+        sim2.run()
+    ml2.write(b)
+    for name in OUTPUTS:
+        assert _sha(a / name) == _sha(b / name), name
+
+
+def test_fork_divergence_parent_unperturbed(tmp_path):
+    """ISSUE 12 satellite: fork the same paused engine twice, mutate the
+    forks differently — the parent's subsequent replay stays
+    byte-identical to an unforked run, and the children diverge from the
+    baseline and from each other, deterministically across rebuilds."""
+    a, b = tmp_path / "a", tmp_path / "b"
+    a.mkdir(), b.mkdir()
+    sim, ml = _world(a / "events.jsonl")
+    with ml:
+        sim.run()
+    ml.write(a)
+
+    def forked_results():
+        sim2, ml2 = _world(b / "events.jsonl")
+        with ml2:
+            sim2.run_until(_mid_time(sim2))
+            f1, f2 = sim2.fork(), sim2.fork()
+            base = sim2.fork().run()
+            f1.inject_admit(
+                Job("spec-admit", f1.now, num_chips=16, duration=7200.0),
+                pin={"pod": 1},
+            )
+            f2.inject_drain(("pod", 0), duration=3600.0)
+            r1, r2 = f1.run(), f2.run()
+            sim2.run()  # the parent finishes AFTER the speculation
+        ml2.write(b)
+        return base, r1, r2
+
+    base, r1, r2 = forked_results()
+    for name in OUTPUTS:
+        assert _sha(a / name) == _sha(b / name), name
+    # both mutations moved the future, in different directions
+    assert r1.num_finished == base.num_finished + 1
+    assert (r2.avg_jct, r2.makespan) != (base.avg_jct, base.makespan)
+    assert (r1.avg_jct, r1.makespan) != (r2.avg_jct, r2.makespan)
+
+    # seeded determinism: the same forks + mutations reproduce exactly
+    base2, r1b, r2b = forked_results()
+    for x, y in ((base, base2), (r1, r1b), (r2, r2b)):
+        assert x.avg_jct == y.avg_jct
+        assert x.makespan == y.makespan
+        assert x.goodput == y.goodput
+
+
+def test_inject_admit_rejects_past_and_pins_placement():
+    import math
+
+    sim, _ = _world()
+    sim.run_until(math.inf)  # the whole trace drained: an idle mirror
+    with pytest.raises(ValueError, match="in the past"):
+        sim.fork().inject_admit(
+            Job("late", 0.0, num_chips=4, duration=60.0), t=sim.now - 1.0
+        )
+    fork = sim.fork()
+    job = fork.inject_admit(
+        Job("pinned", fork.now, num_chips=4, duration=600.0),
+        pin={"pod": 2},
+    )
+    fork.run_until(fork.now)  # apply the injected batch, stay paused
+    assert job.pin_hint == {"pod": 2}
+    # the pin won: the idle cluster granted the hinted pod immediately
+    assert job.allocation is not None
+    assert job.allocation.detail.pod == 2
+    res = fork.run()
+    assert job.end_time is not None
+    assert res.num_finished == len(fork.jobs)
+
+
+def test_whatif_coinciding_with_sample_batch_still_schedules():
+    """_WHATIF sorts after _SAMPLE, so an injected mutation landing on a
+    periodic-sample instant would ride the samples-only fast path —
+    applied with no policy pass, lying dormant until the next dirty
+    batch.  With a mutation pending the fast path must stand down: an
+    admit injected at an exact sample instant on an idle cluster starts
+    at that instant."""
+    c = TpuCluster("v5e", dims=(4, 4), num_pods=2)
+    far = [Job("far", 10_000.0, num_chips=4, duration=60.0)]
+    sim = Simulator(c, make_policy("fifo"), far, sample_interval=100.0)
+    sim.run_until(50.0)
+    fork = sim.fork()
+    job = fork.inject_admit(
+        Job("on-sample", 100.0, num_chips=4, duration=300.0), t=100.0
+    )
+    fork.run_until(150.0)
+    # the policy pass ran in the injected batch, not hours later
+    assert job.first_start_time == 100.0
+    assert job.allocation is not None
+
+
+def test_query_at_beyond_horizon_is_rejected():
+    """A query whose at= lands past the bounded replay window would sit
+    unapplied in the heap and read as a spurious ~zero delta; the
+    evaluator must reject it instead."""
+    sim, _ = _world()
+    sim.run_until(_mid_time(sim))
+    with WhatIfService(sim, horizon=1000.0) as service:
+        with pytest.raises(ValueError, match="beyond the bounded replay"):
+            service.evaluate([{
+                "kind": "admit", "chips": 4, "duration": 60.0,
+                "at": sim.now + 5000.0,
+            }])
+
+
+# --------------------------------------------------------------------- #
+# serial vs pooled service: identical answers, observed latency
+
+
+def _strip(doc: dict) -> dict:
+    return {k: v for k, v in doc.items() if k != "latency_s"}
+
+
+def test_serial_and_pool_identical_results(tmp_path):
+    sim, _ = _world()
+    sim.run_until(_mid_time(sim))
+    queries = (
+        parse_admit_spec("chips=8,duration=3600,pods=0:2")
+        + [parse_drain_spec("pod=1,duration=1800")]
+        + [{"kind": "policy-swap", "policy": "srtf"}]
+    )
+    registry = MetricsRegistry()
+    with WhatIfService(sim, horizon=40_000.0, registry=registry) as serial:
+        docs_serial = serial.evaluate(queries)
+        assert serial.queries_served == len(queries)
+    with WhatIfService(sim, horizon=40_000.0, workers=2) as pooled:
+        docs_pool = pooled.evaluate(queries)
+    assert [_strip(d) for d in docs_serial] == [_strip(d) for d in docs_pool]
+    for doc in docs_serial:
+        assert doc["latency_s"] > 0.0
+        assert doc["base"] != doc["variant"] or doc["query"]["kind"] == (
+            "policy-swap"
+        )  # admit/drain must move the bounded future on this world
+        assert set(doc["delta"]) == set(doc["base"])
+    # the attributed delta decomposes by cause (PR-5 machinery)
+    assert any(doc["delta"]["delay_by_cause"] for doc in docs_serial)
+    # admit docs carry the injected job's outcome
+    admits = [d for d in docs_serial if d["query"]["kind"] == "admit"]
+    assert admits and all("admitted" in d for d in admits)
+    # latency histogram observed one sample per query, labeled by kind
+    prom = tmp_path / "whatif.prom"
+    registry.write(prom_path=prom)
+    text = prom.read_text()
+    assert 'whatif_query_latency_ms_count{kind="admit"} 2' in text
+    assert 'whatif_query_latency_ms_count{kind="drain"} 1' in text
+    assert 'whatif_query_latency_ms_count{kind="policy-swap"} 1' in text
+
+
+def test_query_and_spec_validation():
+    with pytest.raises(ValueError, match="unknown what-if query kind"):
+        validate_query({"kind": "bogus"})
+    with pytest.raises(ValueError, match="chips > 0"):
+        validate_query({"kind": "admit", "chips": 0, "duration": 60.0})
+    with pytest.raises(ValueError, match="scope"):
+        validate_query({"kind": "drain"})
+    with pytest.raises(ValueError, match="policy name"):
+        validate_query({"kind": "policy-swap"})
+    with pytest.raises(ValueError, match="unknown --admit keys"):
+        parse_admit_spec("chips=8,duration=60,flavor=mint")
+    with pytest.raises(ValueError, match="chips= and duration="):
+        parse_admit_spec("chips=8")
+    with pytest.raises(ValueError, match="needs pod="):
+        parse_drain_spec("at=100")
+    # pods fan-out: one pinned candidate query per pod
+    qs = parse_admit_spec("chips=8,duration=60,pods=0:3:5")
+    assert [q["pod"] for q in qs] == [0, 3, 5]
+    assert all(q["chips"] == 8 for q in qs)
+    # no pods= -> a single unpinned query (the policy places it)
+    (q,) = parse_admit_spec("chips=8,duration=60")
+    assert "pod" not in q
+    sim, _ = _world()
+    with pytest.raises(ValueError, match="horizon"):
+        WhatIfService(sim, horizon=0.0)
+
+
+# --------------------------------------------------------------------- #
+# WorkerPool: order, crash/retry, warm-state replay on respawn
+
+_CRASH_DIR: str = ""
+_WARM_VALUE = None
+
+
+def _echo(i: int) -> int:
+    return i * 10
+
+
+def _set_warm(v) -> bool:
+    global _WARM_VALUE
+    _WARM_VALUE = v
+    return True
+
+
+def _read_warm_crash_once(tag: str):
+    """Hard-kills its worker on the first attempt (marker file), then
+    returns the warm state — so a passing retry proves the respawned
+    worker was re-warmed before serving."""
+    marker = Path(_CRASH_DIR) / f"{tag}.attempted"
+    if not marker.exists():
+        marker.write_text("1")
+        os._exit(1)
+    return _WARM_VALUE
+
+
+def _raise_until(tag: str, ok_attempt: int):
+    marker = Path(_CRASH_DIR) / f"{tag}.count"
+    n = int(marker.read_text()) + 1 if marker.exists() else 1
+    marker.write_text(str(n))
+    if n < ok_attempt:
+        raise ValueError(f"transient {tag} attempt {n}")
+    return n
+
+
+def test_pool_map_preserves_item_order():
+    with WorkerPool(2, backoff_s=0.01) as pool:
+        assert pool.map(_echo, [(i,) for i in range(9)]) == [
+            i * 10 for i in range(9)
+        ]
+        assert pool.respawns == 0
+
+
+def test_pool_crash_respawns_and_replays_warm_state(tmp_path):
+    global _CRASH_DIR
+    _CRASH_DIR = str(tmp_path)
+    retries: list = []
+    with WorkerPool(1, backoff_s=0.01) as pool:
+        pool.broadcast(_set_warm, 42)
+        out = pool.map(
+            _read_warm_crash_once, [("t0",)],
+            on_retry=lambda idx, att: retries.append((idx, att)),
+        )
+    # the retry ran on a respawned worker that got the warm load replayed
+    assert out == [42]
+    assert pool.respawns == 1
+    assert retries == [(0, 1)]
+
+
+def test_pool_task_exception_retries_then_exhausts(tmp_path):
+    global _CRASH_DIR
+    _CRASH_DIR = str(tmp_path)
+    with WorkerPool(2, max_retries=2, backoff_s=0.01) as pool:
+        assert pool.map(_raise_until, [("a", 3), ("b", 1)]) == [3, 1]
+        with pytest.raises(ValueError, match="transient c"):
+            pool.map(_raise_until, [("c", 99)])
+    with pytest.raises(ValueError, match="workers must be >= 1"):
+        WorkerPool(0)
+
+
+# --------------------------------------------------------------------- #
+# the tier-1 CLI smoke (ISSUE 12 satellite): whatif end-to-end
+
+WORLD = [
+    "--synthetic", "12", "--seed", "5", "--cluster", "tpu-v5e",
+    "--dims", "4x4", "--pods", "2", "--policy", "dlas",
+    "--faults", "mtbf=5000,repair=600",
+    "--net", "os=2",
+]
+
+
+def test_cli_whatif_smoke(tmp_path, capsys):
+    """admit + drain queries against the 12-job feature-loaded world
+    with --pool 2: one result document per query with attributed deltas,
+    non-empty latency histograms, and history rows written."""
+    store = tmp_path / "history.sqlite"
+    prom = tmp_path / "whatif.prom"
+    out = tmp_path / "whatif.json"
+    rc = main([
+        "whatif", *WORLD, "--at", "20000", "--horizon", "40000",
+        "--pool", "2",
+        "--admit", "chips=8,duration=3600,pods=0:1",
+        "--drain", "pod=1,duration=3600",
+        "--history", str(store), "--prom", str(prom), "--out", str(out),
+    ])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["pool"] == 2
+    assert doc["at_s"] <= 20000
+    assert len(doc["queries"]) == 3  # two admit candidates + one drain
+    kinds = [q["query"]["kind"] for q in doc["queries"]]
+    assert kinds == ["admit", "admit", "drain"]
+    assert [q["query"]["pod"] for q in doc["queries"][:2]] == [0, 1]
+    for q in doc["queries"]:
+        assert q["latency_s"] > 0.0
+        assert "delay_by_cause" in q["delta"]  # attribution always armed
+    assert doc["latency_ms"]["count"] == 3
+    assert doc["latency_ms"]["p50_ms"] > 0.0
+    # --out wrote the same document (pretty-printed)
+    assert json.loads(out.read_text()) == doc
+    # latency histogram non-empty, labeled by query kind
+    text = prom.read_text()
+    assert 'whatif_query_latency_ms_count{kind="admit"} 2' in text
+    assert 'whatif_query_latency_ms_count{kind="drain"} 1' in text
+    # one history row per query under the run's config-hash identity
+    with HistoryStore(store) as hs:
+        rows = hs.rows(kind="whatif")
+    assert len(rows) == 3
+    assert [r.label for r in rows] == ["admit", "admit", "drain"]
+    assert all(r.config_hash == doc["config_hash"] for r in rows)
+    assert all(r.metrics["latency_ms"] > 0.0 for r in rows)
+    assert all("delta_avg_jct_s" in r.metrics for r in rows)
+
+
+def test_cli_whatif_rejects_bad_usage(tmp_path, capsys):
+    with pytest.raises(SystemExit, match="at least one"):
+        main(["whatif", *WORLD, "--at", "100"])
+    capsys.readouterr()
+    with pytest.raises(SystemExit, match="unknown --admit keys"):
+        main(["whatif", *WORLD, "--at", "100",
+              "--admit", "chips=8,duration=60,flavor=mint"])
+    capsys.readouterr()
+    with pytest.raises(SystemExit, match="--at must be >= 0"):
+        main(["whatif", *WORLD, "--at", "-5",
+              "--admit", "chips=8,duration=60"])
+    capsys.readouterr()
+    # deterministic user errors exit cleanly BEFORE pooled evaluation
+    # could retry them: an unknown policy name is an argparse choice
+    # error, a speculative mutation in the replayed past a SystemExit
+    with pytest.raises(SystemExit):
+        main(["whatif", *WORLD, "--at", "100", "--swap-policy", "bogus"])
+    capsys.readouterr()
+    with pytest.raises(SystemExit, match="before the mirror instant"):
+        main(["whatif", *WORLD, "--at", "5000",
+              "--admit", "chips=4,duration=600,at=100"])
+    capsys.readouterr()
+    with pytest.raises(SystemExit, match="beyond the bounded replay"):
+        main(["whatif", *WORLD, "--at", "5000", "--horizon", "1000",
+              "--admit", "chips=4,duration=600,at=99000"])
+    capsys.readouterr()
+    # the window is also capped by --max-time, not just the horizon
+    with pytest.raises(SystemExit, match="beyond the bounded replay"):
+        main(["whatif", *WORLD, "--max-time", "6000", "--at", "5000",
+              "--horizon", "86400",
+              "--admit", "chips=4,duration=600,at=50000"])
+    capsys.readouterr()
+
+
+# --------------------------------------------------------------------- #
+# the serving bench (ISSUE 12 satellite), at test scale
+
+
+@pytest.mark.slow
+def test_whatif_bench_records_latency_and_scaling(tmp_path):
+    """tools/whatif_bench.py end-to-end at reduced scale: the document
+    records p50/p95 query latency and pool-scaling efficiency, all arms
+    agree byte-for-byte (exit 0 means the mismatch check passed), and
+    the gate evaluates against the shipped CI floors."""
+    import whatif_bench
+
+    out = tmp_path / "bench.json"
+    rc = whatif_bench.main([
+        "--jobs", "1500", "--queries", "6", "--pool", "2",
+        "--repeats", "1", "--horizon", "20000", "--out", str(out),
+        "--no-gate",
+    ])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    lat = doc["query_latency_ms"]
+    assert lat["count"] == 6
+    assert 0.0 < lat["p50_ms"] <= lat["p95_ms"] <= lat["max_ms"]
+    assert doc["pool_scaling_efficiency"] > 0.0
+    assert doc["serial_s"] > 0.0 and doc["pool_s"] > 0.0
+    assert doc["speedup_vs_serial"] > 1.0  # warm pool beats cold serial
+    assert {"speedup_ok", "p50_ok", "ok"} <= set(doc["gate"])

@@ -69,7 +69,11 @@ CONFIGS = ("plain", "faults", "net", "attrib")
 # criterion is pinned on (FIFO never reads running progress, so v2 runs
 # the fully-lazy path there)
 V2_PAIR = ("plain-v2", "attrib-v2")
-DEFAULT_CONFIGS = CONFIGS + V2_PAIR
+# the snapshot rung (ISSUE 12): write + restore + fork round-trip cost
+# on a mid-replay engine — the what-if latency floor, gated like any
+# other rung so fork cost cannot silently regress
+SNAPSHOT = "snapshot"
+DEFAULT_CONFIGS = CONFIGS + V2_PAIR + (SNAPSHOT,)
 
 # Jobs/sec floors per configuration (the budget gate), pinned in
 # tools/engine_bench_floors.json (ISSUE 9: a data file so the tier-1
@@ -193,9 +197,68 @@ def run_rung(
     }
 
 
+def run_snapshot_rung(
+    num_jobs: int, *, seed: int = 0, repeats: int = 1
+) -> dict:
+    """The ISSUE 12 ``snapshot`` rung: one plain replay paused mid-trace
+    (the instant the midpoint job arrives — live running/pending sets,
+    the state a digital twin mirrors), then the full persistence round
+    trip — ``snapshot()`` to disk, ``Simulator.restore()`` in-process,
+    and one in-memory ``fork()``.  Reported like the replay rungs:
+    ``jobs_per_s`` is trace jobs carried per second of round trip, so
+    the pinned floor gates fork cost — the what-if latency floor — the
+    same way the other floors gate replay speed."""
+    import tempfile
+
+    from gpuschedule_tpu.sim.snapshot import load_snapshot
+
+    sim = build_sim("plain", num_jobs, seed=seed)
+    sim.run_until(sim.jobs[num_jobs // 2].submit_time)
+    best = math.inf
+    kept: dict = {}
+    with tempfile.TemporaryDirectory() as d:
+        path = Path(d) / "engine.snap"
+        for _ in range(max(1, repeats)):
+            t0 = time.perf_counter()
+            sim.snapshot(path)
+            t_write = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            restored = load_snapshot(path)
+            t_restore = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            fork = restored.fork()
+            t_fork = time.perf_counter() - t0
+            elapsed = t_write + t_restore + t_fork
+            if elapsed < best:
+                best = elapsed
+                kept = {
+                    "write_s": round(t_write, 4),
+                    "restore_s": round(t_restore, 4),
+                    "fork_s": round(t_fork, 4),
+                    "snapshot_bytes": path.stat().st_size,
+                    "paused_at_s": sim.now,
+                    "running": len(sim.running),
+                    "pending": len(sim.pending),
+                    "finished": len(fork.finished),
+                }
+    return {
+        "config": SNAPSHOT,
+        "num_jobs": num_jobs,
+        "elapsed_s": round(best, 4),
+        "jobs_per_s": round(num_jobs / best, 2),
+        "rss_peak_mb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            / (1024.0 * 1024.0 if sys.platform == "darwin" else 1024.0), 1
+        ),
+        **kept,
+    }
+
+
 def _rung_task(args) -> dict:
     """Picklable per-rung entry for the fork-isolated pool."""
     config, num_jobs, seed, repeats = args
+    if config == SNAPSHOT:
+        return run_snapshot_rung(num_jobs, seed=seed, repeats=repeats)
     return run_rung(config, num_jobs, seed=seed, repeats=repeats)
 
 
@@ -219,7 +282,7 @@ def run_ladder(
                 if pool is not None:
                     rung = pool.apply(_rung_task, ((config, n, seed, repeats),))
                 else:
-                    rung = run_rung(config, n, seed=seed, repeats=repeats)
+                    rung = _rung_task((config, n, seed, repeats))
                 print(json.dumps(rung, sort_keys=True), file=sys.stderr)
                 rungs.append(rung)
     finally:
@@ -318,8 +381,11 @@ def main(argv=None) -> int:
         sizes = sizes + (MILLION,)
     configs = tuple(c.strip() for c in args.configs.split(",") if c.strip())
     if args.accounting == "v2":
+        # the snapshot rung measures persistence, not accounting — it
+        # has no -v2 form and rides every forced ladder unchanged
         configs = tuple(
-            c if c.endswith("-v2") else c + "-v2" for c in configs
+            c if c.endswith("-v2") or c == SNAPSHOT else c + "-v2"
+            for c in configs
         )
     elif args.accounting == "v1":
         configs = tuple(
