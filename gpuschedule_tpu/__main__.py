@@ -1,0 +1,8 @@
+"""``python -m gpuschedule_tpu ...`` — the same CLI as ``cli.main``."""
+
+import sys
+
+from gpuschedule_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

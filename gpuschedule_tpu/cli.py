@@ -166,30 +166,15 @@ def build_fault_plan(args, cluster, jobs):
 def _run_config_hash(args) -> str:
     """Digest of the *experiment* config — cluster + trace + fault spec,
     deliberately not the policy — so `compare` accepts policy-A-vs-B runs
-    of the same seeded world and refuses cross-world diffs."""
+    of the same seeded world and refuses cross-world diffs.  The flag ->
+    hash-key mapping lives in ONE table (``worldspec.py``, ISSUE 13) that
+    this function and the contract linter's coverage rule both consume,
+    so a flag added without a hash/allowlist decision is a lint failure
+    instead of silent drift."""
+    from gpuschedule_tpu import worldspec
     from gpuschedule_tpu.obs import config_hash
 
-    return config_hash({
-        "cluster": args.cluster, "chips": args.chips, "dims": args.dims,
-        "pods": args.pods, "gpu_shape": args.gpu_shape,
-        "placement": args.placement, "placement_seed": args.placement_seed,
-        "philly": args.philly, "trace": args.trace,
-        "synthetic": args.synthetic, "seed": args.seed,
-        "arrival_rate": args.arrival_rate, "mean_duration": args.mean_duration,
-        "failure_rate": args.failure_rate, "util_min": args.util_min,
-        "max_job_chips": args.max_job_chips, "max_time": args.max_time,
-        "faults": args.faults,
-        # only present when --net is on: a net-free run's hash (and
-        # therefore its run_id and events header) must stay byte-identical
-        # to what it was before the net layer existed
-        **({"net": args.net} if getattr(args, "net", None) else {}),
-        # accounting v2 changes the float-summation contract (ISSUE 11:
-        # closure replaces byte-identity), so it IS experiment config and
-        # rides the hash — but only when armed, keeping every historical
-        # v1 hash (and run_id, and events header) byte-identical
-        **({"accounting": "v2"}
-           if getattr(args, "accounting", "v1") == "v2" else {}),
-    })
+    return config_hash(worldspec.hash_config(args))
 
 
 def _append_run_history(store_path, run_meta, summary, *, policy, seed,
@@ -1295,6 +1280,65 @@ def _apply_platform_override() -> None:
     jax.config.update("jax_platforms", plat)
 
 
+def cmd_lint(args) -> int:
+    """``lint``: the contract linter (ISSUE 13) — AST-enforced
+    determinism / seed-stream / event-schema / config-hash / cache /
+    fork-safety invariants over this checkout.  Exit 0 when every
+    finding is fixed, pragma-allowed, or baselined; 1 otherwise.
+    Output is deterministic: the same tree and baseline produce
+    byte-identical JSON, so ``--json`` artifacts diff cleanly and
+    ``--history`` rows trend meaningfully."""
+    from pathlib import Path
+
+    from gpuschedule_tpu.lint import load_baseline, run_lint
+
+    if args.root:
+        root = Path(args.root)
+        if not root.is_dir():
+            raise SystemExit(f"lint root is not a directory: {args.root}")
+    else:
+        root = Path(__file__).resolve().parent.parent
+    baseline = None
+    baseline_path = (
+        Path(args.baseline) if args.baseline
+        else root / "tools" / "lint_baseline.json"
+    )
+    if baseline_path.is_file():
+        try:
+            baseline = load_baseline(baseline_path)
+        except (ValueError, KeyError) as e:
+            raise SystemExit(f"bad baseline {baseline_path}: {e}") from None
+    elif args.baseline:
+        raise SystemExit(f"baseline not found: {args.baseline}")
+
+    report = run_lint(root, baseline=baseline)
+    if report.files_scanned == 0:
+        # an empty scan exiting 0 would greenwash a mistyped --root
+        raise SystemExit(f"no package files found under {root} — wrong root?")
+
+    doc = report.render_json()
+    if args.json is True:
+        sys.stdout.write(doc)
+    else:
+        if args.json:
+            Path(args.json).write_text(doc)
+        for f in report.findings:
+            print(f.render())
+        print(
+            f"contract-lint: {len(report.findings)} finding(s), "
+            f"{report.baselined} baselined, {report.allowed} allowed by "
+            f"pragma, {report.files_scanned} files, "
+            f"{report.rules_run} rules — {'ok' if report.ok else 'FAIL'}"
+        )
+    if args.history:
+        from gpuschedule_tpu.obs import HistoryStore
+
+        with HistoryStore(args.history) as store:
+            store.append("lint", metrics=report.summary_metrics(),
+                         label="contract-lint")
+    return 0 if report.ok else 1
+
+
 def _add_world_args(p) -> None:
     """The world-building flags, defined ONCE and shared by every
     subcommand that builds a seeded world (``run``, ``whatif``): the
@@ -1524,6 +1568,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "(whatif_query_latency_ms{kind}) in Prometheus "
                          "text format")
     wi.set_defaults(fn=cmd_whatif)
+
+    lint = sub.add_parser(
+        "lint",
+        help="contract linter (ISSUE 13): statically enforce the "
+             "determinism / seed-stream / event-schema / config-hash / "
+             "cache-discipline / fork-safety invariants; exit 1 on any "
+             "unbaselined finding (rule catalog: docs/static-analysis.md)",
+    )
+    lint.add_argument("--root", metavar="DIR",
+                      help="repo checkout to lint (default: the checkout "
+                           "containing this package)")
+    lint.add_argument("--baseline", metavar="JSON",
+                      help="findings baseline (default: "
+                           "ROOT/tools/lint_baseline.json when present)")
+    lint.add_argument("--json", nargs="?", const=True, default=None,
+                      metavar="PATH",
+                      help="emit the deterministic JSON report (bare flag: "
+                           "stdout instead of the human rendering; with "
+                           "PATH: write there, keep the human output)")
+    lint.add_argument("--history", metavar="STORE",
+                      help="append the summary metrics to the sqlite "
+                           "history store at STORE (kind 'lint') — "
+                           "finding-count trends ride `history trend`")
+    lint.set_defaults(fn=cmd_lint)
 
     gen = sub.add_parser("gen-trace", help="write a synthetic trace CSV")
     gen.add_argument("--num-jobs", type=int, required=True)

@@ -325,6 +325,21 @@ class TpuCluster(OverlayMixin, ClusterBase):
     # ------------------------------------------------------------------ #
     # engine snapshot support (sim/snapshot.py, ISSUE 11)
 
+    # the snapshot contract's audit surface (ISSUE 13): every derived
+    # cache listed here must be shed in __getstate__ or rebuilt in
+    # restored(), and vice versa — cross-checked statically by the
+    # contract linter (GS502, docs/static-analysis.md)
+    _DERIVED_CACHES = (
+        "_rows",
+        "_scan_memo",
+        "_fail_version",
+        "_fail_sizes",
+        "_can_true_version",
+        "_can_true",
+        "_can_false_version",
+        "_can_false",
+    )
+
     def __getstate__(self):
         """Serialize for an engine snapshot: authoritative state only.
         The derived caches — bitmask row tables, scan memos, the

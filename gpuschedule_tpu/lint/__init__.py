@@ -1,0 +1,31 @@
+"""Contract linter (ISSUE 13): AST-enforced determinism, seed-stream,
+schema, config-hash, cache-discipline, and fork-safety invariants.
+
+Entry points: ``run_lint(root)`` (Python), ``python -m gpuschedule_tpu
+lint`` (CLI), ``tools/contract_lint.py`` (CI gate).  Rule catalog and
+suppression workflow: docs/static-analysis.md.
+"""
+
+from gpuschedule_tpu.lint.core import (
+    Finding,
+    LintConfig,
+    LintContext,
+    LintReport,
+    load_baseline,
+    run_lint,
+)
+from gpuschedule_tpu.lint.seed_registry import (
+    SEED_STREAMS,
+    SHARED_SEED_STREAMS,
+)
+
+__all__ = [
+    "Finding",
+    "LintConfig",
+    "LintContext",
+    "LintReport",
+    "SEED_STREAMS",
+    "SHARED_SEED_STREAMS",
+    "load_baseline",
+    "run_lint",
+]

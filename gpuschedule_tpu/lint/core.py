@@ -1,0 +1,363 @@
+"""Contract-linter core (ISSUE 13): findings, rule engine, baseline.
+
+The linter is a repo-aware static-analysis pass: every rule reads the
+repository's own Python ASTs (and docs) and enforces one of the
+invariants PRs 1-12 established by hand — determinism, seed-stream
+namespacing, event-schema/doc agreement, config-hash coverage, cache
+discipline, fork safety.  Zero dependencies beyond the stdlib ``ast``
+module; output is deterministic (sorted findings, no timestamps, no
+absolute paths) so repeated runs produce byte-identical JSON and the
+report can ride the PR-10 history store.
+
+Suppression surfaces (both audited — see docs/static-analysis.md):
+
+- **inline pragma**: ``# lint: allow[GS101] reason`` on the flagged
+  line or the line directly above suppresses matching findings; a
+  pragma without a reason is itself a finding (GS002).
+- **baseline file** (``tools/lint_baseline.json``): entries match on
+  ``(code, path, detail)`` — the stable fingerprint, deliberately not
+  the line number, so baselines survive unrelated edits.  A baseline
+  entry that matches nothing is a finding (GS001: stale), which keeps
+  the file honest as violations get fixed.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+PACKAGE = "gpuschedule_tpu"
+
+# pragma grammar: "# lint: allow[GS101]" or "# lint: allow[GS101,GS601]",
+# reason text required after the bracket
+_PRAGMA_RE = re.compile(r"#\s*lint:\s*allow\[([A-Z0-9, ]+)\]\s*(.*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One contract violation.  ``detail`` is the stable fingerprint
+    token baseline entries match on (a dotted name, a stream template,
+    an attribute name — never a line number)."""
+
+    code: str
+    path: str            # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    detail: str
+
+    def key(self) -> Tuple[str, str, int, int, str]:
+        return (self.path, self.line, self.col, self.code, self.detail)
+
+    def to_json(self) -> dict:
+        return {
+            "code": self.code, "path": self.path, "line": self.line,
+            "col": self.col, "message": self.message, "detail": self.detail,
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col} {self.code} {self.message}"
+
+
+@dataclass
+class LintConfig:
+    """Where each repo-aware rule looks.  Defaults describe this
+    repository; fixture tests point ``run_lint`` at miniature trees with
+    the same layout (tests/lint_fixtures/)."""
+
+    package: str = PACKAGE
+    # rule GS1xx: modules whose replay semantics must be deterministic
+    determinism_dirs: Tuple[str, ...] = ("sim", "net", "faults", "cluster")
+    # rule GS3xx: the event emitter and its schema document
+    engine_path: str = f"{PACKAGE}/sim/engine.py"
+    events_doc_path: str = "docs/events.md"
+    # rule GS4xx: the argparse definitions and the shared hash table;
+    # every subparser variable that builds a hashed world is audited
+    cli_path: str = f"{PACKAGE}/cli.py"
+    worldspec_path: str = f"{PACKAGE}/worldspec.py"
+    world_parser_receivers: Tuple[str, ...] = ("run", "wi")
+    # rule GS2xx: the declared seed-stream registry (None = the repo's
+    # own registry from gpuschedule_tpu/lint/seed_registry.py)
+    seed_streams: Optional[Dict[str, str]] = None
+    shared_seed_streams: Tuple[str, ...] = ()
+
+
+class LintContext:
+    """Parsed-once view of the tree: source text, lines, and ASTs for
+    every package file, plus the docs the schema rules read."""
+
+    def __init__(self, root: Path, config: LintConfig):
+        self.root = Path(root)
+        self.config = config
+        self._sources: Dict[str, str] = {}
+        self._lines: Dict[str, List[str]] = {}
+        self._trees: Dict[str, ast.AST] = {}
+        self._comments: Dict[str, Dict[int, str]] = {}
+        pkg = self.root / config.package
+        self.py_files: List[str] = sorted(
+            p.relative_to(self.root).as_posix()
+            for p in pkg.rglob("*.py")
+            if "__pycache__" not in p.parts
+        )
+
+    def has(self, rel: str) -> bool:
+        return (self.root / rel).is_file()
+
+    def source(self, rel: str) -> str:
+        if rel not in self._sources:
+            self._sources[rel] = (self.root / rel).read_text()
+        return self._sources[rel]
+
+    def lines(self, rel: str) -> List[str]:
+        if rel not in self._lines:
+            self._lines[rel] = self.source(rel).splitlines()
+        return self._lines[rel]
+
+    def tree(self, rel: str) -> ast.AST:
+        if rel not in self._trees:
+            self._trees[rel] = ast.parse(self.source(rel), filename=rel)
+        return self._trees[rel]
+
+    def comments(self, rel: str) -> Dict[int, str]:
+        """line -> comment text, via the tokenizer — so pragma matching
+        never fires on pragma-shaped text inside a string/docstring."""
+        if rel not in self._comments:
+            out: Dict[int, str] = {}
+            try:
+                toks = tokenize.generate_tokens(
+                    io.StringIO(self.source(rel)).readline
+                )
+                for tok in toks:
+                    if tok.type == tokenize.COMMENT:
+                        out[tok.start[0]] = tok.string
+            except tokenize.TokenError:
+                pass
+            self._comments[rel] = out
+        return self._comments[rel]
+
+
+Rule = Callable[[LintContext], List[Finding]]
+_RULES: List[Rule] = []  # lint: allow[GS601] populated once at rule-module import; every process re-imports identically
+
+
+def rule(fn: Rule) -> Rule:
+    """Register a rule: a callable taking the context and returning
+    findings.  Registration order is irrelevant — findings are sorted."""
+    _RULES.append(fn)
+    return fn
+
+
+# ---------------------------------------------------------------------- #
+# shared AST helpers (used by several rules)
+
+def import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Name -> dotted-module/attribute map from a module's imports:
+    ``import time as t`` -> {"t": "time"}; ``from time import
+    perf_counter as pc`` -> {"pc": "time.perf_counter"}."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted_name(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Resolve a Name/Attribute chain to its dotted import-rooted form
+    (``t.perf_counter`` with ``import time as t`` -> "time.perf_counter");
+    None when the chain doesn't root at an imported name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    base = aliases.get(node.id)
+    if base is None:
+        return None
+    parts.append(base)
+    return ".".join(reversed(parts))
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def backtick_tokens(text: str) -> set:
+    """Every `backtick`-quoted token in a markdown document.  Code
+    fences (```) are stripped first — their triple backticks would
+    otherwise desynchronize the pairing — and tokens never span lines.
+    For prose-shaped tokens like ``warned: true`` the leading
+    identifier is extracted too, so a documented key matches however
+    the prose quotes it."""
+    tokens = set(re.findall(r"`([^`\n]+)`", text.replace("```", "")))
+    for t in list(tokens):
+        m = re.match(r"[A-Za-z_][A-Za-z0-9_]*", t)
+        if m:
+            tokens.add(m.group(0))
+    return tokens
+
+
+# ---------------------------------------------------------------------- #
+# baseline + pragma suppression
+
+def load_baseline(path: Path) -> List[dict]:
+    doc = json.loads(path.read_text())
+    entries = doc.get("entries") if isinstance(doc, dict) else doc
+    if not isinstance(entries, list):
+        raise ValueError(
+            f"baseline {path}: expected a JSON list or an object with an "
+            "'entries' list"
+        )
+    for e in entries:
+        if not isinstance(e, dict):
+            raise ValueError(f"baseline entry {e!r}: must be an object")
+        for k in ("code", "path", "detail", "justification"):
+            if not isinstance(e.get(k), str) or not e[k].strip():
+                raise ValueError(
+                    f"baseline entry {e!r}: '{k}' must be a non-empty string"
+                )
+    return entries
+
+
+def _pragma_allows(ctx: LintContext, f: Finding) -> Optional[bool]:
+    """True: suppressed by a reasoned pragma.  False: pragma present but
+    reasonless (caller turns that into GS002).  None: no pragma."""
+    if f.line <= 0 or not ctx.has(f.path):
+        # aggregate findings (stale registry/baseline rows, doc-side
+        # drift) anchor to a file:0 label, not a source line
+        return None
+    if not f.path.endswith(".py"):
+        return None
+    comments = ctx.comments(f.path)
+    for ln in (f.line, f.line - 1):
+        comment = comments.get(ln)
+        if comment is None:
+            continue
+        m = _PRAGMA_RE.search(comment)
+        if m and f.code in {c.strip() for c in m.group(1).split(",")}:
+            return bool(m.group(2).strip())
+    return None
+
+
+@dataclass
+class LintReport:
+    findings: List[Finding]            # unsuppressed — these gate
+    baselined: int = 0
+    allowed: int = 0                   # pragma-suppressed
+    files_scanned: int = 0
+    rules_run: int = 0
+    codes: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def summary_metrics(self) -> Dict[str, int]:
+        """Flat numeric summary — the shape the PR-10 history store
+        ingests (``lint --history``)."""
+        out = {
+            "findings": len(self.findings),
+            "baselined": self.baselined,
+            "allowed": self.allowed,
+            "files_scanned": self.files_scanned,
+            "rules_run": self.rules_run,
+            "ok": int(self.ok),
+        }
+        for code, n in sorted(self.codes.items()):
+            out[f"findings_{code}"] = n
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "ok": self.ok,
+            "findings": [f.to_json() for f in self.findings],
+            "baselined": self.baselined,
+            "allowed": self.allowed,
+            "files_scanned": self.files_scanned,
+            "rules_run": self.rules_run,
+            "codes": dict(sorted(self.codes.items())),
+        }
+
+    def render_json(self) -> str:
+        """Deterministic bytes: same tree + baseline -> same output."""
+        return json.dumps(self.to_json(), indent=2, sort_keys=True) + "\n"
+
+
+def run_lint(
+    root,
+    *,
+    config: Optional[LintConfig] = None,
+    baseline: Optional[Sequence[dict]] = None,
+) -> LintReport:
+    """Run every registered rule over the tree at ``root`` and fold the
+    raw findings through pragma + baseline suppression."""
+    # rule modules self-register on import
+    from gpuschedule_tpu.lint import (  # noqa: F401
+        rules_cache,
+        rules_confighash,
+        rules_determinism,
+        rules_forksafety,
+        rules_schema,
+        rules_seeds,
+    )
+
+    ctx = LintContext(Path(root), config or LintConfig())
+    raw: List[Finding] = []
+    for fn in _RULES:
+        raw.extend(fn(ctx))
+
+    entries = list(baseline or ())
+    matched = [False] * len(entries)
+    kept: List[Finding] = []
+    baselined = allowed = 0
+    for f in raw:
+        verdict = _pragma_allows(ctx, f)
+        if verdict is True:
+            allowed += 1
+            continue
+        if verdict is False:
+            f = Finding(
+                "GS002", f.path, f.line, f.col,
+                f"pragma suppressing {f.code} has no justification text",
+                f.detail,
+            )
+        hit = False
+        for i, e in enumerate(entries):
+            if (e["code"], e["path"], e["detail"]) == (f.code, f.path, f.detail):
+                matched[i] = True
+                hit = True
+        if hit:
+            baselined += 1
+        else:
+            kept.append(f)
+    for e, m in zip(entries, matched):
+        if not m:
+            kept.append(Finding(
+                "GS001", e["path"], 0, 0,
+                f"stale baseline entry: no {e['code']} finding with detail "
+                f"'{e['detail']}' — remove it",
+                e["detail"],
+            ))
+
+    kept.sort(key=Finding.key)
+    codes: Dict[str, int] = {}
+    for f in kept:
+        codes[f.code] = codes.get(f.code, 0) + 1
+    return LintReport(
+        findings=kept, baselined=baselined, allowed=allowed,
+        files_scanned=len(ctx.py_files), rules_run=len(_RULES), codes=codes,
+    )

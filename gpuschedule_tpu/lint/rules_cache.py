@@ -1,0 +1,241 @@
+"""GS5xx — cache-discipline rules (ISSUE 13).
+
+The PR-7/9/11 speed lattice is a web of caches whose correctness rests
+on two conventions with no runtime check:
+
+- every cache exposed through the unified ``engine_cache_events``
+  telemetry family (a ``cache_stats()`` method returning
+  ``{cache: {outcome: counter}}``) must have LIVE counter sites — a
+  counter attribute that is never incremented anywhere reads as a
+  permanently-cold cache in the Engine-health panel (**GS501**), and a
+  declared cache name absent from ``docs/events.md`` is schema drift in
+  the ``cache`` record's documentation (**GS503**);
+- every derived cache on a snapshot-capable class must be shed in
+  ``__getstate__`` or rebuilt in ``restored()`` (the ISSUE 11 snapshot
+  contract: a resume never trusts pre-snapshot geometry).  The class
+  declares its derived caches in a ``_DERIVED_CACHES`` tuple; this rule
+  cross-checks the declaration against both hooks in BOTH directions
+  (**GS502**) — an undeclared shed is as much drift as an unshed
+  declaration, and a class that sheds state without any declaration is
+  flagged too.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from gpuschedule_tpu.lint.core import (
+    Finding,
+    LintContext,
+    backtick_tokens,
+    const_str,
+    rule,
+)
+
+
+def _last_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _counter_tokens_in_dict(d: ast.Dict) -> List[Tuple[str, str]]:
+    """(outcome, counter-attribute token) pairs from an
+    ``{"hit": self.x, ...}`` literal; non-constant counters yield no
+    token (computed expressions can't be increment-checked)."""
+    out = []
+    for k, v in zip(d.keys, d.values):
+        outcome = const_str(k) if k is not None else None
+        token = _last_attr(v)
+        if outcome and token:
+            out.append((outcome, token))
+    return out
+
+
+def _declared_caches(
+    ctx: LintContext,
+) -> Dict[str, Tuple[str, int, List[Tuple[str, str]]]]:
+    """cache name -> (path, line, [(outcome, counter token)]) from every
+    ``cache_stats`` method in the package: dict-literal returns plus
+    ``stats["name"] = {...}`` subscript stores."""
+    caches: Dict[str, Tuple[str, int, List[Tuple[str, str]]]] = {}
+    for path in ctx.py_files:
+        for node in ast.walk(ctx.tree(path)):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if node.name != "cache_stats":
+                continue
+            for sub in ast.walk(node):
+                pairs: Dict[str, ast.Dict] = {}
+                if isinstance(sub, ast.Return) and isinstance(
+                    sub.value, ast.Dict
+                ):
+                    for k, v in zip(sub.value.keys, sub.value.values):
+                        name = const_str(k) if k is not None else None
+                        if name and isinstance(v, ast.Dict):
+                            pairs[name] = v
+                elif isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if (
+                            isinstance(t, ast.Subscript)
+                            and isinstance(sub.value, ast.Dict)
+                        ):
+                            name = const_str(t.slice)
+                            if name:
+                                pairs[name] = sub.value
+                    # out = {...} literal bodies inside cache_stats
+                    if (
+                        len(sub.targets) == 1
+                        and isinstance(sub.targets[0], ast.Name)
+                        and isinstance(sub.value, ast.Dict)
+                    ):
+                        for k, v in zip(sub.value.keys, sub.value.values):
+                            name = const_str(k) if k is not None else None
+                            if name and isinstance(v, ast.Dict):
+                                pairs[name] = v
+                for name, d in pairs.items():
+                    caches.setdefault(
+                        name,
+                        (path, d.lineno, _counter_tokens_in_dict(d)),
+                    )
+    return caches
+
+
+def _incremented_attrs(ctx: LintContext) -> Set[str]:
+    """Every attribute/name that is the target of an augmented
+    assignment anywhere in the package."""
+    incs: Set[str] = set()
+    for path in ctx.py_files:
+        for node in ast.walk(ctx.tree(path)):
+            if isinstance(node, ast.AugAssign):
+                token = _last_attr(node.target)
+                if token:
+                    incs.add(token)
+    return incs
+
+
+@rule
+def cache_telemetry_liveness(ctx: LintContext) -> List[Finding]:
+    caches = _declared_caches(ctx)
+    if not caches:
+        return []
+    incremented = _incremented_attrs(ctx)
+    out: List[Finding] = []
+    for name in sorted(caches):
+        path, line, counters = caches[name]
+        for outcome, token in counters:
+            if token not in incremented:
+                out.append(Finding(
+                    "GS501", path, line, 0,
+                    f"cache '{name}' outcome '{outcome}' reads counter "
+                    f"'{token}' that is never incremented anywhere — "
+                    "dead telemetry",
+                    f"{name}.{outcome}",
+                ))
+    # GS503: every declared cache name must appear in docs/events.md
+    doc_path = ctx.config.events_doc_path
+    if ctx.has(doc_path):
+        tokens = backtick_tokens(ctx.source(doc_path))
+        for name in sorted(caches):
+            path, line, _ = caches[name]
+            if name not in tokens:
+                out.append(Finding(
+                    "GS503", path, line, 0,
+                    f"cache '{name}' rides the engine_cache_events "
+                    f"family but appears nowhere in {doc_path} — "
+                    "document it in the `cache` record row",
+                    name,
+                ))
+    return out
+
+
+def _class_derived_decl(cls: ast.ClassDef) -> Optional[Tuple[Set[str], int]]:
+    for node in cls.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "_DERIVED_CACHES":
+                    names: Set[str] = set()
+                    if isinstance(node.value, (ast.Tuple, ast.List)):
+                        for el in node.value.elts:
+                            s = const_str(el)
+                            if s:
+                                names.add(s)
+                    return names, node.lineno
+    return None
+
+
+def _shed_keys(cls: ast.ClassDef) -> Set[str]:
+    """Keys assigned into the state dict inside ``__getstate__``."""
+    keys: Set[str] = set()
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "__getstate__":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Subscript):
+                            s = const_str(t.slice)
+                            if s:
+                                keys.add(s)
+    return keys
+
+
+def _rebuilt_attrs(cls: ast.ClassDef) -> Set[str]:
+    """``self.X = ...`` targets inside ``restored()``."""
+    attrs: Set[str] = set()
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "restored":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            attrs.add(t.attr)
+    return attrs
+
+
+@rule
+def derived_cache_snapshot_coverage(ctx: LintContext) -> List[Finding]:
+    out: List[Finding] = []
+    for path in ctx.py_files:
+        for node in ast.walk(ctx.tree(path)):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            decl = _class_derived_decl(node)
+            shed = _shed_keys(node)
+            rebuilt = _rebuilt_attrs(node)
+            if decl is None:
+                if shed or rebuilt:
+                    out.append(Finding(
+                        "GS502", path, node.lineno, node.col_offset,
+                        f"class {node.name} sheds/rebuilds state in "
+                        "__getstate__/restored() but declares no "
+                        "_DERIVED_CACHES tuple — the snapshot contract "
+                        "is unauditable without the declaration",
+                        f"{node.name}:undeclared",
+                    ))
+                continue
+            declared, line = decl
+            for name in sorted(declared - (shed | rebuilt)):
+                out.append(Finding(
+                    "GS502", path, line, 0,
+                    f"{node.name}._DERIVED_CACHES declares '{name}' but "
+                    "__getstate__ does not shed it and restored() does "
+                    "not rebuild it — a resume would trust pre-snapshot "
+                    "state",
+                    f"{node.name}:{name}:unshed",
+                ))
+            for name in sorted((shed | rebuilt) - declared):
+                out.append(Finding(
+                    "GS502", path, line, 0,
+                    f"{node.name} sheds/rebuilds '{name}' without "
+                    "declaring it in _DERIVED_CACHES — declare it so the "
+                    "snapshot contract stays auditable",
+                    f"{node.name}:{name}:undeclared",
+                ))
+    return out

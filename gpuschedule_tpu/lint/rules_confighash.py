@@ -1,0 +1,160 @@
+"""GS4xx — config-hash coverage rules (ISSUE 13).
+
+The config hash is the run's identity: ``compare`` accepts two streams
+only when their hashes match, the history store keys trends by it, and
+the what-if layer mirrors worlds by it.  A CLI knob that changes replay
+semantics but doesn't ride the hash makes two *different* worlds look
+identical — the silent-drift hazard PR 12's hardening log names.
+
+The mapping lives in ONE table (``gpuschedule_tpu/worldspec.py``) that
+``cli.py:_run_config_hash`` consumes at runtime and this rule reads
+statically (AST literals — no import, so fixture trees lint the same
+way).  Every argparse dest defined in ``_add_world_args`` or on the
+``run`` subparser must appear in exactly one bucket:
+
+- **GS401** flag in the CLI but in no bucket (undecided: hash it or
+  allowlist it with a justification);
+- **GS402** table row naming a flag the CLI no longer defines (stale);
+- **GS403** ``UNHASHED`` row with an empty/missing justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from gpuschedule_tpu.lint.core import Finding, LintContext, const_str, rule
+
+
+def _dest_of(call: ast.Call) -> Optional[str]:
+    for kw in call.keywords:
+        if kw.arg == "dest":
+            return const_str(kw.value)
+    # argparse derives dest from the first LONG option; fall back to
+    # the first option only when no long form exists
+    first = None
+    for arg in call.args:
+        opt = const_str(arg)
+        if not opt or not opt.startswith("-"):
+            continue
+        if first is None:
+            first = opt
+        if opt.startswith("--"):
+            return opt.lstrip("-").replace("-", "_")
+    if first is not None:
+        return first.lstrip("-").replace("-", "_")
+    return None
+
+
+def _add_argument_dests(
+    tree: ast.AST, func_name: str, receiver: Optional[str] = None
+) -> Dict[str, int]:
+    """dest -> line for every ``X.add_argument(...)`` inside the named
+    function; with ``receiver`` only calls on that variable count."""
+    dests: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name != func_name:
+            continue
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            f = call.func
+            if not (isinstance(f, ast.Attribute) and f.attr == "add_argument"):
+                continue
+            if receiver is not None and not (
+                isinstance(f.value, ast.Name) and f.value.id == receiver
+            ):
+                continue
+            dest = _dest_of(call)
+            if dest:
+                dests.setdefault(dest, call.lineno)
+    return dests
+
+
+def _table_literals(
+    tree: ast.AST,
+) -> Tuple[Set[str], Set[str], Dict[str, Optional[str]], Dict[str, int]]:
+    """(HASHED, HASHED_WHEN_ARMED keys, UNHASHED dest->reason,
+    name->line) from the worldspec module's top-level literals."""
+    hashed: Set[str] = set()
+    armed: Set[str] = set()
+    unhashed: Dict[str, Optional[str]] = {}
+    lines: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if t.id == "HASHED" and isinstance(node.value, (ast.Tuple, ast.List)):
+                for el in node.value.elts:
+                    s = const_str(el)
+                    if s:
+                        hashed.add(s)
+                        lines[s] = el.lineno
+            elif t.id == "HASHED_WHEN_ARMED" and isinstance(node.value, ast.Dict):
+                for k in node.value.keys:
+                    s = const_str(k) if k is not None else None
+                    if s:
+                        armed.add(s)
+                        lines[s] = k.lineno
+            elif t.id == "UNHASHED" and isinstance(node.value, ast.Dict):
+                for k, v in zip(node.value.keys, node.value.values):
+                    s = const_str(k) if k is not None else None
+                    if s:
+                        unhashed[s] = const_str(v)
+                        lines[s] = k.lineno
+    return hashed, armed, unhashed, lines
+
+
+@rule
+def config_hash_coverage(ctx: LintContext) -> List[Finding]:
+    cfg = ctx.config
+    if not ctx.has(cfg.cli_path) or not ctx.has(cfg.worldspec_path):
+        return []
+    cli_tree = ctx.tree(cfg.cli_path)
+    dests: Dict[str, int] = {}
+    dests.update(_add_argument_dests(cli_tree, "_add_world_args"))
+    # the flags of every subparser that builds a hashed world (run,
+    # whatif), defined inside main() on their parser variables
+    for receiver in cfg.world_parser_receivers:
+        for d, ln in _add_argument_dests(
+            cli_tree, "main", receiver=receiver
+        ).items():
+            dests.setdefault(d, ln)
+
+    hashed, armed, unhashed, lines = _table_literals(
+        ctx.tree(cfg.worldspec_path)
+    )
+    covered = hashed | armed | set(unhashed)
+
+    out: List[Finding] = []
+    for dest in sorted(dests):
+        if dest not in covered:
+            out.append(Finding(
+                "GS401", cfg.cli_path, dests[dest], 0,
+                f"CLI flag '{dest}' (world/run surface) is neither hashed "
+                f"nor allowlisted in {cfg.worldspec_path} — decide: does "
+                "it change replay semantics?",
+                dest,
+            ))
+    for name in sorted(covered):
+        if name not in dests:
+            out.append(Finding(
+                "GS402", cfg.worldspec_path, lines.get(name, 0), 0,
+                f"worldspec table row '{name}' matches no _add_world_args "
+                "/ run flag — remove the stale row",
+                name,
+            ))
+    for name in sorted(unhashed):
+        reason = unhashed[name]
+        if not reason or not reason.strip():
+            out.append(Finding(
+                "GS403", cfg.worldspec_path, lines.get(name, 0), 0,
+                f"UNHASHED row '{name}' has no justification — every "
+                "deliberately-unhashed knob documents why",
+                name,
+            ))
+    return out

@@ -1,0 +1,248 @@
+"""GS1xx — determinism rules (ISSUE 13).
+
+The engine's first contract (PR 2 onward): a seeded replay is a pure
+function of its config.  Inside the replay-semantics modules
+(``sim/``, ``net/``, ``faults/``, ``cluster/``) that forbids:
+
+- **GS101** wall-clock reads (``time.time``/``perf_counter``/
+  ``datetime.now``...): wall time changes between runs, so any value
+  derived from it breaks byte-identical replay.  The obs layer
+  (tracer, selfprof) is *outside* these dirs — wall time is its job;
+  in-scope measurement sites (the self-profiler loop, what-if latency,
+  worker-pool timeouts) carry reasoned pragmas or baseline rows.
+- **GS102** module-state RNG (``random.shuffle``, ``np.random.rand``):
+  global RNG state is shared across every caller in the process, so
+  draws interleave unpredictably; the seed-split rule requires a
+  namespaced ``random.Random(...)`` instance instead.
+- **GS103** bare-set iteration: set order is hash-randomized across
+  processes (PYTHONHASHSEED), so iterating one to emit events or order
+  flows is a fork/worker-dependent replay.  Wrap in ``sorted(...)`` or
+  keep an ordered structure.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from gpuschedule_tpu.lint.core import (
+    Finding,
+    LintContext,
+    dotted_name,
+    import_aliases,
+    rule,
+)
+
+WALLCLOCK = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.process_time",
+    "time.process_time_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+}
+
+# seeded constructors are the *sanctioned* RNG surface; everything else
+# reachable under the random / numpy.random module roots is module state
+_RNG_OK_LEAVES = {"Random", "SystemRandom", "default_rng", "Generator",
+                  "RandomState", "Philox", "PCG64", "SFC64", "MT19937",
+                  "SeedSequence", "BitGenerator"}
+
+
+def _target_files(ctx: LintContext) -> List[str]:
+    dirs = tuple(
+        f"{ctx.config.package}/{d}/" for d in ctx.config.determinism_dirs
+    )
+    return [p for p in ctx.py_files if p.startswith(dirs)]
+
+
+def _rng_violation(name: str) -> bool:
+    parts = name.split(".")
+    if parts[0] == "random":
+        return len(parts) > 1 and parts[-1] not in _RNG_OK_LEAVES
+    if parts[0] in ("numpy", "np") and len(parts) > 2 and parts[1] == "random":
+        return parts[-1] not in _RNG_OK_LEAVES
+    return False
+
+
+@rule
+def wallclock_and_module_rng(ctx: LintContext) -> List[Finding]:
+    out: List[Finding] = []
+    for path in _target_files(ctx):
+        tree = ctx.tree(path)
+        aliases = import_aliases(tree)
+        for node in ast.walk(tree):
+            # flag the *reference* (Attribute chain or from-imported
+            # Name), not just calls: `perf = time.perf_counter` aliases
+            # the clock and must be caught at the aliasing site
+            if isinstance(node, ast.Attribute):
+                if not isinstance(getattr(node, "ctx", None), ast.Load):
+                    continue
+                name = dotted_name(node, aliases)
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                name = aliases.get(node.id)
+                # only from-imports resolve to dotted leaves; a bare
+                # `import time` Name reference is the Attribute case
+                if name is not None and "." not in name:
+                    name = None
+            else:
+                continue
+            if name is None:
+                continue
+            # skip inner Attribute nodes of a longer flagged chain:
+            # datetime.datetime.now flags once, at the full chain
+            if name in WALLCLOCK:
+                out.append(Finding(
+                    "GS101", path, node.lineno, node.col_offset,
+                    f"wall-clock read `{name}` inside a replay-semantics "
+                    "module breaks deterministic replay",
+                    name,
+                ))
+            elif _rng_violation(name):
+                out.append(Finding(
+                    "GS102", path, node.lineno, node.col_offset,
+                    f"module-state RNG `{name}` shares global stream "
+                    "state; use a namespaced random.Random instance "
+                    "(seed-split rule)",
+                    name,
+                ))
+    return _dedup_chain(out)
+
+
+def _dedup_chain(findings: List[Finding]) -> List[Finding]:
+    """An Attribute chain like ``datetime.datetime.now`` resolves at two
+    depths (`datetime.datetime.now` and nothing else matching) — but a
+    call also visits the chain as the Call's func child, producing one
+    finding per matching node at the same location.  Collapse exact
+    (code, path, line, col, detail) duplicates."""
+    seen: Set[tuple] = set()
+    out: List[Finding] = []
+    for f in findings:
+        k = (f.code, f.path, f.line, f.col, f.detail)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out
+
+
+def _is_setish(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in ("set", "frozenset")
+    return False
+
+
+class _SetIterVisitor(ast.NodeVisitor):
+    """Per-function tracking: names locally bound to set expressions,
+    plus ``self.<attr>`` names bound to sets anywhere in the enclosing
+    class.  Iterating either (outside ``sorted(...)``) is a finding."""
+
+    def __init__(self, path: str, class_set_attrs: Set[str]):
+        self.path = path
+        self.class_set_attrs = class_set_attrs
+        self.local_sets: Set[str] = set()
+        self.findings: List[Finding] = []
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if _is_setish(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.local_sets.add(t.id)
+        else:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.local_sets.discard(t.id)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        # annotated bindings (`s: Set[int] = set()`) track the same way
+        if isinstance(node.target, ast.Name) and node.value is not None:
+            if _is_setish(node.value):
+                self.local_sets.add(node.target.id)
+            else:
+                self.local_sets.discard(node.target.id)
+        self.generic_visit(node)
+
+    def _check_iter(self, it: ast.AST) -> None:
+        bad: Optional[str] = None
+        if _is_setish(it):
+            bad = "set-literal"
+        elif isinstance(it, ast.Name) and it.id in self.local_sets:
+            bad = it.id
+        elif (
+            isinstance(it, ast.Attribute)
+            and isinstance(it.value, ast.Name)
+            and it.value.id == "self"
+            and it.attr in self.class_set_attrs
+        ):
+            bad = f"self.{it.attr}"
+        if bad is not None:
+            self.findings.append(Finding(
+                "GS103", self.path, it.lineno, it.col_offset,
+                f"iteration over bare set `{bad}`: set order is "
+                "hash-randomized across processes — sort it or keep an "
+                "ordered structure",
+                bad,
+            ))
+
+    def visit_For(self, node: ast.For) -> None:
+        self._check_iter(node.iter)
+        self.generic_visit(node)
+
+    def _visit_comp(self, node) -> None:
+        for gen in node.generators:
+            self._check_iter(gen.iter)
+        self.generic_visit(node)
+
+    visit_ListComp = visit_SetComp = visit_GeneratorExp = _visit_comp
+    visit_DictComp = _visit_comp
+
+
+def _class_set_attrs(cls: ast.ClassDef) -> Set[str]:
+    attrs: Set[str] = set()
+    for node in ast.walk(cls):
+        targets: list = []
+        if isinstance(node, ast.Assign) and _is_setish(node.value):
+            targets = node.targets
+        elif (
+            isinstance(node, ast.AnnAssign)
+            and node.value is not None
+            and _is_setish(node.value)
+        ):
+            targets = [node.target]
+        for t in targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                attrs.add(t.attr)
+    return attrs
+
+
+@rule
+def bare_set_iteration(ctx: LintContext) -> List[Finding]:
+    out: List[Finding] = []
+    for path in _target_files(ctx):
+        tree = ctx.tree(path)
+
+        def scan(node: ast.AST, attrs: Set[str]) -> None:
+            # generic descent (if/try/with wrappers included) swapping
+            # the self-attr set at class boundaries and visiting each
+            # function body once at its outermost def (nested defs are
+            # walked by the visitor itself)
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    scan(child, _class_set_attrs(child))
+                elif isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    v = _SetIterVisitor(path, attrs)
+                    for stmt in child.body:
+                        v.visit(stmt)
+                    out.extend(v.findings)
+                else:
+                    scan(child, attrs)
+
+        scan(tree, set())
+    return out

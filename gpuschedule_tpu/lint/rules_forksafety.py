@@ -1,0 +1,307 @@
+"""GS6xx — fork-safety rule (ISSUE 13).
+
+The what-if pool (PR 12) and the sweep grids (PR 7) run the engine in
+forked/spawned worker processes.  Module-level mutable state that is
+MUTATED at runtime is the classic fork hazard: under ``fork()`` every
+worker silently shares the parent's pre-fork contents, and under
+``spawn`` it silently *doesn't* — either way the state diverges from
+what a single-process run sees, and nothing says so.
+
+**GS601** flags a module-level mutable binding (list/dict/set literal
+or constructor) that some function in the package mutates — subscript
+stores, ``del``, augmented assignment, or a mutating method call
+(``append``/``update``/``setdefault``...).  Read-only module tables
+(``GENERATIONS``, ``POLICY_CONFIGS``, ``_SPEC_KEYS``) are fine and not
+flagged: they are never written after import, so every process sees the
+same bytes.  Deliberate process-local state (a worker's warm-baseline
+cache, an import-time registry) carries a reasoned pragma — the point
+is that the sharing decision is *written down*, not inferred.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from gpuschedule_tpu.lint.core import (
+    Finding,
+    LintContext,
+    dotted_name,
+    import_aliases,
+    rule,
+)
+
+_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "setdefault", "pop",
+    "popitem", "clear", "remove", "discard", "sort", "reverse",
+    "__setitem__",
+}
+
+_MUTABLE_CONSTRUCTORS = {"list", "dict", "set", "defaultdict",
+                         "OrderedDict", "Counter", "deque"}
+
+
+def _is_mutable_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in _MUTABLE_CONSTRUCTORS
+    return False
+
+
+def _module_mutables(tree: ast.Module) -> Dict[str, Tuple[int, int, bool]]:
+    """Top-level Name -> (line, col, is_sentinel) for mutable bindings
+    plus ``None``-sentinel bindings (the worker-warm-state pattern:
+    ``_STATE = None`` rebound under ``global`` later).  Skips __all__
+    (a convention list nothing mutates by design)."""
+    out: Dict[str, Tuple[int, int, bool]] = {}
+    for node in tree.body:
+        targets: List[ast.Name] = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name):
+                targets = [node.target]
+            value = node.value
+        if value is None:
+            continue
+        sentinel = isinstance(value, ast.Constant) and value.value is None
+        if not _is_mutable_literal(value) and not sentinel:
+            continue
+        for t in targets:
+            if t.id != "__all__":
+                out[t.id] = (node.lineno, node.col_offset, sentinel)
+    return out
+
+
+def _local_bindings(fn) -> Set[str]:
+    """Names bound locally in ``fn`` (params, plain assigns, loop/with
+    targets, comprehension targets) — mutations of these are not module
+    state.  Nested functions' locals fold in (an over-approximation
+    that only ever suppresses, never invents, a finding)."""
+    local: Set[str] = set()
+    a = fn.args
+    for arg in (*a.posonlyargs, *a.args, *a.kwonlyargs, a.vararg, a.kwarg):
+        if arg is not None:
+            local.add(arg.arg)
+
+    def bind(t) -> None:
+        if isinstance(t, ast.Name):
+            local.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                bind(el)
+        elif isinstance(t, ast.Starred):
+            bind(t.value)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                bind(t)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            bind(node.target)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            bind(node.target)
+        elif isinstance(node, ast.withitem):
+            if node.optional_vars is not None:
+                bind(node.optional_vars)
+        elif isinstance(node, ast.comprehension):
+            bind(node.target)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            local.add(node.name)
+    return local
+
+
+def _qualified_target(
+    node: ast.AST, aliases: Dict[str, str]
+) -> Optional[Tuple[str, str]]:
+    """(imported module, attribute) when ``node`` is a mutation of a
+    module-qualified name — ``mod.TABLE[k]`` / ``mod.TABLE`` with
+    ``mod`` resolving through the file's imports."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute):
+        full = dotted_name(node, aliases)
+        if full and "." in full:
+            return tuple(full.rsplit(".", 1))  # type: ignore[return-value]
+    return None
+
+
+def _runtime_mutations(
+    tree: ast.AST,
+) -> Tuple[Set[str], Set[str], Set[Tuple[str, str]]]:
+    """Mutation sites inside function bodies (import-time top-level
+    mutation is fork-safe: it happens in every process), split three
+    ways because cross-module attribution needs the distinction:
+
+    - ``rebinds``: ``global NAME; NAME = ...`` — rebinds THIS module's
+      binding only (a sibling's from-imported copy is untouched);
+    - ``container``: subscript/method/del mutations of a module-level
+      name — these mutate the shared OBJECT, so a from-imported name
+      mutated this way blames the defining module;
+    - ``qualified``: (module, attr) pairs for ``mod.NAME[...]``-style
+      mutations through an imported module reference.
+
+    Scope-aware: a function-local ``out = {}; out[k] = v`` never blames
+    a same-named module global."""
+    aliases = import_aliases(tree)
+    rebinds: Set[str] = set()
+    container: Set[str] = set()
+    qualified: Set[Tuple[str, str]] = set()
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        declared_global: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                declared_global.update(node.names)
+        local = _local_bindings(fn) - declared_global
+
+        def module_name(base) -> Optional[str]:
+            if isinstance(base, ast.Name) and (
+                base.id in declared_global or base.id not in local
+            ):
+                return base.id
+            return None
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    # bare-Name rebind only mutates module state under
+                    # an explicit ``global`` declaration
+                    if isinstance(t, ast.Name) and t.id in declared_global:
+                        rebinds.add(t.id)
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    base = t
+                    seen_container = False
+                    while isinstance(base, (ast.Subscript, ast.Attribute)):
+                        if isinstance(base, ast.Subscript):
+                            seen_container = True
+                        base = base.value
+                    if seen_container:
+                        name = module_name(base)
+                        if name:
+                            container.add(name)
+                        q = _qualified_target(t, aliases)
+                        if q:
+                            qualified.add(q)
+                    elif isinstance(t, ast.Attribute):
+                        # `mod.NAME = x`: rebinding another module's
+                        # global is a mutation of that module's state
+                        q = _qualified_target(t, aliases)
+                        if q:
+                            qualified.add(q)
+                    elif (
+                        isinstance(node, ast.AugAssign)
+                        and isinstance(base, ast.Name)
+                        and base.id in declared_global
+                    ):
+                        rebinds.add(base.id)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        name = module_name(t.value)
+                        if name:
+                            container.add(name)
+                        q = _qualified_target(t, aliases)
+                        if q:
+                            qualified.add(q)
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                    name = module_name(f.value)
+                    if name:
+                        container.add(name)
+                    q = _qualified_target(f.value, aliases)
+                    if q:
+                        qualified.add(q)
+    return rebinds, container, qualified
+
+
+def _module_dotted(path: str) -> str:
+    """gpuschedule_tpu/sim/whatif.py -> gpuschedule_tpu.sim.whatif"""
+    return path[:-3].replace("/__init__", "").replace("/", ".")
+
+
+@rule
+def module_level_mutable_state(ctx: LintContext) -> List[Finding]:
+    out: List[Finding] = []
+    # pass 1: each module's own candidates and mutation sites; collect
+    # from-imports RESOLVED to their source module (absolute dotted, or
+    # relative against the importing file's package), so an unrelated
+    # module that happens to define a same-named table is never blamed
+    # for a sibling's mutation
+    candidates: Dict[str, Dict[str, Tuple[int, int, bool]]] = {}
+    rebinds: Dict[str, Set[str]] = {}
+    container: Dict[str, Set[str]] = {}
+    qualified: Dict[str, Set[Tuple[str, str]]] = {}  # (module, attr)
+    imports: Dict[str, Set[Tuple[str, str]]] = {}  # (resolved mod, name)
+    for path in ctx.py_files:
+        tree = ctx.tree(path)
+        candidates[path] = _module_mutables(tree)
+        rebinds[path], container[path], qualified[path] = (
+            _runtime_mutations(tree)
+        )
+        # relative imports resolve against the containing package — for
+        # an __init__.py that is the module's own dotted path
+        if path.endswith("/__init__.py"):
+            package = _module_dotted(path)
+        else:
+            package = _module_dotted(path).rsplit(".", 1)[0]
+        pairs: Set[Tuple[str, str]] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            if node.level == 0:
+                resolved = node.module or ""
+            else:
+                parts = package.split(".")
+                parts = parts[: len(parts) - (node.level - 1)]
+                if node.module:
+                    parts.append(node.module)
+                resolved = ".".join(parts)
+            for a in node.names:
+                pairs.add((resolved, a.asname or a.name))
+        imports[path] = pairs
+
+    for path in ctx.py_files:
+        dotted = _module_dotted(path)
+        for name, (line, col, _sentinel) in sorted(
+            candidates[path].items()
+        ):
+            hit = name in rebinds[path] or name in container[path]
+            if not hit:
+                # a sibling module that mutates the shared OBJECT —
+                # through a module-qualified reference (mod.NAME[...])
+                # or a container mutation of its from-imported name.
+                # A sibling's `global NAME; NAME = ...` rebind of its
+                # own imported copy does NOT blame this module
+                for other in ctx.py_files:
+                    if other == path:
+                        continue
+                    if (dotted, name) in qualified[other]:
+                        hit = True
+                        break
+                    if name in container[other] and (
+                        (dotted, name) in imports[other]
+                    ):
+                        hit = True
+                        break
+            if hit:
+                out.append(Finding(
+                    "GS601", path, line, col,
+                    f"module-level mutable `{name}` is mutated at "
+                    "runtime — forked pool workers silently share (or "
+                    "silently don't share) its contents; make the "
+                    "sharing decision explicit",
+                    name,
+                ))
+    return out

@@ -1,0 +1,188 @@
+"""GS3xx — event-schema drift rules (ISSUE 13).
+
+``docs/events.md`` is the contract the analytics layer, the Perfetto
+exporter, and every external consumer of the event stream read against;
+``sim/engine.py`` is the only writer.  Schema v1 is additive-only, so
+drift has exactly two shapes, both statically detectable:
+
+- **GS301** the engine emits an event kind the document doesn't list
+  (an undocumented record every reader must guess at);
+- **GS302** the document lists a kind the engine never emits (dead
+  documentation that readers build against);
+- **GS303** the engine emits a payload key that appears nowhere in the
+  document (an undocumented field).
+
+Extraction: every ``*.event("<kind>", t, job, key=..., **extra)`` call
+in the engine — explicit keywords plus the keys of any local ``extra``
+dict the call splats (dict literals and ``extra["k"] = ...`` stores in
+the enclosing function are resolved; opaque splats like
+``**cluster.sample_state()`` contribute nothing, which is safe because
+GS303 only checks the *extracted* keys).  The document side parses the
+markdown tables whose header column is ``kind``; payload keys match
+against every backticked token in the document (tables and prose — the
+shared ``slow_factor``/``why``/``blame`` semantics live in prose).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from gpuschedule_tpu.lint.core import (
+    Finding,
+    LintContext,
+    backtick_tokens,
+    const_str,
+    rule,
+)
+
+
+def _doc_kinds(text: str) -> Set[str]:
+    """The documented event kinds: first-column backtick tokens of every
+    markdown table whose header's first column is ``kind``.  (Payload
+    keys match against the whole document's tokens, not per-row — the
+    shared ``slow_factor``/``why``/``blame`` semantics live in prose.)"""
+    kinds: Set[str] = set()
+    in_table = False
+    for line in text.splitlines():
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            in_table = False
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if not cells:
+            continue
+        if cells[0] == "kind":
+            in_table = True
+            continue
+        if set(cells[0]) <= {"-", ":", " "}:
+            continue
+        if not in_table:
+            continue
+        m = re.fullmatch(r"`([^`]+)`", cells[0])
+        if m:
+            kinds.add(m.group(1))
+        else:
+            # a non-backticked first cell is a different table's header
+            # (e.g. `| cache | count |` adjacent with no blank line) —
+            # stop collecting so its rows aren't read as event kinds
+            in_table = False
+    return kinds
+
+
+class _ExtraResolver(ast.NodeVisitor):
+    """Collect, per function, the constant keys flowing into each local
+    name that is later ``**``-splatted: dict-literal assignments and
+    ``name["key"] = ...`` subscript stores."""
+
+    def __init__(self) -> None:
+        self.keys: Dict[str, Set[str]] = {}
+        self.opaque: Set[str] = set()
+
+    def _add_dict(self, name: str, d: ast.Dict) -> None:
+        bucket = self.keys.setdefault(name, set())
+        for k in d.keys:
+            s = const_str(k) if k is not None else None
+            if s is None:
+                self.opaque.add(name)
+            else:
+                bucket.add(s)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                if isinstance(node.value, ast.Dict):
+                    self._add_dict(t.id, node.value)
+                else:
+                    self.opaque.add(t.id)
+            elif (
+                isinstance(t, ast.Subscript)
+                and isinstance(t.value, ast.Name)
+            ):
+                key = const_str(t.slice)
+                if key is None:
+                    self.opaque.add(t.value.id)
+                else:
+                    self.keys.setdefault(t.value.id, set()).add(key)
+        self.generic_visit(node)
+
+
+def _emitted(tree: ast.AST) -> Dict[str, List[Tuple[int, int, Set[str]]]]:
+    """kind -> [(line, col, payload keys)] for every ``.event("kind",
+    ...)`` call, with local ``extra`` splats resolved per function."""
+    out: Dict[str, List[Tuple[int, int, Set[str]]]] = {}
+    funcs: List[ast.AST] = [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for fn in funcs:
+        resolver = _ExtraResolver()
+        resolver.visit(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute) and f.attr == "event"):
+                continue
+            if not node.args:
+                continue
+            kind = const_str(node.args[0])
+            if kind is None:
+                continue
+            keys: Set[str] = set()
+            for kw in node.keywords:
+                if kw.arg is not None:
+                    keys.add(kw.arg)
+                elif isinstance(kw.value, ast.Name):
+                    name = kw.value.id
+                    keys |= resolver.keys.get(name, set())
+                # non-Name splats (**obj.method()) are opaque: skip
+            out.setdefault(kind, []).append(
+                (node.lineno, node.col_offset, keys)
+            )
+    return out
+
+
+@rule
+def event_schema_drift(ctx: LintContext) -> List[Finding]:
+    cfg = ctx.config
+    if not ctx.has(cfg.engine_path) or not ctx.has(cfg.events_doc_path):
+        return []
+    doc_text = ctx.source(cfg.events_doc_path)
+    doc_kinds = _doc_kinds(doc_text)
+    doc_tokens = backtick_tokens(doc_text)
+    emitted = _emitted(ctx.tree(cfg.engine_path))
+
+    out: List[Finding] = []
+    for kind in sorted(emitted):
+        line, col, _ = emitted[kind][0]
+        if kind not in doc_kinds:
+            out.append(Finding(
+                "GS301", cfg.engine_path, line, col,
+                f"engine emits event kind '{kind}' that "
+                f"{cfg.events_doc_path} does not document",
+                f"kind:{kind}",
+            ))
+    for kind in sorted(doc_kinds):
+        if kind not in emitted:
+            out.append(Finding(
+                "GS302", cfg.events_doc_path, 0, 0,
+                f"{cfg.events_doc_path} documents event kind '{kind}' "
+                f"that {cfg.engine_path} never emits",
+                f"kind:{kind}",
+            ))
+    seen: Set[Tuple[str, str]] = set()
+    for kind in sorted(emitted):
+        for line, col, keys in emitted[kind]:
+            for key in sorted(keys):
+                if key in doc_tokens or (kind, key) in seen:
+                    continue
+                seen.add((kind, key))
+                out.append(Finding(
+                    "GS303", cfg.engine_path, line, col,
+                    f"event '{kind}' payload key '{key}' appears nowhere "
+                    f"in {cfg.events_doc_path}",
+                    f"key:{kind}.{key}",
+                ))
+    return out

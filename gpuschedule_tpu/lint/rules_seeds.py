@@ -1,0 +1,138 @@
+"""GS2xx — seed-stream registry rules (ISSUE 13).
+
+The seed-split rule (PR 2): every stochastic process derives its own
+independent stream as ``random.Random(f"{seed}:<namespace>")``, so
+changing one knob's config never perturbs another stream's draws.  The
+namespaces form a flat global space with no runtime collision check —
+two processes picking the same namespace silently share a stream.  This
+rule extracts every f-string handed to ``random.Random`` anywhere in
+the package, normalizes the interpolation holes to ``{}``, and checks
+the result against the declared registry
+(``gpuschedule_tpu/lint/seed_registry.py``):
+
+- **GS201** unregistered stream template,
+- **GS202** registry row whose template is constructed nowhere (stale),
+- **GS203** one template constructed at more than one call site
+  (stream collision) unless declared in ``SHARED_SEED_STREAMS``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from gpuschedule_tpu.lint.core import Finding, LintContext, rule
+
+
+def _template(js: ast.JoinedStr) -> str:
+    parts: List[str] = []
+    for v in js.values:
+        if isinstance(v, ast.Constant):
+            parts.append(str(v.value))
+        else:
+            parts.append("{}")
+    return "".join(parts)
+
+
+def _stream_sites(ctx: LintContext) -> List[Tuple[str, int, int, str]]:
+    """(path, line, col, template) for every random.Random(f"...")."""
+    sites = []
+    for path in ctx.py_files:
+        for node in ast.walk(ctx.tree(path)):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            is_random = (
+                isinstance(fn, ast.Attribute) and fn.attr == "Random"
+            ) or (isinstance(fn, ast.Name) and fn.id == "Random")
+            if not is_random:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.JoinedStr):
+                sites.append(
+                    (path, node.lineno, node.col_offset, _template(arg))
+                )
+    return sites
+
+
+@rule
+def seed_stream_registry(ctx: LintContext) -> List[Finding]:
+    registry_path = f"{ctx.config.package}/lint/seed_registry.py"
+    if ctx.config.seed_streams is not None:
+        registry: Dict[str, str] = dict(ctx.config.seed_streams)
+        shared = set(ctx.config.shared_seed_streams)
+        check_stale = True
+    elif ctx.has(registry_path):
+        # read the TARGET tree's declared registry statically (AST
+        # literals, like the worldspec rule) — `lint --root OTHER`
+        # must check OTHER's registry, not the running package's
+        registry = {}
+        shared = set()
+        for node in ast.walk(ctx.tree(registry_path)):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if t.id == "SEED_STREAMS" and isinstance(
+                    node.value, ast.Dict
+                ):
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) and isinstance(
+                            k.value, str
+                        ):
+                            registry[k.value] = ""
+                elif t.id == "SHARED_SEED_STREAMS" and isinstance(
+                    node.value, (ast.Tuple, ast.List)
+                ):
+                    for el in node.value.elts:
+                        if isinstance(el, ast.Constant) and isinstance(
+                            el.value, str
+                        ):
+                            shared.add(el.value)
+        check_stale = True
+    else:
+        # a tree without the registry file: fall back to the running
+        # package's registry for GS201, but never report stale rows —
+        # they would all be stale against a fixture tree
+        from gpuschedule_tpu.lint.seed_registry import (
+            SEED_STREAMS,
+            SHARED_SEED_STREAMS,
+        )
+        registry = dict(SEED_STREAMS)
+        shared = set(SHARED_SEED_STREAMS)
+        check_stale = False
+
+    out: List[Finding] = []
+    sites = _stream_sites(ctx)
+    by_template: Dict[str, List[Tuple[str, int, int]]] = {}
+    for path, line, col, tmpl in sites:
+        by_template.setdefault(tmpl, []).append((path, line, col))
+        if tmpl not in registry:
+            out.append(Finding(
+                "GS201", path, line, col,
+                f"unregistered seed-stream namespace '{tmpl}': add it to "
+                "lint/seed_registry.py (or it may collide silently)",
+                tmpl,
+            ))
+    for tmpl, locs in sorted(by_template.items()):
+        if len(locs) > 1 and tmpl not in shared:
+            for path, line, col in locs[1:]:
+                out.append(Finding(
+                    "GS203", path, line, col,
+                    f"seed-stream namespace '{tmpl}' is constructed at "
+                    f"{len(locs)} call sites — two RNGs sharing one "
+                    "namespace produce identical interleaved draw "
+                    "sequences; declare it SHARED or pick a new namespace",
+                    tmpl,
+                ))
+    # stale registry rows, anchored to the registry file's label
+    for tmpl in sorted(registry):
+        if check_stale and tmpl not in by_template:
+            out.append(Finding(
+                "GS202", registry_path, 0, 0,
+                f"registered seed stream '{tmpl}' is constructed nowhere "
+                "— remove the stale registry row",
+                tmpl,
+            ))
+    return out

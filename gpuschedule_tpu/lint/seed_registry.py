@@ -1,0 +1,39 @@
+"""The declared seed-stream registry (ISSUE 13, rule GS2xx).
+
+The seed-split rule (faults/schedule.py, PR 2): one ``--seed`` governs
+every stochastic stream in a run — trace synthesis keeps the bare seed,
+and every other process derives an independent stream as
+``random.Random(f"{seed}:<namespace>")``.  Two processes sharing a
+namespace silently share a stream (draws interleave, determinism
+contracts break one knob at a time), so every namespace template used
+anywhere in the package must be REGISTERED here, and each template may
+be constructed at exactly one call site (GS203) unless listed as
+deliberately shared.
+
+Templates are the f-string with every interpolation hole normalized to
+``{}`` — ``f"{seed}:faults:mtbf"`` registers as ``{}:faults:mtbf``.
+Adding a stream: pick a namespace no other process uses, add the row
+here with a one-line description, then construct it.  The linter flags
+unregistered templates (GS201), stale registry rows (GS202), and
+duplicate construction sites (GS203).
+"""
+
+from __future__ import annotations
+
+# template -> what draws from it
+SEED_STREAMS = {
+    "{}:faults:mtbf": "per-chip MTBF outages (faults/schedule.py); the "
+                      "Weibull hazard sampler time-rescales this same "
+                      "stream so shape=1 stays draw-identical",
+    "{}:faults:spot": "spot revocations (+ pre-revoke warnings)",
+    "{}:faults:link": "DCN uplink degradation outages",
+    "{}:faults:domain": "correlated host/rack/pod blast-radius outages",
+    "{}:faults:straggler": "slow-chip onset/recovery",
+    "{}:net:share": "deterministic multislice promotion in the "
+                    "contention sweep grid (net/sweep.py)",
+}
+
+# templates deliberately constructed at more than one call site (none
+# today; the hazard sampler reuses the mtbf stream by replaying the SAME
+# RNG object, not by re-deriving the namespace)
+SHARED_SEED_STREAMS: tuple = ()

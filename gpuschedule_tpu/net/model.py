@@ -273,6 +273,18 @@ class NetModel:
 
     # ------------------------------------------------------------------ #
 
+    # the snapshot contract's audit surface (ISSUE 13): every derived
+    # cache listed here must be rebuilt/invalidated in restored() —
+    # cross-checked statically by the contract linter (GS502,
+    # docs/static-analysis.md)
+    _DERIVED_CACHES = (
+        "_dirty",
+        "_flows_dirty",
+        "_state",
+        "_pod_routes",
+        "_group_cache",
+    )
+
     def restored(self) -> None:
         """Post-restore cache invalidation (engine snapshots, ISSUE 11):
         a deserialized model keeps its authoritative state — link degrade

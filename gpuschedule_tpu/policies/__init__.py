@@ -12,7 +12,7 @@ from gpuschedule_tpu.policies.optimus import OptimusPolicy
 from gpuschedule_tpu.policies.srtf import SrtfPolicy
 from gpuschedule_tpu.policies.themis import ThemisPolicy
 
-_REGISTRY = {
+_REGISTRY = {  # lint: allow[GS601] populated by register() at import time only; every process re-imports identically
     "fifo": FifoPolicy,
     "srtf": SrtfPolicy,
     "dlas": DlasPolicy,

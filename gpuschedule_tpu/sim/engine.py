@@ -1717,6 +1717,7 @@ class Simulator:
         dispatch) lands in ``other`` at :meth:`PhaseProfiler.finish`, so
         the phase totals sum to the measured total exactly."""
         prof = self._profiler
+        # lint: allow[GS101] the self-profiler measures wall time by design (ISSUE 10); replay output stays byte-identical
         perf = time.perf_counter
         prof.start(policy=self.policy.name, jobs=len(self.jobs))
         heap = self._heap

@@ -199,7 +199,9 @@ def baseline_doc(fork_fn, horizon: float) -> dict:
 # --------------------------------------------------------------------- #
 # pool-worker half: module state warmed once per worker process
 
+# lint: allow[GS601] deliberately process-local: each pool worker holds its own restored mirror bytes (ISSUE 12)
 _MIRROR_BYTES: Optional[bytes] = None
+# lint: allow[GS601] deliberately process-local: each pool worker warms its own baseline cache after restoring the broadcast mirror (ISSUE 12)
 _BASELINES: Dict[float, dict] = {}
 
 
@@ -230,9 +232,10 @@ def _eval_task(q: dict, horizon: float) -> dict:
         # lazy warm for a non-preloaded horizon: setup cost, untimed —
         # the same rule _eval_local follows
         base = _BASELINES[horizon] = baseline_doc(_worker_fork, horizon)
+    # lint: allow[GS101] query-latency measurement is wall-clock by design; the replay itself never reads it
     t0 = time.perf_counter()
     doc = evaluate_query(_worker_fork, q, horizon, base)
-    doc["latency_s"] = time.perf_counter() - t0
+    doc["latency_s"] = time.perf_counter() - t0  # lint: allow[GS101] same latency surface as above
     return doc
 
 
@@ -320,9 +323,10 @@ class WhatIfService:
         # — else the first serial query reports ~2x and the SLO
         # telemetry becomes mode-dependent
         base = self.warm(horizon)
+        # lint: allow[GS101] query-latency measurement is wall-clock by design; the replay itself never reads it
         t0 = time.perf_counter()
         doc = evaluate_query(self._fork, q, horizon, base)
-        doc["latency_s"] = time.perf_counter() - t0
+        doc["latency_s"] = time.perf_counter() - t0  # lint: allow[GS101] same latency surface as above
         return doc
 
     def evaluate(self, queries: Sequence[dict]) -> List[dict]:

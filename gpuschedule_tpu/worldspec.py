@@ -1,0 +1,129 @@
+"""The world-flag -> config-hash table: ONE source of truth (ISSUE 13).
+
+Every CLI knob reachable from ``_add_world_args`` or defined on a
+world-building subparser (``run``, ``whatif``) is accounted for here, in
+exactly one of three buckets:
+
+- ``HASHED``: always part of the experiment config hash, under its
+  argparse dest name (the hash dict key EQUALS the dest, which is what
+  keeps every historical hash byte-identical — do not rename either side
+  independently).
+- ``HASHED_WHEN_ARMED``: rides the hash only when armed (value differs
+  from the disarmed default AND is truthy) — a knob-off run's hash (and
+  therefore its run_id and events header) must stay byte-identical to
+  what it was before the knob existed.
+- ``UNHASHED``: deliberately outside the hash, each with a one-line
+  justification.  Output/telemetry knobs never change replay semantics;
+  policy-side knobs are excluded so ``compare`` accepts policy-A-vs-B
+  runs of the same seeded world.
+
+``cli.py:_run_config_hash`` consumes this table at runtime; the contract
+linter's config-hash coverage rule (``gpuschedule_tpu/lint/``, GS4xx)
+cross-checks it statically against the argparse definitions — a flag
+added to ``_add_world_args`` or ``run`` without a row here is a lint
+failure, which is what turns silent hash drift into a CI-gated defect
+(see docs/static-analysis.md).
+"""
+
+from __future__ import annotations
+
+# Always hashed: dest name == hash key, values taken verbatim from args.
+HASHED = (
+    "cluster",
+    "chips",
+    "dims",
+    "pods",
+    "gpu_shape",
+    "placement",
+    "placement_seed",
+    "philly",
+    "trace",
+    "synthetic",
+    "seed",
+    "arrival_rate",
+    "mean_duration",
+    "failure_rate",
+    "util_min",
+    "max_job_chips",
+    "max_time",
+    "faults",
+)
+
+# Hashed only when armed: dest -> disarmed default.  The knob joins the
+# hash dict (key == dest, value == the armed arg value) only when the
+# value is truthy and differs from the disarmed default:
+# - net: only present when --net is on — a net-free run's hash must stay
+#   byte-identical to before the net layer existed (ISSUE 4);
+# - accounting: v2 changes the float-summation contract (ISSUE 11:
+#   closure replaces byte-identity), so it IS experiment config — but
+#   only when armed, keeping every historical v1 hash byte-identical.
+HASHED_WHEN_ARMED = {
+    "net": None,
+    "accounting": "v1",
+}
+
+# Deliberately unhashed, each with its one-line justification — the
+# linter refuses empty reasons (GS403).
+UNHASHED = {
+    # -- policy-side world flags (the hash covers cluster + trace +
+    #    faults, deliberately NOT the policy, so policy-A-vs-B runs of
+    #    the same world stay compare-compatible) --
+    "policy": "policy identity is deliberately outside the experiment "
+              "hash so A-vs-B policy runs of one world are comparable",
+    "policy_arg": "policy constructor kwargs are policy identity, not "
+                  "world config",
+    "curves": "goodput curve cache feeds the optimus policy, not the "
+              "world",
+    "online": "live profiling is an optimus policy input, not world "
+              "config",
+    # -- run-only output / telemetry knobs (replay-neutral by pinned
+    #    byte-identity contracts) --
+    "out": "output directory choice never changes replay semantics",
+    "prefix": "output filename prefix only",
+    "events": "event recording is observational; recorded runs are "
+              "byte-identical to unrecorded ones",
+    "perfetto": "trace export is derived from the event stream, "
+                "replay-neutral",
+    "spans": "span tracing is gated at <=2% overhead and replay-neutral",
+    "attrib": "attribution is additive bookkeeping; off-path runs are "
+              "byte-identical (ISSUE 5 pinned)",
+    "sample_interval": "sample events never perturb the replay "
+                       "(byte-identity pinned, ISSUE 5)",
+    "sample_on_change": "on-change samples never perturb the replay "
+                        "(byte-identity pinned, ISSUE 10)",
+    "self_profile": "wall-clock self-profiling leaves replay output "
+                    "byte-identical (ISSUE 10 pinned)",
+    "cache_stats": "cache telemetry harvests counters after the replay "
+                   "finished",
+    "prom": "metrics exposition format output only",
+    "history": "history rows record results; they never feed back into "
+               "the replay",
+    "snapshot": "periodic snapshot writes are between-batch and "
+                "replay-neutral (resume byte-identity pinned, ISSUE 11)",
+    "snapshot_every": "snapshot cadence, replay-neutral with --snapshot",
+    "resume": "a resumed run's world comes from the snapshot, not the "
+              "flags; finished outputs are byte-identical under v1",
+    # -- whatif-only query flags (ISSUE 12): they select what to ASK of
+    #    the mirrored world — queries evaluate on speculative forks and
+    #    are never part of the world's identity --
+    "at": "the mirror instant selects where to pause, not which world",
+    "horizon": "speculative-replay budget per query, fork-side only",
+    "pool": "worker-process count; serial and pooled documents are "
+            "pinned identical",
+    "admit": "admit queries evaluate on forks of the mirrored world",
+    "drain": "drain queries evaluate on forks of the mirrored world",
+    "swap_policy": "policy-swap queries evaluate on forks; policy is "
+                   "outside the hash by design",
+}
+
+
+def hash_config(args) -> dict:
+    """The experiment-config dict ``cli.py:_run_config_hash`` digests —
+    built from the table above so the hash computation and the linter's
+    coverage rule read the same source of truth."""
+    config = {dest: getattr(args, dest) for dest in HASHED}
+    for dest, disarmed in HASHED_WHEN_ARMED.items():
+        value = getattr(args, dest, disarmed)
+        if value and value != disarmed:
+            config[dest] = value
+    return config

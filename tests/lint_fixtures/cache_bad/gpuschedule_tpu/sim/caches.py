@@ -1,0 +1,31 @@
+"""Bad fixture: a dead counter, a declared-but-unshed cache, an
+undeclared shed, and an undocumented cache name."""
+
+
+class Engine:
+    _DERIVED_CACHES = ("_memo",)            # GS502 unshed (line 5)
+
+    def __init__(self):
+        self._hits = 0
+        self._misses = 0
+        self._memo = {}
+
+    def lookup(self, key):
+        if key in self._memo:
+            self._hits += 1
+            return self._memo[key]
+        return None                         # _misses never incremented
+
+    def cache_stats(self):
+        # GS501 dead 'miss' counter + GS503 undocumented name (line 21)
+        return {"dark_cache": {"hit": self._hits, "miss": self._misses}}
+
+
+class Other:
+    def __init__(self):
+        self._scratch = {}
+
+    def __getstate__(self):                 # GS502 undeclared (line 24)
+        state = self.__dict__.copy()
+        state["_scratch"] = {}
+        return state

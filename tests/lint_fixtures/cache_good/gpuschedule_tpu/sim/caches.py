@@ -1,0 +1,25 @@
+"""Good fixture: live counters, declared + shed derived cache."""
+
+
+class Engine:
+    _DERIVED_CACHES = ("_memo",)
+
+    def __init__(self):
+        self._hits = 0
+        self._misses = 0
+        self._memo = {}
+
+    def lookup(self, key):
+        if key in self._memo:
+            self._hits += 1
+            return self._memo[key]
+        self._misses += 1
+        return None
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_memo"] = {}
+        return state
+
+    def cache_stats(self):
+        return {"demo_cache": {"hit": self._hits, "miss": self._misses}}

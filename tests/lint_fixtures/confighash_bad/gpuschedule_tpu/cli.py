@@ -1,0 +1,11 @@
+"""Bad fixture: an undecided flag, plus (in the table) a stale row and
+an empty justification."""
+
+
+def _add_world_args(p):
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--mystery-knob", type=float)   # GS401 (line 7)
+
+
+def main(run):
+    run.add_argument("--out")

@@ -1,0 +1,8 @@
+HASHED = ("seed",)
+
+HASHED_WHEN_ARMED = {}
+
+UNHASHED = {
+    "ghost": "a flag the CLI no longer defines",   # GS402 (line 6)
+    "out": "",                                     # GS403 (line 7)
+}

@@ -1,0 +1,11 @@
+"""Good fixture: every flag is hashed or allowlisted."""
+
+
+def _add_world_args(p):
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("-p", "--policy", default="fifo")   # dest from the
+    p.add_argument("--net", nargs="?", const=True, default=None)  # long opt
+
+
+def main(run):
+    run.add_argument("--out")

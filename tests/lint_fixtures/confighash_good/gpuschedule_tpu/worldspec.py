@@ -1,0 +1,8 @@
+HASHED = ("seed",)
+
+HASHED_WHEN_ARMED = {"net": None}
+
+UNHASHED = {
+    "policy": "policy identity stays out of the experiment hash",
+    "out": "output path only, replay-neutral",
+}

@@ -1,0 +1,28 @@
+"""Bad fixture: one violation per determinism sub-rule, lines pinned
+by tests/test_contract_lint.py."""
+
+import random
+import time
+from datetime import datetime
+
+
+def emit_events(jobs):
+    t = time.time()                     # GS101 (line 10)
+    jitter = random.random()            # GS102 (line 11)
+    order = set(jobs)
+    for job in order:                   # GS103 (line 13)
+        pass
+    return t, jitter
+
+
+def stamp():
+    return datetime.now()               # GS101 (line 19)
+
+
+try:
+    def guarded(flows):
+        members = {1, 2, 3}
+        for f in members:               # GS103 (line 25): functions
+            pass                        # under try/if are scanned too
+except Exception:
+    pass

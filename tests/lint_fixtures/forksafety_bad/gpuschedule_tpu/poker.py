@@ -1,0 +1,7 @@
+"""Mutates a sibling module's table through a qualified reference."""
+
+from gpuschedule_tpu import util_state
+
+
+def poke(key, value):
+    util_state.TABLE2[key] = value

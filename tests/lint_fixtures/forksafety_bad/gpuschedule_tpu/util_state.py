@@ -1,0 +1,20 @@
+"""Bad fixture: module-level mutable state mutated at runtime — a dict
+mutated in place and a None sentinel rebound under ``global`` (the
+worker-warm-state pattern)."""
+
+_CACHE = {}                                 # GS601 (line 5)
+
+_WARM = None                                # GS601 (line 7)
+
+TABLE2 = {}                                 # GS601 (line 9): mutated by
+                                            # sibling poker.py, qualified
+
+
+def remember(key, value):
+    _CACHE[key] = value
+    return _CACHE
+
+
+def warm(payload):
+    global _WARM
+    _WARM = payload

@@ -1,0 +1,7 @@
+"""Good fixture: a module-level table that is only ever read."""
+
+TABLE = {"a": 1, "b": 2}
+
+
+def read(key):
+    return TABLE[key]

@@ -1,0 +1,17 @@
+"""Pragma fixture: one reasoned pragma (allowed) and one reasonless
+pragma (GS002)."""
+
+import time
+
+
+def reasoned():
+    return time.time()  # lint: allow[GS101] fixture demonstrates a reasoned pragma
+
+
+def reasonless():
+    return time.time()  # lint: allow[GS101]
+
+
+def documented():
+    "# lint: allow[GS101] pragma-shaped STRING must not suppress"
+    return time.time()  # GS103-adjacent: a real, unsuppressed GS101

@@ -1,0 +1,10 @@
+"""Bad fixture: an undocumented kind, an undocumented key, and (in the
+doc) a kind that is never emitted."""
+
+
+class Sim:
+    def run(self, metrics):
+        extra = {"speed": 1.0}
+        extra["warp"] = 9.0
+        metrics.event("start", 0.0, None, chips=4, **extra)   # GS303 warp
+        metrics.event("mystery", 2.0, None, blob=1)           # GS301+GS303

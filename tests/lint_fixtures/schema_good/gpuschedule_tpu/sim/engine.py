@@ -1,0 +1,9 @@
+"""Good fixture: every emitted kind and key is documented."""
+
+
+class Sim:
+    def run(self, metrics):
+        extra = {"speed": 1.0}
+        extra["track"] = "pod0"
+        metrics.event("start", 0.0, None, chips=4, **extra)
+        metrics.event("finish", 1.0, None, end_state="done")

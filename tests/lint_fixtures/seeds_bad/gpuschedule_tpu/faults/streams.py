@@ -1,0 +1,10 @@
+"""Bad fixture: an unregistered stream and a duplicated one."""
+
+import random
+
+
+def make(seed):
+    a = random.Random(f"{seed}:faults:mtbf")     # registered, 1st site
+    b = random.Random(f"{seed}:faults:rogue")    # GS201 (line 8)
+    c = random.Random(f"{seed}:faults:mtbf")     # GS203 (line 9)
+    return a, b, c

@@ -1,0 +1,7 @@
+"""Good fixture: one registered stream, one call site."""
+
+import random
+
+
+def make(seed):
+    return random.Random(f"{seed}:faults:mtbf")

@@ -1,0 +1,46 @@
+"""Contract-linter CI gate (ISSUE 13 satellite).
+
+Mirrors the ``check_overhead.py`` / ``engine_bench.py`` gate pattern:
+run the full contract linter over this checkout, print one
+deterministic JSON document, exit 0 when the tree is clean (every
+finding fixed, pragma-allowed, or baselined against
+``tools/lint_baseline.json``) and 1 otherwise.  The JSON is
+byte-identical across repeated runs on the same tree, so the artifact
+diffs cleanly and the summary block can ride the PR-10 history store
+(``python -m gpuschedule_tpu lint --history STORE`` appends it).
+
+Run directly, or through the tier-1 pytest wrapper
+(tests/test_contract_lint.py::test_repo_tree_is_clean):
+
+    python tools/contract_lint.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gpuschedule_tpu.lint import load_baseline, run_lint
+
+ROOT = Path(__file__).resolve().parent.parent
+BASELINE = ROOT / "tools" / "lint_baseline.json"
+
+
+def run_gate() -> dict:
+    baseline = load_baseline(BASELINE) if BASELINE.is_file() else None
+    report = run_lint(ROOT, baseline=baseline)
+    doc = report.to_json()
+    for f in report.findings:
+        print(f.render(), file=sys.stderr)
+    return doc
+
+
+if __name__ == "__main__":
+    res = run_gate()
+    import json
+
+    print(json.dumps(res, sort_keys=True))
+    sys.exit(0 if res["ok"] else 1)
