@@ -1280,14 +1280,93 @@ def _apply_platform_override() -> None:
     jax.config.update("jax_platforms", plat)
 
 
+def _fixture_covered_codes(root) -> set:
+    """GS codes exercised by the fixture trees under
+    ``tests/lint_fixtures/`` — the non-vacuity floor ``--update-baseline``
+    refuses to cross: a finding whose code no fixture can produce must
+    not be baselined (add the fixture pair first)."""
+    from gpuschedule_tpu.lint import run_lint
+
+    fixtures = root / "tests" / "lint_fixtures"
+    covered = {"GS001"}  # stale-baseline findings are never baselined
+    if not fixtures.is_dir():
+        return covered
+    for tree in sorted(fixtures.iterdir()):
+        if (tree / "gpuschedule_tpu").is_dir():
+            covered.update(
+                f.code for f in run_lint(tree).findings
+            )
+    return covered
+
+
+def _update_baseline(root, baseline_path, old_entries) -> int:
+    """``lint --update-baseline``: rewrite the baseline deterministically
+    from the tree's current findings (sorted fingerprints, justifications
+    carried over; new entries get an explicit edit-me placeholder)."""
+    import json as _json
+
+    from gpuschedule_tpu.lint import run_lint
+
+    report = run_lint(root)  # pragma suppression applies, baseline doesn't
+    if report.files_scanned == 0:
+        raise SystemExit(f"no package files found under {root} — wrong root?")
+    covered = _fixture_covered_codes(root)
+    uncovered = sorted(
+        {f.code for f in report.findings} - covered
+    )
+    if uncovered:
+        raise SystemExit(
+            "refusing to baseline findings for rule codes with zero "
+            f"fixtures: {', '.join(uncovered)} — add a good/bad fixture "
+            "pair under tests/lint_fixtures/ first "
+            "(docs/static-analysis.md)"
+        )
+    old = {
+        (e["code"], e["path"], e["detail"]): e["justification"]
+        for e in old_entries
+    }
+    entries = []
+    for key in sorted({(f.code, f.path, f.detail) for f in report.findings}):
+        code, path, detail = key
+        entries.append({
+            "code": code, "path": path, "detail": detail,
+            "justification": old.get(
+                key, "UNJUSTIFIED — written by lint --update-baseline; "
+                     "replace with a real reason before shipping"
+            ),
+        })
+    doc = {
+        "_comment": "Contract-linter findings baseline "
+                    "(docs/static-analysis.md). Entries match findings on "
+                    "(code, path, detail) — deliberately not line numbers. "
+                    "Rewrite deterministically with `python -m "
+                    "gpuschedule_tpu lint --update-baseline`.",
+        "entries": entries,
+    }
+    baseline_path.parent.mkdir(parents=True, exist_ok=True)
+    baseline_path.write_text(
+        _json.dumps(doc, indent=2, sort_keys=False) + "\n"
+    )
+    dropped = len(old) - sum(
+        1 for e in entries
+        if (e["code"], e["path"], e["detail"]) in old
+    )
+    print(
+        f"baseline rewritten: {len(entries)} entr"
+        f"{'y' if len(entries) == 1 else 'ies'} "
+        f"({dropped} stale dropped) -> {baseline_path}"
+    )
+    return 0
+
+
 def cmd_lint(args) -> int:
-    """``lint``: the contract linter (ISSUE 13) — AST-enforced
+    """``lint``: the contract linter (ISSUE 13/14) — AST-enforced
     determinism / seed-stream / event-schema / config-hash / cache /
-    fork-safety invariants over this checkout.  Exit 0 when every
-    finding is fixed, pragma-allowed, or baselined; 1 otherwise.
-    Output is deterministic: the same tree and baseline produce
-    byte-identical JSON, so ``--json`` artifacts diff cleanly and
-    ``--history`` rows trend meaningfully."""
+    fork-safety / state-machine invariants over this checkout.  Exit 0
+    when every finding is fixed, pragma-allowed, or baselined; 1
+    otherwise.  Output is deterministic: the same tree and baseline
+    produce byte-identical JSON, so ``--json`` artifacts diff cleanly
+    and ``--history`` rows trend meaningfully."""
     from pathlib import Path
 
     from gpuschedule_tpu.lint import load_baseline, run_lint
@@ -1308,8 +1387,13 @@ def cmd_lint(args) -> int:
             baseline = load_baseline(baseline_path)
         except (ValueError, KeyError) as e:
             raise SystemExit(f"bad baseline {baseline_path}: {e}") from None
-    elif args.baseline:
+    elif args.baseline and not getattr(args, "update_baseline", False):
+        # --update-baseline is allowed to CREATE the file it points at;
+        # every other mode refuses a missing explicit baseline
         raise SystemExit(f"baseline not found: {args.baseline}")
+
+    if getattr(args, "update_baseline", False):
+        return _update_baseline(root, baseline_path, baseline or [])
 
     report = run_lint(root, baseline=baseline)
     if report.files_scanned == 0:
@@ -1328,7 +1412,8 @@ def cmd_lint(args) -> int:
             f"contract-lint: {len(report.findings)} finding(s), "
             f"{report.baselined} baselined, {report.allowed} allowed by "
             f"pragma, {report.files_scanned} files, "
-            f"{report.rules_run} rules — {'ok' if report.ok else 'FAIL'}"
+            f"{report.rules_run} rules / {report.rules} codes — "
+            f"{'ok' if report.ok else 'FAIL'}"
         )
     if args.history:
         from gpuschedule_tpu.obs import HistoryStore
@@ -1587,6 +1672,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                       help="emit the deterministic JSON report (bare flag: "
                            "stdout instead of the human rendering; with "
                            "PATH: write there, keep the human output)")
+    lint.add_argument("--update-baseline", action="store_true",
+                      help="rewrite ROOT/tools/lint_baseline.json (or "
+                           "--baseline) deterministically from the "
+                           "tree's current findings: sorted "
+                           "fingerprints, stale entries dropped, "
+                           "existing justifications kept; refuses "
+                           "findings for rule codes no fixture tree "
+                           "exercises")
     lint.add_argument("--history", metavar="STORE",
                       help="append the summary metrics to the sqlite "
                            "history store at STORE (kind 'lint') — "

@@ -546,6 +546,18 @@ _SPEC_KEYS = {
     "ckpt_write": ("recovery", "ckpt_write"),
 }
 
+# Config fields deliberately outside the per-key spec surface, each with
+# its one-line justification — the contract linter (GS404, per-key hash
+# coverage) refuses a FaultConfig/RecoveryModel field that neither a
+# _SPEC_KEYS row reaches nor this allowlist documents: only the spec
+# STRING rides the config hash, so an unreachable field would reshape
+# replays without ever changing the hash.
+_UNSPECCED = {
+    "domain_weights": "populated exclusively by the domain_host/"
+                      "domain_rack/domain_pod weight keys, which ride "
+                      "the spec string themselves",
+}
+
 
 def parse_fault_spec(spec: str):
     """Parse the CLI's ``--faults k=v,...`` spec into a

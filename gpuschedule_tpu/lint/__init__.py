@@ -1,9 +1,12 @@
-"""Contract linter (ISSUE 13): AST-enforced determinism, seed-stream,
-schema, config-hash, cache-discipline, and fork-safety invariants.
+"""Contract linter (ISSUE 13/14): AST-enforced determinism, seed-stream,
+schema, config-hash, cache-discipline, fork-safety, and state-machine
+invariants — a whole-program pass over the package's own ASTs, built on
+the shared symbol table / call graph in ``lint/symbols.py``.
 
 Entry points: ``run_lint(root)`` (Python), ``python -m gpuschedule_tpu
-lint`` (CLI), ``tools/contract_lint.py`` (CI gate).  Rule catalog and
-suppression workflow: docs/static-analysis.md.
+lint`` (CLI, ``--update-baseline`` rewrites the baseline), and
+``tools/contract_lint.py`` (CI gate with a wall-time budget).  Rule
+catalog and suppression workflow: docs/static-analysis.md.
 """
 
 from gpuschedule_tpu.lint.core import (
@@ -12,12 +15,14 @@ from gpuschedule_tpu.lint.core import (
     LintContext,
     LintReport,
     load_baseline,
+    registered_codes,
     run_lint,
 )
 from gpuschedule_tpu.lint.seed_registry import (
     SEED_STREAMS,
     SHARED_SEED_STREAMS,
 )
+from gpuschedule_tpu.lint.symbols import SymbolTable
 
 __all__ = [
     "Finding",
@@ -26,6 +31,8 @@ __all__ = [
     "LintReport",
     "SEED_STREAMS",
     "SHARED_SEED_STREAMS",
+    "SymbolTable",
     "load_baseline",
+    "registered_codes",
     "run_lint",
 ]

@@ -72,20 +72,64 @@ class LintConfig:
     the same layout (tests/lint_fixtures/)."""
 
     package: str = PACKAGE
+    # directories excluded from the package walk anywhere in the path:
+    # fixture trees (miniature checkouts used by the linter's own tests)
+    # must never be linted as product code when --root points at a tree
+    # that happens to nest them (ISSUE 14 satellite)
+    exclude_dirs: Tuple[str, ...] = ("tests", "lint_fixtures")
     # rule GS1xx: modules whose replay semantics must be deterministic
     determinism_dirs: Tuple[str, ...] = ("sim", "net", "faults", "cluster")
-    # rule GS3xx: the event emitter and its schema document
+    # rule GS3xx: the event emitters and their schema document.  Every
+    # path in emitter_paths is scanned for ``.event(...)`` calls — the
+    # engine is joined by the what-if and snapshot layers so a second
+    # emitter growing an event site is linted from day one (ISSUE 14)
     engine_path: str = f"{PACKAGE}/sim/engine.py"
+    emitter_paths: Tuple[str, ...] = (
+        f"{PACKAGE}/sim/engine.py",
+        f"{PACKAGE}/sim/whatif.py",
+        f"{PACKAGE}/sim/snapshot.py",
+    )
     events_doc_path: str = "docs/events.md"
     # rule GS4xx: the argparse definitions and the shared hash table;
     # every subparser variable that builds a hashed world is audited
     cli_path: str = f"{PACKAGE}/cli.py"
     worldspec_path: str = f"{PACKAGE}/worldspec.py"
     world_parser_receivers: Tuple[str, ...] = ("run", "wi")
+    # rule GS41x: per-key spec-table audit (ISSUE 14) — each row is
+    # (spec module, table name, ((target label, config module, config
+    # class), ...)).  A table whose values are plain attribute strings
+    # uses the single row with label "" ; a label mapping to ("", "")
+    # is exempt (it targets a dynamic bucket, not a dataclass field).
+    spec_tables: Tuple[
+        Tuple[str, str, Tuple[Tuple[str, str, str], ...]], ...
+    ] = (
+        (f"{PACKAGE}/faults/schedule.py", "_SPEC_KEYS", (
+            ("config", f"{PACKAGE}/faults/schedule.py", "FaultConfig"),
+            ("recovery", f"{PACKAGE}/faults/recovery.py", "RecoveryModel"),
+            ("weight", "", ""),
+        )),
+        (f"{PACKAGE}/net/model.py", "_SPEC_KEYS", (
+            ("", f"{PACKAGE}/net/model.py", "NetConfig"),
+        )),
+    )
     # rule GS2xx: the declared seed-stream registry (None = the repo's
     # own registry from gpuschedule_tpu/lint/seed_registry.py)
     seed_streams: Optional[Dict[str, str]] = None
     shared_seed_streams: Tuple[str, ...] = ()
+    # rule GS7xx: the analyzer's transition table and the engine's
+    # job-state vocabulary (ISSUE 14).  state_aliases maps engine
+    # JobState values onto the analyzer's state names; job_set_attrs
+    # gives the states of jobs iterated off the engine's membership
+    # containers (``self.running`` / ``self.pending``).
+    analyzer_path: str = f"{PACKAGE}/obs/analyze.py"
+    legal_from_name: str = "_LEGAL_FROM"
+    job_state_path: str = f"{PACKAGE}/sim/job.py"
+    job_state_class: str = "JobState"
+    state_aliases: Tuple[Tuple[str, str], ...] = (("pending", "queued"),)
+    job_set_attrs: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+        ("running", ("running",)),
+        ("pending", ("queued", "suspended")),
+    )
 
 
 class LintContext:
@@ -99,11 +143,17 @@ class LintContext:
         self._lines: Dict[str, List[str]] = {}
         self._trees: Dict[str, ast.AST] = {}
         self._comments: Dict[str, Dict[int, str]] = {}
+        self._symbols = None
         pkg = self.root / config.package
+        # exclusion applies to parts BELOW the package dir only: a
+        # fixture tree may itself live under a tests/ prefix, but a
+        # tests/ (or nested fixture) subtree inside the scanned package
+        # must never be linted as product code (ISSUE 14 satellite)
+        skip = set(config.exclude_dirs) | {"__pycache__"}
         self.py_files: List[str] = sorted(
             p.relative_to(self.root).as_posix()
             for p in pkg.rglob("*.py")
-            if "__pycache__" not in p.parts
+            if not skip.intersection(p.relative_to(pkg).parts)
         )
 
     def has(self, rel: str) -> bool:
@@ -123,6 +173,15 @@ class LintContext:
         if rel not in self._trees:
             self._trees[rel] = ast.parse(self.source(rel), filename=rel)
         return self._trees[rel]
+
+    def symbols(self):
+        """The package-wide symbol table (lint/symbols.py), built once
+        per context and shared by every whole-program rule."""
+        if self._symbols is None:
+            from gpuschedule_tpu.lint.symbols import SymbolTable
+
+            self._symbols = SymbolTable(self)
+        return self._symbols
 
     def comments(self, rel: str) -> Dict[int, str]:
         """line -> comment text, via the tokenizer — so pragma matching
@@ -144,13 +203,32 @@ class LintContext:
 
 Rule = Callable[[LintContext], List[Finding]]
 _RULES: List[Rule] = []  # lint: allow[GS601] populated once at rule-module import; every process re-imports identically
+_RULE_CODES: Dict[str, Tuple[str, ...]] = {}  # lint: allow[GS601] same import-time registry
 
 
-def rule(fn: Rule) -> Rule:
+def rule(fn: Optional[Rule] = None, *, codes: Tuple[str, ...] = ()):
     """Register a rule: a callable taking the context and returning
-    findings.  Registration order is irrelevant — findings are sorted."""
-    _RULES.append(fn)
-    return fn
+    findings.  Registration order is irrelevant — findings are sorted.
+    ``codes`` declares the GS codes the rule can produce; the union
+    across rules is the ``rules`` coverage count the history store
+    trends (ISSUE 14 satellite)."""
+    def register(f: Rule) -> Rule:
+        _RULES.append(f)
+        _RULE_CODES[f.__name__] = tuple(codes)
+        return f
+
+    if fn is not None:
+        return register(fn)
+    return register
+
+
+def registered_codes() -> Tuple[str, ...]:
+    """Every GS code the registered rules declare, sorted — the linter's
+    enforced-contract surface (plus the engine's own GS001/GS002)."""
+    out = {"GS001", "GS002"}
+    for codes in _RULE_CODES.values():
+        out.update(codes)
+    return tuple(sorted(out))
 
 
 # ---------------------------------------------------------------------- #
@@ -260,7 +338,12 @@ class LintReport:
     allowed: int = 0                   # pragma-suppressed
     files_scanned: int = 0
     rules_run: int = 0
+    rules: int = 0                     # distinct enforced GS codes
     codes: Dict[str, int] = field(default_factory=dict)
+    # wall-clock seconds per rule function, plus "total" — measurement,
+    # NOT part of the deterministic report (render_json excludes it);
+    # the CI gate (tools/contract_lint.py) prints and budgets it
+    timings: Dict[str, float] = field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -268,13 +351,16 @@ class LintReport:
 
     def summary_metrics(self) -> Dict[str, int]:
         """Flat numeric summary — the shape the PR-10 history store
-        ingests (``lint --history``)."""
+        ingests (``lint --history``).  ``rules`` counts the distinct GS
+        codes the registered rules enforce, so ``history trend`` shows
+        contract coverage growing across versions (ISSUE 14)."""
         out = {
             "findings": len(self.findings),
             "baselined": self.baselined,
             "allowed": self.allowed,
             "files_scanned": self.files_scanned,
             "rules_run": self.rules_run,
+            "rules": self.rules,
             "ok": int(self.ok),
         }
         for code, n in sorted(self.codes.items()):
@@ -289,6 +375,7 @@ class LintReport:
             "allowed": self.allowed,
             "files_scanned": self.files_scanned,
             "rules_run": self.rules_run,
+            "rules": self.rules,
             "codes": dict(sorted(self.codes.items())),
         }
 
@@ -305,6 +392,8 @@ def run_lint(
 ) -> LintReport:
     """Run every registered rule over the tree at ``root`` and fold the
     raw findings through pragma + baseline suppression."""
+    import time
+
     # rule modules self-register on import
     from gpuschedule_tpu.lint import (  # noqa: F401
         rules_cache,
@@ -313,12 +402,20 @@ def run_lint(
         rules_forksafety,
         rules_schema,
         rules_seeds,
+        rules_statemachine,
     )
 
     ctx = LintContext(Path(root), config or LintConfig())
     raw: List[Finding] = []
+    timings: Dict[str, float] = {}
+    t_all = time.perf_counter()
     for fn in _RULES:
+        t0 = time.perf_counter()
         raw.extend(fn(ctx))
+        timings[fn.__name__] = (
+            timings.get(fn.__name__, 0.0) + time.perf_counter() - t0
+        )
+    timings["total"] = time.perf_counter() - t_all
 
     entries = list(baseline or ())
     matched = [False] * len(entries)
@@ -359,5 +456,6 @@ def run_lint(
         codes[f.code] = codes.get(f.code, 0) + 1
     return LintReport(
         findings=kept, baselined=baselined, allowed=allowed,
-        files_scanned=len(ctx.py_files), rules_run=len(_RULES), codes=codes,
+        files_scanned=len(ctx.py_files), rules_run=len(_RULES),
+        rules=len(registered_codes()), codes=codes, timings=timings,
     )

@@ -1,4 +1,4 @@
-"""GS5xx — cache-discipline rules (ISSUE 13).
+"""GS5xx — cache-discipline rules (ISSUE 13, precision ISSUE 14).
 
 The PR-7/9/11 speed lattice is a web of caches whose correctness rests
 on two conventions with no runtime check:
@@ -6,18 +6,29 @@ on two conventions with no runtime check:
 - every cache exposed through the unified ``engine_cache_events``
   telemetry family (a ``cache_stats()`` method returning
   ``{cache: {outcome: counter}}``) must have LIVE counter sites — a
-  counter attribute that is never incremented anywhere reads as a
-  permanently-cold cache in the Engine-health panel (**GS501**), and a
-  declared cache name absent from ``docs/events.md`` is schema drift in
-  the ``cache`` record's documentation (**GS503**);
+  counter attribute that is never incremented reads as a permanently-
+  cold cache in the Engine-health panel (**GS501**), and a declared
+  cache name absent from ``docs/events.md`` is schema drift in the
+  ``cache`` record's documentation (**GS503**).  ISSUE 14: liveness is
+  CLASS-QUALIFIED through the symbol table — the counter expression's
+  owner class is resolved (``self.x`` -> the declaring class;
+  ``self._group_cache.reused`` -> the class ``_group_cache`` was
+  constructed with), and only increments attributable to that owner
+  (``self.x += 1`` in its methods, or ``p.x += 1`` through a parameter
+  annotated with the owner class) keep it alive — a same-named counter
+  in an unrelated class no longer masks a dead one.  An increment whose
+  owner cannot be resolved still counts for any owner (conservative:
+  unknown suppresses, never invents, a finding);
 - every derived cache on a snapshot-capable class must be shed in
   ``__getstate__`` or rebuilt in ``restored()`` (the ISSUE 11 snapshot
   contract: a resume never trusts pre-snapshot geometry).  The class
   declares its derived caches in a ``_DERIVED_CACHES`` tuple; this rule
   cross-checks the declaration against both hooks in BOTH directions
-  (**GS502**) — an undeclared shed is as much drift as an unshed
-  declaration, and a class that sheds state without any declaration is
-  flagged too.
+  (**GS502**).  ISSUE 14: NON-cache snapshot metadata handled in those
+  hooks (a schema stamp, a format version) is declared in a
+  ``_SNAPSHOT_META`` tuple instead of being misread as an undeclared
+  cache; a ``_SNAPSHOT_META`` entry no hook touches, or one that also
+  appears in ``_DERIVED_CACHES``, is flagged.
 """
 
 from __future__ import annotations
@@ -33,106 +44,165 @@ from gpuschedule_tpu.lint.core import (
     rule,
 )
 
-
-def _last_attr(node: ast.AST) -> Optional[str]:
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    if isinstance(node, ast.Name):
-        return node.id
-    return None
+# (defining path, class name) or None when unresolvable
+OwnerKey = Optional[Tuple[str, str]]
 
 
-def _counter_tokens_in_dict(d: ast.Dict) -> List[Tuple[str, str]]:
-    """(outcome, counter-attribute token) pairs from an
-    ``{"hit": self.x, ...}`` literal; non-constant counters yield no
+def _counter_owner(
+    node: ast.AST, path: str, cls: Optional[str], symbols
+) -> Tuple[OwnerKey, Optional[str]]:
+    """Resolve a counter expression to (owner class, attribute):
+    ``self.x`` -> the enclosing class; ``self.a.b`` -> the class
+    ``self.a`` was constructed with (symbol-table provenance);
+    ``name.b`` -> the annotated class of parameter/local ``name`` when
+    known.  Unresolvable owners return (None, attr)."""
+    if not isinstance(node, ast.Attribute):
+        if isinstance(node, ast.Name):
+            return None, node.id
+        return None, None
+    attr = node.attr
+    base = node.value
+    if isinstance(base, ast.Name):
+        if base.id == "self" and cls is not None:
+            return (path, cls), attr
+        return None, attr
+    if (
+        isinstance(base, ast.Attribute)
+        and isinstance(base.value, ast.Name)
+        and base.value.id == "self"
+        and cls is not None
+    ):
+        owner = symbols.class_attr_types.get((path, cls), {}).get(base.attr)
+        return owner, attr
+    return None, attr
+
+
+def _counter_tokens_in_dict(
+    d: ast.Dict, path: str, cls: Optional[str], symbols
+) -> List[Tuple[str, OwnerKey, str]]:
+    """(outcome, owner, counter attribute) triples from an
+    ``{"hit": self.x, ...}`` literal; non-attribute counters yield no
     token (computed expressions can't be increment-checked)."""
     out = []
     for k, v in zip(d.keys, d.values):
         outcome = const_str(k) if k is not None else None
-        token = _last_attr(v)
+        owner, token = _counter_owner(v, path, cls, symbols)
         if outcome and token:
-            out.append((outcome, token))
+            out.append((outcome, owner, token))
     return out
 
 
 def _declared_caches(
-    ctx: LintContext,
-) -> Dict[str, Tuple[str, int, List[Tuple[str, str]]]]:
-    """cache name -> (path, line, [(outcome, counter token)]) from every
-    ``cache_stats`` method in the package: dict-literal returns plus
-    ``stats["name"] = {...}`` subscript stores."""
-    caches: Dict[str, Tuple[str, int, List[Tuple[str, str]]]] = {}
-    for path in ctx.py_files:
-        for node in ast.walk(ctx.tree(path)):
-            if not isinstance(node, ast.FunctionDef):
-                continue
-            if node.name != "cache_stats":
-                continue
-            for sub in ast.walk(node):
-                pairs: Dict[str, ast.Dict] = {}
-                if isinstance(sub, ast.Return) and isinstance(
-                    sub.value, ast.Dict
+    ctx: LintContext, symbols
+) -> Dict[str, Tuple[str, int, List[Tuple[str, OwnerKey, str]]]]:
+    """cache name -> (path, line, [(outcome, owner, counter attr)]) from
+    every ``cache_stats`` method in the package: dict-literal returns
+    plus ``stats["name"] = {...}`` subscript stores."""
+    caches: Dict[str, Tuple[str, int, List[Tuple[str, OwnerKey, str]]]] = {}
+    for (path, cls, fname), node in sorted(
+        symbols.functions.items(),
+        key=lambda kv: (kv[0][0], kv[1].lineno),
+    ):
+        if fname != "cache_stats" or cls is None:
+            continue
+        for sub in ast.walk(node):
+            pairs: Dict[str, ast.Dict] = {}
+            if isinstance(sub, ast.Return) and isinstance(
+                sub.value, ast.Dict
+            ):
+                for k, v in zip(sub.value.keys, sub.value.values):
+                    name = const_str(k) if k is not None else None
+                    if name and isinstance(v, ast.Dict):
+                        pairs[name] = v
+            elif isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    if (
+                        isinstance(t, ast.Subscript)
+                        and isinstance(sub.value, ast.Dict)
+                    ):
+                        name = const_str(t.slice)
+                        if name:
+                            pairs[name] = sub.value
+                # out = {...} literal bodies inside cache_stats
+                if (
+                    len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Name)
+                    and isinstance(sub.value, ast.Dict)
                 ):
                     for k, v in zip(sub.value.keys, sub.value.values):
                         name = const_str(k) if k is not None else None
                         if name and isinstance(v, ast.Dict):
                             pairs[name] = v
-                elif isinstance(sub, ast.Assign):
-                    for t in sub.targets:
-                        if (
-                            isinstance(t, ast.Subscript)
-                            and isinstance(sub.value, ast.Dict)
-                        ):
-                            name = const_str(t.slice)
-                            if name:
-                                pairs[name] = sub.value
-                    # out = {...} literal bodies inside cache_stats
-                    if (
-                        len(sub.targets) == 1
-                        and isinstance(sub.targets[0], ast.Name)
-                        and isinstance(sub.value, ast.Dict)
-                    ):
-                        for k, v in zip(sub.value.keys, sub.value.values):
-                            name = const_str(k) if k is not None else None
-                            if name and isinstance(v, ast.Dict):
-                                pairs[name] = v
-                for name, d in pairs.items():
-                    caches.setdefault(
-                        name,
-                        (path, d.lineno, _counter_tokens_in_dict(d)),
-                    )
+            for name, d in pairs.items():
+                caches.setdefault(
+                    name,
+                    (path, d.lineno,
+                     _counter_tokens_in_dict(d, path, cls, symbols)),
+                )
     return caches
 
 
-def _incremented_attrs(ctx: LintContext) -> Set[str]:
-    """Every attribute/name that is the target of an augmented
-    assignment anywhere in the package."""
-    incs: Set[str] = set()
-    for path in ctx.py_files:
-        for node in ast.walk(ctx.tree(path)):
-            if isinstance(node, ast.AugAssign):
-                token = _last_attr(node.target)
-                if token:
-                    incs.add(token)
-    return incs
+def _incremented_attrs(
+    ctx: LintContext, symbols
+) -> Tuple[Set[Tuple[Tuple[str, str], str]], Set[str]]:
+    """(owner-resolved increments, owner-unknown increment attrs):
+    every augmented-assignment target in the package (pre-collected by
+    the symbol table), attributed to its owner class where resolvable."""
+    owned: Set[Tuple[Tuple[str, str], str]] = set()
+    bare: Set[str] = set()
+    for path, cls, fkey, target in symbols.aug_assigns:
+        if isinstance(target, ast.Name):
+            bare.add(target.id)
+            continue
+        if not isinstance(target, ast.Attribute):
+            continue
+        attr = target.attr
+        base = target.value
+        owner: OwnerKey = None
+        if isinstance(base, ast.Name):
+            if base.id == "self" and cls is not None:
+                owner = (path, cls)
+            elif fkey is not None:
+                owner = symbols.param_class(fkey, base.id)
+        elif (
+            isinstance(base, ast.Attribute)
+            and isinstance(base.value, ast.Name)
+            and base.value.id == "self"
+            and cls is not None
+        ):
+            owner = symbols.class_attr_types.get(
+                (path, cls), {}
+            ).get(base.attr)
+        if owner is not None:
+            owned.add((owner, attr))
+        else:
+            bare.add(attr)
+    return owned, bare
 
 
-@rule
+@rule(codes=("GS501", "GS503"))
 def cache_telemetry_liveness(ctx: LintContext) -> List[Finding]:
-    caches = _declared_caches(ctx)
+    symbols = ctx.symbols()
+    caches = _declared_caches(ctx, symbols)
     if not caches:
         return []
-    incremented = _incremented_attrs(ctx)
+    owned, bare = _incremented_attrs(ctx, symbols)
     out: List[Finding] = []
     for name in sorted(caches):
         path, line, counters = caches[name]
-        for outcome, token in counters:
-            if token not in incremented:
+        for outcome, owner, token in counters:
+            live = token in bare or (
+                owner is not None and (owner, token) in owned
+            )
+            if owner is None:
+                # unresolvable owner: fall back to any-owner increments
+                live = live or any(a == token for _, a in owned)
+            if not live:
                 out.append(Finding(
                     "GS501", path, line, 0,
                     f"cache '{name}' outcome '{outcome}' reads counter "
-                    f"'{token}' that is never incremented anywhere — "
-                    "dead telemetry",
+                    f"'{token}' that is never incremented on its owner "
+                    "class — dead telemetry",
                     f"{name}.{outcome}",
                 ))
     # GS503: every declared cache name must appear in docs/events.md
@@ -152,11 +222,13 @@ def cache_telemetry_liveness(ctx: LintContext) -> List[Finding]:
     return out
 
 
-def _class_derived_decl(cls: ast.ClassDef) -> Optional[Tuple[Set[str], int]]:
+def _class_tuple_decl(
+    cls: ast.ClassDef, decl_name: str
+) -> Optional[Tuple[Set[str], int]]:
     for node in cls.body:
         if isinstance(node, ast.Assign):
             for t in node.targets:
-                if isinstance(t, ast.Name) and t.id == "_DERIVED_CACHES":
+                if isinstance(t, ast.Name) and t.id == decl_name:
                     names: Set[str] = set()
                     if isinstance(node.value, (ast.Tuple, ast.List)):
                         for el in node.value.elts:
@@ -199,43 +271,67 @@ def _rebuilt_attrs(cls: ast.ClassDef) -> Set[str]:
     return attrs
 
 
-@rule
+@rule(codes=("GS502",))
 def derived_cache_snapshot_coverage(ctx: LintContext) -> List[Finding]:
+    symbols = ctx.symbols()
     out: List[Finding] = []
-    for path in ctx.py_files:
-        for node in ast.walk(ctx.tree(path)):
-            if not isinstance(node, ast.ClassDef):
-                continue
-            decl = _class_derived_decl(node)
-            shed = _shed_keys(node)
-            rebuilt = _rebuilt_attrs(node)
-            if decl is None:
-                if shed or rebuilt:
-                    out.append(Finding(
-                        "GS502", path, node.lineno, node.col_offset,
-                        f"class {node.name} sheds/rebuilds state in "
-                        "__getstate__/restored() but declares no "
-                        "_DERIVED_CACHES tuple — the snapshot contract "
-                        "is unauditable without the declaration",
-                        f"{node.name}:undeclared",
-                    ))
-                continue
-            declared, line = decl
-            for name in sorted(declared - (shed | rebuilt)):
+    for (path, _clsname), node in sorted(
+        symbols.classes.items(), key=lambda kv: (kv[0][0], kv[1].lineno)
+    ):
+        decl = _class_tuple_decl(node, "_DERIVED_CACHES")
+        meta = _class_tuple_decl(node, "_SNAPSHOT_META")
+        shed = _shed_keys(node)
+        rebuilt = _rebuilt_attrs(node)
+        touched = shed | rebuilt
+        meta_names = meta[0] if meta is not None else set()
+        if decl is None and meta is None:
+            if touched:
                 out.append(Finding(
-                    "GS502", path, line, 0,
-                    f"{node.name}._DERIVED_CACHES declares '{name}' but "
-                    "__getstate__ does not shed it and restored() does "
-                    "not rebuild it — a resume would trust pre-snapshot "
-                    "state",
-                    f"{node.name}:{name}:unshed",
+                    "GS502", path, node.lineno, node.col_offset,
+                    f"class {node.name} sheds/rebuilds state in "
+                    "__getstate__/restored() but declares neither "
+                    "_DERIVED_CACHES nor _SNAPSHOT_META — the "
+                    "snapshot contract is unauditable without a "
+                    "declaration",
+                    f"{node.name}:undeclared",
                 ))
-            for name in sorted((shed | rebuilt) - declared):
-                out.append(Finding(
-                    "GS502", path, line, 0,
-                    f"{node.name} sheds/rebuilds '{name}' without "
-                    "declaring it in _DERIVED_CACHES — declare it so the "
-                    "snapshot contract stays auditable",
-                    f"{node.name}:{name}:undeclared",
-                ))
+            continue
+        declared, line = decl if decl is not None else (set(), 0)
+        if meta is not None and line == 0:
+            line = meta[1]
+        for name in sorted(declared & meta_names):
+            out.append(Finding(
+                "GS502", path, line, 0,
+                f"{node.name} declares '{name}' in BOTH "
+                "_DERIVED_CACHES and _SNAPSHOT_META — it is either "
+                "a rebuildable cache or snapshot metadata, not both",
+                f"{node.name}:{name}:dual-declared",
+            ))
+        for name in sorted(declared - touched):
+            out.append(Finding(
+                "GS502", path, line, 0,
+                f"{node.name}._DERIVED_CACHES declares '{name}' but "
+                "__getstate__ does not shed it and restored() does "
+                "not rebuild it — a resume would trust pre-snapshot "
+                "state",
+                f"{node.name}:{name}:unshed",
+            ))
+        for name in sorted(meta_names - touched):
+            out.append(Finding(
+                "GS502", path, line, 0,
+                f"{node.name}._SNAPSHOT_META declares '{name}' but "
+                "neither __getstate__ nor restored() touches it — "
+                "stale metadata declaration",
+                f"{node.name}:{name}:meta-stale",
+            ))
+        for name in sorted(touched - declared - meta_names):
+            out.append(Finding(
+                "GS502", path, line, 0,
+                f"{node.name} sheds/rebuilds '{name}' without "
+                "declaring it in _DERIVED_CACHES (a rebuildable "
+                "cache) or _SNAPSHOT_META (non-cache snapshot "
+                "metadata) — declare it so the snapshot contract "
+                "stays auditable",
+                f"{node.name}:{name}:undeclared",
+            ))
     return out

@@ -16,6 +16,23 @@ way).  Every argparse dest defined in ``_add_world_args`` or on the
   allowlist it with a justification);
 - **GS402** table row naming a flag the CLI no longer defines (stale);
 - **GS403** ``UNHASHED`` row with an empty/missing justification.
+
+Per-key spec coverage (ISSUE 14): the ``--faults``/``--net`` spec
+STRINGS ride the hash, but the string can only express what a
+``_SPEC_KEYS`` row reaches — a field added to ``FaultConfig`` /
+``RecoveryModel`` / ``NetConfig`` with no spec key silently escapes the
+hashed surface (its default can reshape every replay while two runs
+keep one hash).  ``LintConfig.spec_tables`` names each spec table and
+the config classes its rows target:
+
+- **GS404** a config-class field no ``_SPEC_KEYS`` row reaches and the
+  module's ``_UNSPECCED`` dict (field -> one-line justification) does
+  not allowlist;
+- **GS405** a ``_SPEC_KEYS`` row targeting an attribute that is not a
+  declared field of its config class (a typo ``setattr`` would create
+  silently at runtime);
+- **GS406** an ``_UNSPECCED`` row that is stale (field covered by a
+  spec key, or nonexistent) or carries no justification.
 """
 
 from __future__ import annotations
@@ -109,7 +126,7 @@ def _table_literals(
     return hashed, armed, unhashed, lines
 
 
-@rule
+@rule(codes=("GS401", "GS402", "GS403"))
 def config_hash_coverage(ctx: LintContext) -> List[Finding]:
     cfg = ctx.config
     if not ctx.has(cfg.cli_path) or not ctx.has(cfg.worldspec_path):
@@ -157,4 +174,177 @@ def config_hash_coverage(ctx: LintContext) -> List[Finding]:
                 "deliberately-unhashed knob documents why",
                 name,
             ))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# per-key spec coverage (ISSUE 14)
+
+
+def _spec_rows(
+    tree: ast.AST, table_name: str
+) -> Optional[Dict[str, Tuple[str, str, int]]]:
+    """spec key -> (target label, target attr, line) from the module's
+    ``_SPEC_KEYS`` literal.  Plain-string values use label ""."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == table_name
+            for t in node.targets
+        ):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return None
+        rows: Dict[str, Tuple[str, str, int]] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            key = const_str(k) if k is not None else None
+            if key is None:
+                continue
+            attr = const_str(v)
+            if attr is not None:
+                rows[key] = ("", attr, k.lineno)
+            elif isinstance(v, (ast.Tuple, ast.List)) and len(v.elts) == 2:
+                label, attr = const_str(v.elts[0]), const_str(v.elts[1])
+                if label is not None and attr is not None:
+                    rows[key] = (label, attr, k.lineno)
+        return rows
+    return None
+
+
+def _dataclass_fields(tree: ast.AST, class_name: str) -> Optional[Dict[str, int]]:
+    """field -> line for a config dataclass's declared fields (class-body
+    ``name: ann [= default]`` statements; methods/underscored ignored)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            fields: Dict[str, int] = {}
+            for sub in node.body:
+                if isinstance(sub, ast.AnnAssign) and isinstance(
+                    sub.target, ast.Name
+                ):
+                    if not sub.target.id.startswith("_"):
+                        fields[sub.target.id] = sub.lineno
+            return fields
+    return None
+
+
+def _unspecced(tree: ast.AST) -> Tuple[Dict[str, Optional[str]], Dict[str, int]]:
+    """The module's ``_UNSPECCED`` allowlist (field -> reason, + lines)."""
+    out: Dict[str, Optional[str]] = {}
+    lines: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if (
+                isinstance(t, ast.Name) and t.id == "_UNSPECCED"
+                and isinstance(node.value, ast.Dict)
+            ):
+                for k, v in zip(node.value.keys, node.value.values):
+                    s = const_str(k) if k is not None else None
+                    if s is not None:
+                        out[s] = const_str(v)
+                        lines[s] = k.lineno
+    return out, lines
+
+
+@rule(codes=("GS404", "GS405", "GS406"))
+def spec_key_hash_coverage(ctx: LintContext) -> List[Finding]:
+    out: List[Finding] = []
+    for spec_path, table_name, targets in ctx.config.spec_tables:
+        if not ctx.has(spec_path):
+            continue
+        spec_tree = ctx.tree(spec_path)
+        rows = _spec_rows(spec_tree, table_name)
+        if rows is None:
+            continue
+        allow, allow_lines = _unspecced(spec_tree)
+
+        # label -> (class path, class name, its fields) — "" class paths
+        # mark exempt dynamic buckets (the domain-weight dict)
+        classes: Dict[str, Optional[Tuple[str, str, Dict[str, int]]]] = {}
+        for label, cls_path, cls_name in targets:
+            if not cls_path:
+                classes[label] = None
+                continue
+            if not ctx.has(cls_path):
+                continue
+            fields = _dataclass_fields(ctx.tree(cls_path), cls_name)
+            if fields is not None:
+                classes[label] = (cls_path, cls_name, fields)
+
+        covered: Dict[str, Set[str]] = {}  # label -> reached attrs
+        for key in sorted(rows):
+            label, attr, line = rows[key]
+            if label not in classes:
+                continue  # unknown bucket: a fixture subset, skip
+            target = classes[label]
+            if target is None:
+                continue  # exempt dynamic bucket
+            cls_path, cls_name, fields = target
+            covered.setdefault(label, set()).add(attr)
+            if attr not in fields:
+                out.append(Finding(
+                    "GS405", spec_path, line, 0,
+                    f"{table_name} row '{key}' targets {cls_name}.{attr} "
+                    "which is not a declared field — a runtime setattr "
+                    "would create it silently (stale row or typo)",
+                    f"{key}->{cls_name}.{attr}",
+                ))
+
+        for label in sorted(classes):
+            target = classes[label]
+            if target is None:
+                continue
+            cls_path, cls_name, fields = target
+            reached = covered.get(label, set())
+            for attr in sorted(fields):
+                if attr in reached:
+                    continue
+                if attr in allow:
+                    continue
+                out.append(Finding(
+                    "GS404", cls_path, fields[attr], 0,
+                    f"{cls_name}.{attr} is reachable by no {table_name} "
+                    f"key in {spec_path} and not allowlisted in "
+                    "_UNSPECCED — only the spec STRING rides the config "
+                    "hash, so this field escapes the hashed surface",
+                    f"{cls_name}.{attr}",
+                ))
+
+        # which labels declare each field name — same-named fields on
+        # two audited classes stay distinguishable: an allowlist row is
+        # stale only when EVERY declaring class has the field reached
+        declaring: Dict[str, List[str]] = {}
+        for label, target in classes.items():
+            if target is not None:
+                for attr in target[2]:
+                    declaring.setdefault(attr, []).append(label)
+        for name in sorted(allow):
+            reason = allow[name]
+            line = allow_lines.get(name, 0)
+            if not reason or not reason.strip():
+                out.append(Finding(
+                    "GS406", spec_path, line, 0,
+                    f"_UNSPECCED row '{name}' has no justification — "
+                    "every field deliberately outside the spec surface "
+                    "documents why",
+                    f"{name}:unjustified",
+                ))
+            labels = declaring.get(name)
+            if labels is None:
+                out.append(Finding(
+                    "GS406", spec_path, line, 0,
+                    f"_UNSPECCED row '{name}' names no declared field of "
+                    "the audited config classes — remove the stale row",
+                    f"{name}:stale",
+                ))
+            elif all(name in covered.get(lb, set()) for lb in labels):
+                out.append(Finding(
+                    "GS406", spec_path, line, 0,
+                    f"_UNSPECCED row '{name}' is stale: a {table_name} "
+                    "key now reaches that field on every declaring "
+                    "class — remove the allowlist row",
+                    f"{name}:stale",
+                ))
     return out

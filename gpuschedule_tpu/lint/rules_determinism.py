@@ -18,6 +18,12 @@ function of its config.  Inside the replay-semantics modules
   processes (PYTHONHASHSEED), so iterating one to emit events or order
   flows is a fork/worker-dependent replay.  Wrap in ``sorted(...)`` or
   keep an ordered structure.
+
+  ISSUE 14: detection is whole-program via the package symbol table
+  (lint/symbols.py) — a set built in ``cluster/base.py`` and iterated
+  in ``sim/engine.py`` resolves through from-imports, set-returning
+  functions/methods, and class-attribute provenance, not just local
+  bindings of the iterating function.
 """
 
 from __future__ import annotations
@@ -64,7 +70,7 @@ def _rng_violation(name: str) -> bool:
     return False
 
 
-@rule
+@rule(codes=("GS101", "GS102"))
 def wallclock_and_module_rng(ctx: LintContext) -> List[Finding]:
     out: List[Finding] = []
     for path in _target_files(ctx):
@@ -124,59 +130,71 @@ def _dedup_chain(findings: List[Finding]) -> List[Finding]:
     return out
 
 
-def _is_setish(node: ast.AST) -> bool:
-    if isinstance(node, (ast.Set, ast.SetComp)):
-        return True
-    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
-        return node.func.id in ("set", "frozenset")
-    return False
+def _iter_label(it: ast.AST) -> str:
+    """Stable fingerprint for the iterated expression."""
+    if isinstance(it, ast.Name):
+        return it.id
+    if isinstance(it, ast.Attribute) and isinstance(it.value, ast.Name):
+        return f"{it.value.id}.{it.attr}"
+    if isinstance(it, ast.Call):
+        f = it.func
+        if isinstance(f, ast.Name):
+            return f"{f.id}()"
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            return f"{f.value.id}.{f.attr}()"
+        return "call()"
+    return "set-literal"
 
 
 class _SetIterVisitor(ast.NodeVisitor):
-    """Per-function tracking: names locally bound to set expressions,
-    plus ``self.<attr>`` names bound to sets anywhere in the enclosing
-    class.  Iterating either (outside ``sorted(...)``) is a finding."""
+    """Per-function tracking: names locally bound (or provably NOT
+    bound) to sets, layered over the package symbol table's
+    whole-program provenance — module-level sets reached through
+    from-imports, set-returning functions/methods, and class-attribute
+    assignment (ISSUE 14).  Iterating any provable set outside
+    ``sorted(...)`` is a finding."""
 
-    def __init__(self, path: str, class_set_attrs: Set[str]):
+    def __init__(self, path: str, cls: Optional[str], symbols,
+                 nonsets: Optional[Set[str]] = None):
         self.path = path
-        self.class_set_attrs = class_set_attrs
+        self.cls = cls
+        self.symbols = symbols
         self.local_sets: Set[str] = set()
+        # params / loop / with / comprehension targets pre-seed as
+        # NON-sets: a binding shadowing a module-level set must never be
+        # misread as it (assignments below may still flip it to a set)
+        self.local_nonsets: Set[str] = set(nonsets or ())
         self.findings: List[Finding] = []
 
-    def visit_Assign(self, node: ast.Assign) -> None:
-        if _is_setish(node.value):
-            for t in node.targets:
-                if isinstance(t, ast.Name):
-                    self.local_sets.add(t.id)
+    def _is_setish(self, node: ast.AST) -> bool:
+        return self.symbols.expr_is_set(
+            self.path, self.cls, node, self.local_sets, self.local_nonsets
+        )
+
+    def _bind(self, name: str, is_set: bool) -> None:
+        if is_set:
+            self.local_sets.add(name)
+            self.local_nonsets.discard(name)
         else:
-            for t in node.targets:
-                if isinstance(t, ast.Name):
-                    self.local_sets.discard(t.id)
+            self.local_nonsets.add(name)
+            self.local_sets.discard(name)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        is_set = self._is_setish(node.value)
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                self._bind(t.id, is_set)
         self.generic_visit(node)
 
     def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
         # annotated bindings (`s: Set[int] = set()`) track the same way
         if isinstance(node.target, ast.Name) and node.value is not None:
-            if _is_setish(node.value):
-                self.local_sets.add(node.target.id)
-            else:
-                self.local_sets.discard(node.target.id)
+            self._bind(node.target.id, self._is_setish(node.value))
         self.generic_visit(node)
 
     def _check_iter(self, it: ast.AST) -> None:
-        bad: Optional[str] = None
-        if _is_setish(it):
-            bad = "set-literal"
-        elif isinstance(it, ast.Name) and it.id in self.local_sets:
-            bad = it.id
-        elif (
-            isinstance(it, ast.Attribute)
-            and isinstance(it.value, ast.Name)
-            and it.value.id == "self"
-            and it.attr in self.class_set_attrs
-        ):
-            bad = f"self.{it.attr}"
-        if bad is not None:
+        if self._is_setish(it):
+            bad = _iter_label(it)
             self.findings.append(Finding(
                 "GS103", self.path, it.lineno, it.col_offset,
                 f"iteration over bare set `{bad}`: set order is "
@@ -198,51 +216,33 @@ class _SetIterVisitor(ast.NodeVisitor):
     visit_DictComp = _visit_comp
 
 
-def _class_set_attrs(cls: ast.ClassDef) -> Set[str]:
-    attrs: Set[str] = set()
-    for node in ast.walk(cls):
-        targets: list = []
-        if isinstance(node, ast.Assign) and _is_setish(node.value):
-            targets = node.targets
-        elif (
-            isinstance(node, ast.AnnAssign)
-            and node.value is not None
-            and _is_setish(node.value)
-        ):
-            targets = [node.target]
-        for t in targets:
-            if (
-                isinstance(t, ast.Attribute)
-                and isinstance(t.value, ast.Name)
-                and t.value.id == "self"
-            ):
-                attrs.add(t.attr)
-    return attrs
-
-
-@rule
+@rule(codes=("GS103",))
 def bare_set_iteration(ctx: LintContext) -> List[Finding]:
+    from gpuschedule_tpu.lint.symbols import bound_names
+
+    symbols = ctx.symbols()
     out: List[Finding] = []
     for path in _target_files(ctx):
         tree = ctx.tree(path)
 
-        def scan(node: ast.AST, attrs: Set[str]) -> None:
+        def scan(node: ast.AST, cls: Optional[str]) -> None:
             # generic descent (if/try/with wrappers included) swapping
-            # the self-attr set at class boundaries and visiting each
+            # the enclosing class at class boundaries and visiting each
             # function body once at its outermost def (nested defs are
             # walked by the visitor itself)
             for child in ast.iter_child_nodes(node):
                 if isinstance(child, ast.ClassDef):
-                    scan(child, _class_set_attrs(child))
+                    scan(child, child.name)
                 elif isinstance(
                     child, (ast.FunctionDef, ast.AsyncFunctionDef)
                 ):
-                    v = _SetIterVisitor(path, attrs)
+                    v = _SetIterVisitor(path, cls, symbols,
+                                        nonsets=bound_names(child))
                     for stmt in child.body:
                         v.visit(stmt)
                     out.extend(v.findings)
                 else:
-                    scan(child, attrs)
+                    scan(child, cls)
 
-        scan(tree, set())
+        scan(tree, None)
     return out

@@ -77,12 +77,14 @@ def _module_mutables(tree: ast.Module) -> Dict[str, Tuple[int, int, bool]]:
     return out
 
 
-def _local_bindings(fn) -> Set[str]:
-    """Names bound locally in ``fn`` (params, plain assigns, loop/with
-    targets, comprehension targets) — mutations of these are not module
-    state.  Nested functions' locals fold in (an over-approximation
-    that only ever suppresses, never invents, a finding)."""
+def _locals_and_globals(fn) -> Tuple[Set[str], Set[str]]:
+    """One walk: names bound locally in ``fn`` (params, plain assigns,
+    loop/with targets, comprehension targets — mutations of these are
+    not module state; nested functions' locals fold in, an
+    over-approximation that only ever suppresses, never invents, a
+    finding) plus its ``global`` declarations."""
     local: Set[str] = set()
+    declared_global: Set[str] = set()
     a = fn.args
     for arg in (*a.posonlyargs, *a.args, *a.kwonlyargs, a.vararg, a.kwarg):
         if arg is not None:
@@ -112,7 +114,9 @@ def _local_bindings(fn) -> Set[str]:
             bind(node.target)
         elif isinstance(node, ast.ExceptHandler) and node.name:
             local.add(node.name)
-    return local
+        elif isinstance(node, ast.Global):
+            declared_global.update(node.names)
+    return local, declared_global
 
 
 def _qualified_target(
@@ -154,11 +158,8 @@ def _runtime_mutations(
     for fn in ast.walk(tree):
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
-        declared_global: Set[str] = set()
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Global):
-                declared_global.update(node.names)
-        local = _local_bindings(fn) - declared_global
+        local, declared_global = _locals_and_globals(fn)
+        local -= declared_global
 
         def module_name(base) -> Optional[str]:
             if isinstance(base, ast.Name) and (
@@ -226,19 +227,17 @@ def _runtime_mutations(
     return rebinds, container, qualified
 
 
-def _module_dotted(path: str) -> str:
-    """gpuschedule_tpu/sim/whatif.py -> gpuschedule_tpu.sim.whatif"""
-    return path[:-3].replace("/__init__", "").replace("/", ".")
-
-
-@rule
+@rule(codes=("GS601",))
 def module_level_mutable_state(ctx: LintContext) -> List[Finding]:
+    from gpuschedule_tpu.lint.symbols import module_dotted
+
+    symbols = ctx.symbols()
     out: List[Finding] = []
-    # pass 1: each module's own candidates and mutation sites; collect
-    # from-imports RESOLVED to their source module (absolute dotted, or
-    # relative against the importing file's package), so an unrelated
-    # module that happens to define a same-named table is never blamed
-    # for a sibling's mutation
+    # pass 1: each module's own candidates and mutation sites; the
+    # symbol table resolves from-imports to their source module
+    # (absolute dotted, or relative against the importing file's
+    # package), so an unrelated module that happens to define a
+    # same-named table is never blamed for a sibling's mutation
     candidates: Dict[str, Dict[str, Tuple[int, int, bool]]] = {}
     rebinds: Dict[str, Set[str]] = {}
     container: Dict[str, Set[str]] = {}
@@ -250,30 +249,13 @@ def module_level_mutable_state(ctx: LintContext) -> List[Finding]:
         rebinds[path], container[path], qualified[path] = (
             _runtime_mutations(tree)
         )
-        # relative imports resolve against the containing package — for
-        # an __init__.py that is the module's own dotted path
-        if path.endswith("/__init__.py"):
-            package = _module_dotted(path)
-        else:
-            package = _module_dotted(path).rsplit(".", 1)[0]
-        pairs: Set[Tuple[str, str]] = set()
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.ImportFrom):
-                continue
-            if node.level == 0:
-                resolved = node.module or ""
-            else:
-                parts = package.split(".")
-                parts = parts[: len(parts) - (node.level - 1)]
-                if node.module:
-                    parts.append(node.module)
-                resolved = ".".join(parts)
-            for a in node.names:
-                pairs.add((resolved, a.asname or a.name))
-        imports[path] = pairs
+        imports[path] = {
+            (mod, local)
+            for local, (mod, _sym) in symbols.from_imports[path].items()
+        }
 
     for path in ctx.py_files:
-        dotted = _module_dotted(path)
+        dotted = module_dotted(path)
         for name, (line, col, _sentinel) in sorted(
             candidates[path].items()
         ):

@@ -1,26 +1,39 @@
-"""GS3xx — event-schema drift rules (ISSUE 13).
+"""GS3xx — event-schema drift rules (ISSUE 13, precision ISSUE 14).
 
 ``docs/events.md`` is the contract the analytics layer, the Perfetto
 exporter, and every external consumer of the event stream read against;
-``sim/engine.py`` is the only writer.  Schema v1 is additive-only, so
-drift has exactly two shapes, both statically detectable:
+the emitters are the modules listed in ``LintConfig.emitter_paths``
+(``sim/engine.py`` today joined by ``sim/whatif.py`` and
+``sim/snapshot.py`` — a second emitter growing an event site is linted
+from day one).  Schema v1 is additive-only, so drift has these shapes,
+all statically detectable:
 
-- **GS301** the engine emits an event kind the document doesn't list
+- **GS301** an emitter emits an event kind the document doesn't list
   (an undocumented record every reader must guess at);
-- **GS302** the document lists a kind the engine never emits (dead
+- **GS302** the document lists a kind no emitter ever emits (dead
   documentation that readers build against);
-- **GS303** the engine emits a payload key that appears nowhere in the
-  document (an undocumented field).
+- **GS303** an emitted payload key absent from ITS KIND's payload cell
+  in the document — per-kind, not document-wide (ISSUE 14): a key
+  documented for ``start`` no longer covers the same key smuggled onto
+  ``finish``;
+- **GS304** a payload key documented in a kind's cell that no emit
+  site for that kind produces — dead per-kind documentation.  Only
+  enforced for kinds whose every emit site is fully resolvable (a
+  ``**dynamic`` splat the resolver cannot see suppresses the check for
+  that kind, never invents a finding), and only for cell tokens that
+  are live payload keys of SOME kind — prose tokens, outcome enums,
+  and cache names inside a cell never false-positive.
 
 Extraction: every ``*.event("<kind>", t, job, key=..., **extra)`` call
-in the engine — explicit keywords plus the keys of any local ``extra``
-dict the call splats (dict literals and ``extra["k"] = ...`` stores in
-the enclosing function are resolved; opaque splats like
-``**cluster.sample_state()`` contribute nothing, which is safe because
-GS303 only checks the *extracted* keys).  The document side parses the
-markdown tables whose header column is ``kind``; payload keys match
-against every backticked token in the document (tables and prose — the
-shared ``slow_factor``/``why``/``blame`` semantics live in prose).
+in an emitter — explicit keywords plus the keys of any local ``extra``
+dict the call splats (dict literals, ``extra["k"] = ...`` stores, and
+``extra.update({...})`` literal merges in the enclosing function are
+resolved; opaque splats like ``**cluster.sample_state()`` or
+``.update(param)`` mark the site opaque).  The document side parses the
+markdown tables whose header column is ``kind``: a kind's documented
+payload keys are the backticked tokens of its OWN row (payload +
+transition cells), so shared keys (``slow_factor``, ``blame``,
+``cause``) must be named in every row that carries them.
 """
 
 from __future__ import annotations
@@ -38,12 +51,12 @@ from gpuschedule_tpu.lint.core import (
 )
 
 
-def _doc_kinds(text: str) -> Set[str]:
-    """The documented event kinds: first-column backtick tokens of every
-    markdown table whose header's first column is ``kind``.  (Payload
-    keys match against the whole document's tokens, not per-row — the
-    shared ``slow_factor``/``why``/``blame`` semantics live in prose.)"""
-    kinds: Set[str] = set()
+def _doc_kind_rows(text: str) -> Dict[str, Set[str]]:
+    """kind -> backticked tokens of that kind's table row(s), from every
+    markdown table whose header's first column is ``kind``.  All cells
+    after the first are read — payload keys occasionally live in a
+    transition/notes column (``prog`` when ``saved``)."""
+    rows: Dict[str, Set[str]] = {}
     in_table = False
     for line in text.splitlines():
         stripped = line.strip()
@@ -62,19 +75,22 @@ def _doc_kinds(text: str) -> Set[str]:
             continue
         m = re.fullmatch(r"`([^`]+)`", cells[0])
         if m:
-            kinds.add(m.group(1))
+            tokens = rows.setdefault(m.group(1), set())
+            for cell in cells[1:]:
+                tokens |= backtick_tokens(cell)
         else:
             # a non-backticked first cell is a different table's header
             # (e.g. `| cache | count |` adjacent with no blank line) —
             # stop collecting so its rows aren't read as event kinds
             in_table = False
-    return kinds
+    return rows
 
 
 class _ExtraResolver(ast.NodeVisitor):
     """Collect, per function, the constant keys flowing into each local
-    name that is later ``**``-splatted: dict-literal assignments and
-    ``name["key"] = ...`` subscript stores."""
+    name that is later ``**``-splatted: dict-literal assignments,
+    ``name["key"] = ...`` subscript stores, and ``name.update({...})``
+    literal merges.  Anything dynamic marks the name opaque."""
 
     def __init__(self) -> None:
         self.keys: Dict[str, Set[str]] = {}
@@ -107,11 +123,29 @@ class _ExtraResolver(ast.NodeVisitor):
                     self.keys.setdefault(t.value.id, set()).add(key)
         self.generic_visit(node)
 
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr == "update"
+            and isinstance(f.value, ast.Name)
+        ):
+            name = f.value.id
+            if len(node.args) == 1 and isinstance(node.args[0], ast.Dict):
+                self._add_dict(name, node.args[0])
+            elif node.args or node.keywords:
+                self.opaque.add(name)
+        self.generic_visit(node)
 
-def _emitted(tree: ast.AST) -> Dict[str, List[Tuple[int, int, Set[str]]]]:
-    """kind -> [(line, col, payload keys)] for every ``.event("kind",
-    ...)`` call, with local ``extra`` splats resolved per function."""
-    out: Dict[str, List[Tuple[int, int, Set[str]]]] = {}
+
+def _emitted(
+    tree: ast.AST,
+) -> Dict[str, List[Tuple[int, int, Set[str], bool]]]:
+    """kind -> [(line, col, payload keys, opaque)] for every
+    ``.event("kind", ...)`` call, with local ``extra`` splats resolved
+    per function.  ``opaque`` marks sites whose full key set is
+    unknowable statically (a non-literal splat)."""
+    out: Dict[str, List[Tuple[int, int, Set[str], bool]]] = {}
     funcs: List[ast.AST] = [
         n for n in ast.walk(tree)
         if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
@@ -131,35 +165,55 @@ def _emitted(tree: ast.AST) -> Dict[str, List[Tuple[int, int, Set[str]]]]:
             if kind is None:
                 continue
             keys: Set[str] = set()
+            opaque = False
             for kw in node.keywords:
                 if kw.arg is not None:
                     keys.add(kw.arg)
                 elif isinstance(kw.value, ast.Name):
                     name = kw.value.id
                     keys |= resolver.keys.get(name, set())
-                # non-Name splats (**obj.method()) are opaque: skip
+                    if name in resolver.opaque or name not in resolver.keys:
+                        # a splatted name the resolver never saw bound
+                        # (a function parameter, an outer-scope dict) is
+                        # opaque — NOT an empty key set, or GS304 would
+                        # invent dead-documentation findings
+                        opaque = True
+                else:
+                    # non-Name splats (**obj.method()) are opaque
+                    opaque = True
             out.setdefault(kind, []).append(
-                (node.lineno, node.col_offset, keys)
+                (node.lineno, node.col_offset, keys, opaque)
             )
     return out
 
 
-@rule
+@rule(codes=("GS301", "GS302", "GS303", "GS304"))
 def event_schema_drift(ctx: LintContext) -> List[Finding]:
     cfg = ctx.config
-    if not ctx.has(cfg.engine_path) or not ctx.has(cfg.events_doc_path):
+    emitters = [p for p in cfg.emitter_paths if ctx.has(p)]
+    if not emitters and ctx.has(cfg.engine_path):
+        emitters = [cfg.engine_path]
+    if not emitters or not ctx.has(cfg.events_doc_path):
         return []
     doc_text = ctx.source(cfg.events_doc_path)
-    doc_kinds = _doc_kinds(doc_text)
-    doc_tokens = backtick_tokens(doc_text)
-    emitted = _emitted(ctx.tree(cfg.engine_path))
+    doc_rows = _doc_kind_rows(doc_text)
+    doc_kinds = set(doc_rows)
+
+    # kind -> [(path, line, col, keys, opaque)] across all emitters
+    emitted: Dict[str, List[Tuple[str, int, int, Set[str], bool]]] = {}
+    for path in emitters:
+        for kind, sites in _emitted(ctx.tree(path)).items():
+            emitted.setdefault(kind, []).extend(
+                (path, line, col, keys, opaque)
+                for line, col, keys, opaque in sites
+            )
 
     out: List[Finding] = []
     for kind in sorted(emitted):
-        line, col, _ = emitted[kind][0]
+        path, line, col, _, _ = emitted[kind][0]
         if kind not in doc_kinds:
             out.append(Finding(
-                "GS301", cfg.engine_path, line, col,
+                "GS301", path, line, col,
                 f"engine emits event kind '{kind}' that "
                 f"{cfg.events_doc_path} does not document",
                 f"kind:{kind}",
@@ -169,20 +223,53 @@ def event_schema_drift(ctx: LintContext) -> List[Finding]:
             out.append(Finding(
                 "GS302", cfg.events_doc_path, 0, 0,
                 f"{cfg.events_doc_path} documents event kind '{kind}' "
-                f"that {cfg.engine_path} never emits",
+                f"that no emitter ({', '.join(emitters)}) ever emits",
                 f"kind:{kind}",
             ))
+    # every key any emitter produces for any kind — the schema's live
+    # payload-key vocabulary.  GS304 checks documented cell tokens
+    # against it, so prose tokens, outcome enums, and cache names in a
+    # cell can never false-positive as "dead keys".
+    live_keys: Set[str] = set()
+    for sites in emitted.values():
+        for _, _, _, keys, _ in sites:
+            live_keys |= keys
+
     seen: Set[Tuple[str, str]] = set()
     for kind in sorted(emitted):
-        for line, col, keys in emitted[kind]:
+        cell = doc_rows.get(kind)
+        if cell is None:
+            continue  # the whole kind is already a GS301
+        for path, line, col, keys, _opaque in emitted[kind]:
             for key in sorted(keys):
-                if key in doc_tokens or (kind, key) in seen:
+                if key in cell or (kind, key) in seen:
                     continue
                 seen.add((kind, key))
                 out.append(Finding(
-                    "GS303", cfg.engine_path, line, col,
-                    f"event '{kind}' payload key '{key}' appears nowhere "
-                    f"in {cfg.events_doc_path}",
+                    "GS303", path, line, col,
+                    f"event '{kind}' payload key '{key}' is not in the "
+                    f"'{kind}' row of {cfg.events_doc_path} — document "
+                    "it in the kind's payload cell",
                     f"key:{kind}.{key}",
                 ))
+        # GS304: dead documented keys — only when every site is fully
+        # resolved (an opaque splat may legitimately carry the key)
+        sites = emitted[kind]
+        if any(opaque for _, _, _, _, opaque in sites):
+            continue
+        produced: Set[str] = set()
+        for _, _, _, keys, _ in sites:
+            produced |= keys
+        for key in sorted((cell & live_keys) - produced):
+            if not re.fullmatch(r"[a-z_][a-z0-9_]*", key):
+                continue  # prose tokens (`--net`, file names) aren't keys
+            if key == kind:
+                continue  # rows may re-quote their own kind in prose
+            out.append(Finding(
+                "GS304", cfg.events_doc_path, 0, 0,
+                f"{cfg.events_doc_path} documents payload key '{key}' "
+                f"for kind '{kind}' that no emit site produces — dead "
+                "documentation (or a missing emitter config row)",
+                f"key:{kind}.{key}",
+            ))
     return out

@@ -55,7 +55,7 @@ def _stream_sites(ctx: LintContext) -> List[Tuple[str, int, int, str]]:
     return sites
 
 
-@rule
+@rule(codes=("GS201", "GS202", "GS203"))
 def seed_stream_registry(ctx: LintContext) -> List[Finding]:
     registry_path = f"{ctx.config.package}/lint/seed_registry.py"
     if ctx.config.seed_streams is not None:

@@ -1,0 +1,680 @@
+"""GS7xx — state-machine conformance rules (ISSUE 14 tentpole).
+
+The analyzer's transition table (``obs/analyze.py:_LEGAL_FROM``) is the
+stream contract's armor: an event kind arriving while a job sits in a
+state the table doesn't list is a hard ``StreamError`` (exit 2 on the
+CLI).  PR 13 could not check it — the table's truth lives in *another
+module*, in the engine's guard clauses and membership loops.  This rule
+statically extracts both sides and cross-checks them in BOTH directions:
+
+- **GS701** the engine can emit kind K for a job in state S but the
+  analyzer rejects (K, S) — a future stream error waiting for the first
+  replay that takes that path (also fired when the engine emits a
+  per-job kind the table doesn't know at all);
+- **GS702** the table allows (K, S) but no emit site can produce it —
+  dead armor: readers build against transitions that cannot occur;
+- **GS703** a per-job emit site whose job-state context the analysis
+  cannot resolve — the pass refuses to guess; annotate the source.
+
+Engine-side extraction walks every emitter module (LintConfig
+``emitter_paths``) and infers the job state *before* the event applies
+(state assignments are deliberately ignored — ``try_start`` flips the
+job to RUNNING before emitting ``start``, but the analyzer transitions
+on the event, so the *from*-state is the guarded entry state):
+
+1. **guard clauses** — ``if job.state not in (PENDING, SUSPENDED):
+   raise`` narrows ``job`` for everything after it, including ``or``
+   guards ending in ``continue``/``return``/``raise``;
+2. **membership provenance** — ``for job in self.running:`` and
+   ``self.pending`` via the configured ``job_set_attrs`` map, through
+   ``sorted``/``list`` wrappers, ternaries, and local rebinding;
+3. **caller propagation** — a helper with no guard of its own
+   (``_emit_rebind``, ``_finish``, ``_revoke``) inherits the union of
+   its call sites' argument states, iterated to a fixed point over the
+   module's call graph;
+4. **annotations** — ``# lint: job-states[running]`` on a ``def`` (the
+   function returns jobs in those states), an assignment, or a ``for``
+   line, for provenance the analysis cannot reach (an indexed lookup,
+   a dict of members).  States use the ANALYZER's vocabulary.
+
+Engine ``JobState`` members map onto the analyzer's state names through
+``LintConfig.state_aliases`` (``pending`` -> ``queued``).  Kinds the
+analyzer consumes *before* its table lookup (``arrival``, ``reject``,
+``fault``...) are extracted from the analyzer's own dispatch — the
+``kind == "..."`` comparisons preceding the first ``_LEGAL_FROM``
+reference — and exempted.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from gpuschedule_tpu.lint.core import (
+    Finding,
+    LintContext,
+    const_str,
+    rule,
+)
+
+_ANNOT_RE = re.compile(r"#\s*lint:\s*job-states\[([a-zA-Z_\-, ]+)\]")
+
+# expression wrappers that preserve membership provenance
+_PASSTHROUGH_CALLS = {"sorted", "list", "tuple", "reversed"}
+
+
+def _annot_states(
+    comments: Dict[int, str], line: int
+) -> Optional[frozenset]:
+    for ln in (line, line - 1):
+        c = comments.get(ln)
+        if c:
+            m = _ANNOT_RE.search(c)
+            if m:
+                return frozenset(
+                    s.strip() for s in m.group(1).split(",") if s.strip()
+                )
+    return None
+
+
+# --------------------------------------------------------------------- #
+# analyzer side: the _LEGAL_FROM table + pre-table kinds
+
+
+def _module_str_constants(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ``NAME = "str"`` bindings, including tuple unpacking
+    (``QUEUED, RUNNING = "queued", "running"``)."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                s = const_str(node.value)
+                if s is not None:
+                    out[t.id] = s
+            elif isinstance(t, ast.Tuple) and isinstance(
+                node.value, ast.Tuple
+            ) and len(t.elts) == len(node.value.elts):
+                for el, v in zip(t.elts, node.value.elts):
+                    s = const_str(v)
+                    if isinstance(el, ast.Name) and s is not None:
+                        out[el.id] = s
+    return out
+
+
+def _legal_from(
+    tree: ast.Module, table_name: str
+) -> Optional[Tuple[Dict[str, frozenset], Dict[str, int], int]]:
+    """(kind -> allowed from-states, kind -> key line, table line)."""
+    consts = _module_str_constants(tree)
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == table_name
+            for t in node.targets
+        ):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return None
+        table: Dict[str, frozenset] = {}
+        lines: Dict[str, int] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            kind = const_str(k) if k is not None else None
+            if kind is None:
+                continue
+            states: Set[str] = set()
+            if isinstance(v, (ast.Tuple, ast.List)):
+                for el in v.elts:
+                    s = const_str(el)
+                    if s is None and isinstance(el, ast.Name):
+                        s = consts.get(el.id)
+                    if s is not None:
+                        states.add(s)
+            table[kind] = frozenset(states)
+            lines[kind] = k.lineno
+        return table, lines, node.lineno
+    return None
+
+
+def _pre_table_kinds(tree: ast.Module, table_name: str) -> Set[str]:
+    """Kinds the analyzer dispatches on BEFORE its first table lookup:
+    ``kind == "arrival"``-style comparisons with a lower line number
+    than the first ``_LEGAL_FROM`` reference in the same function."""
+    kinds: Set[str] = set()
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        first_use: Optional[int] = None
+        kind_vars: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and node.id == table_name:
+                if first_use is None or node.lineno < first_use:
+                    first_use = node.lineno
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == table_name
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+            ):
+                kind_vars.add(node.args[0].id)
+            if (
+                isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == table_name
+                and isinstance(node.slice, ast.Name)
+            ):
+                kind_vars.add(node.slice.id)
+        if first_use is None:
+            continue
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Compare)
+                and node.lineno < first_use
+                and isinstance(node.left, ast.Name)
+                and (not kind_vars or node.left.id in kind_vars)
+                and len(node.ops) == 1
+                and isinstance(node.ops[0], ast.Eq)
+            ):
+                s = const_str(node.comparators[0])
+                if s is not None:
+                    kinds.add(s)
+    return kinds
+
+
+# --------------------------------------------------------------------- #
+# engine side: emit sites with inferred job-state context
+
+
+@dataclass
+class _Param:
+    """Sentinel: context depends on this parameter of the enclosing
+    function — resolved by caller propagation."""
+
+    func: str
+    name: str
+
+
+@dataclass
+class _EmitSite:
+    kind: str
+    path: str
+    line: int
+    col: int
+    func: str
+    context: object  # frozenset | _Param | None
+
+
+@dataclass
+class _CallSite:
+    callee: str                       # local function/method name
+    args: List[object] = field(default_factory=list)  # per-position context
+
+
+class _FuncAnalysis:
+    """One pass over a function body, statement order, tracking each
+    name's possible job states."""
+
+    def __init__(
+        self,
+        path: str,
+        fname: str,
+        states_map: Dict[str, str],      # JobState member -> analyzer state
+        all_states: frozenset,
+        job_sets: Dict[str, frozenset],  # self.<attr> -> states
+        fn_returns: Dict[str, frozenset],  # annotated return states
+        comments: Dict[int, str],
+        params: Set[str],
+        state_class: str,
+    ):
+        self.path = path
+        self.fname = fname
+        self.states_map = states_map
+        self.all_states = all_states
+        self.job_sets = job_sets
+        self.fn_returns = fn_returns
+        self.comments = comments
+        self.params = params
+        self.state_class = state_class
+        self.emits: List[_EmitSite] = []
+        self.calls: List[_CallSite] = []
+
+    # -- state-test parsing ------------------------------------------- #
+
+    def _state_const(self, node: ast.AST) -> Optional[str]:
+        """``JobState.PENDING`` (or a bare enum-member constant string)
+        -> the analyzer-vocabulary state name."""
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == self.state_class
+        ):
+            return self.states_map.get(node.attr)
+        s = const_str(node)
+        if s is not None:
+            # a raw string compare against .state
+            return self.states_map.get(s.upper(), s)
+        return None
+
+    def _state_test(
+        self, test: ast.AST
+    ) -> Optional[Tuple[str, frozenset, bool]]:
+        """Parse ``X.state <op> ...`` -> (name, states, positive):
+        ``positive`` True means the test passing implies state IN the
+        set; False means the test passing implies state NOT IN it."""
+        if not (
+            isinstance(test, ast.Compare)
+            and isinstance(test.left, ast.Attribute)
+            and test.left.attr == "state"
+            and isinstance(test.left.value, ast.Name)
+            and len(test.ops) == 1
+        ):
+            return None
+        name = test.left.value.id
+        op = test.ops[0]
+        comp = test.comparators[0]
+        states: Set[str] = set()
+        if isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+            for el in comp.elts:
+                s = self._state_const(el)
+                if s is None:
+                    return None
+                states.add(s)
+        else:
+            s = self._state_const(comp)
+            if s is None:
+                return None
+            states.add(s)
+        if isinstance(op, (ast.In, ast.Is, ast.Eq)):
+            return name, frozenset(states), True
+        if isinstance(op, (ast.NotIn, ast.IsNot, ast.NotEq)):
+            return name, frozenset(states), False
+        return None
+
+    def _narrow_reject(self, test: ast.AST, env: Dict[str, object]) -> None:
+        """The guard's body is terminal, so AFTER the If the test is
+        known false — apply the negated narrowing.  ``or`` guards
+        narrow by every state conjunct (all disjuncts are false)."""
+        tests = (
+            test.values if isinstance(test, ast.BoolOp)
+            and isinstance(test.op, ast.Or) else [test]
+        )
+        for t in tests:
+            parsed = self._state_test(t)
+            if parsed is None:
+                continue
+            name, states, positive = parsed
+            if positive:
+                # test was `state in S` and it is false -> state not in S
+                cur = env.get(name)
+                base = cur if isinstance(cur, frozenset) else self.all_states
+                env[name] = base - states
+            else:
+                # test was `state not in S` and it is false -> state in S
+                cur = env.get(name)
+                if isinstance(cur, frozenset):
+                    env[name] = cur & states
+                else:
+                    env[name] = states
+
+    def _narrow_positive(self, test: ast.AST, env: Dict[str, object]) -> None:
+        """Inside an If body: the test is known true."""
+        tests = (
+            test.values if isinstance(test, ast.BoolOp)
+            and isinstance(test.op, ast.And) else [test]
+        )
+        for t in tests:
+            parsed = self._state_test(t)
+            if parsed is None:
+                continue
+            name, states, positive = parsed
+            cur = env.get(name)
+            if positive:
+                if isinstance(cur, frozenset):
+                    env[name] = cur & states
+                else:
+                    env[name] = states
+            else:
+                base = cur if isinstance(cur, frozenset) else self.all_states
+                env[name] = base - states
+
+    # -- expression provenance ---------------------------------------- #
+
+    def _states_of(self, node: ast.AST, env: Dict[str, object]) -> object:
+        if isinstance(node, ast.Name):
+            if node.id in env:
+                return env[node.id]
+            if node.id in self.params:
+                return _Param(self.fname, node.id)
+            return None
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return self.job_sets.get(node.attr)
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Name)
+                and f.id in _PASSTHROUGH_CALLS
+                and node.args
+            ):
+                return self._states_of(node.args[0], env)
+            callee = None
+            if isinstance(f, ast.Name):
+                callee = f.id
+            elif (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "self"
+            ):
+                callee = f.attr
+            if callee is not None and callee in self.fn_returns:
+                return self.fn_returns[callee]
+            return None
+        if isinstance(node, ast.IfExp):
+            a = self._states_of(node.body, env)
+            b = self._states_of(node.orelse, env)
+            if isinstance(a, frozenset) and isinstance(b, frozenset):
+                return a | b
+            return None
+        return None
+
+    # -- statement walk ----------------------------------------------- #
+
+    @staticmethod
+    def _terminal(body: List[ast.stmt]) -> bool:
+        return bool(body) and isinstance(
+            body[-1], (ast.Raise, ast.Return, ast.Continue, ast.Break)
+        )
+
+    def walk(self, body: List[ast.stmt], env: Dict[str, object]) -> None:
+        for stmt in body:
+            ann = _annot_states(self.comments, stmt.lineno)
+            if isinstance(stmt, ast.Assign):
+                self._scan_exprs(stmt.value, env)
+                states = ann if ann is not None else self._states_of(
+                    stmt.value, env
+                )
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        env[t.id] = states
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._scan_exprs(stmt.iter, env)
+                states = ann if ann is not None else self._states_of(
+                    stmt.iter, env
+                )
+                inner = dict(env)
+                if isinstance(stmt.target, ast.Name):
+                    inner[stmt.target.id] = states
+                self.walk(stmt.body, inner)
+                self.walk(stmt.orelse, dict(env))
+            elif isinstance(stmt, ast.If):
+                self._scan_exprs(stmt.test, env)
+                body_env = dict(env)
+                self._narrow_positive(stmt.test, body_env)
+                self.walk(stmt.body, body_env)
+                else_env = dict(env)
+                self._narrow_reject(stmt.test, else_env)
+                self.walk(stmt.orelse, else_env)
+                if self._terminal(stmt.body):
+                    # the guard pattern: code after the If sees the
+                    # negated test
+                    self._narrow_reject(stmt.test, env)
+            elif isinstance(stmt, (ast.While,)):
+                self._scan_exprs(stmt.test, env)
+                self.walk(stmt.body, dict(env))
+                self.walk(stmt.orelse, dict(env))
+            elif isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    self._scan_exprs(item.context_expr, env)
+                self.walk(stmt.body, env)
+            elif isinstance(stmt, ast.Try):
+                self.walk(stmt.body, dict(env))
+                for h in stmt.handlers:
+                    self.walk(h.body, dict(env))
+                self.walk(stmt.orelse, dict(env))
+                self.walk(stmt.finalbody, dict(env))
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                continue  # nested scopes: out of scope for this pass
+            else:
+                self._scan_exprs(stmt, env)
+
+    def _scan_exprs(self, node: ast.AST, env: Dict[str, object]) -> None:
+        """Record emit sites and propagation-relevant call sites in this
+        expression tree."""
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            if isinstance(f, ast.Attribute) and f.attr == "event" and sub.args:
+                kind = const_str(sub.args[0])
+                if kind is None:
+                    continue
+                jobarg = sub.args[2] if len(sub.args) >= 3 else None
+                if jobarg is None:
+                    for kw in sub.keywords:
+                        if kw.arg == "job":
+                            jobarg = kw.value
+                if jobarg is None or (
+                    isinstance(jobarg, ast.Constant)
+                    and jobarg.value is None
+                ):
+                    continue  # cluster-level record: no job at all
+                self.emits.append(_EmitSite(
+                    kind, self.path, sub.lineno, sub.col_offset,
+                    self.fname, self._states_of(jobarg, env),
+                ))
+                continue
+            callee = None
+            if isinstance(f, ast.Name):
+                callee = f.id
+            elif (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "self"
+            ):
+                callee = f.attr
+            if callee is None:
+                continue
+            args = [self._states_of(a, env) for a in sub.args]
+            if any(a is not None for a in args):
+                self.calls.append(_CallSite(callee, args))
+
+
+def _jobstate_map(
+    tree: ast.Module, class_name: str, aliases: Dict[str, str]
+) -> Dict[str, str]:
+    """JobState member name -> analyzer-vocabulary state string."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            for sub in node.body:
+                if isinstance(sub, ast.Assign):
+                    s = const_str(sub.value)
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name) and s is not None:
+                            out[t.id] = aliases.get(s, s)
+    return out
+
+
+def _analyze_emitter(
+    ctx: LintContext,
+    path: str,
+    states_map: Dict[str, str],
+    all_states: frozenset,
+) -> List[_EmitSite]:
+    """All per-job emit sites of one module, contexts resolved through
+    the in-module call graph to a fixed point."""
+    cfg = ctx.config
+    tree = ctx.tree(path)
+    comments = ctx.comments(path)
+    job_sets = {
+        attr: frozenset(states) for attr, states in cfg.job_set_attrs
+    }
+    fn_returns: Dict[str, frozenset] = {}
+    funcs: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, node)
+            ann = _annot_states(comments, node.lineno)
+            if ann is not None:
+                fn_returns[node.name] = ann
+
+    analyses: Dict[str, _FuncAnalysis] = {}
+    for name, fn in funcs.items():
+        a = fn.args
+        params = {
+            arg.arg
+            for arg in (*a.posonlyargs, *a.args, *a.kwonlyargs,
+                        a.vararg, a.kwarg)
+            if arg is not None and arg.arg != "self"
+        }
+        fa = _FuncAnalysis(
+            path, name, states_map, all_states, job_sets, fn_returns,
+            comments, params, cfg.job_state_class,
+        )
+        fa.walk(fn.body, {})
+        analyses[name] = fa
+
+    # caller propagation: param -> union of known arg states across all
+    # call sites, iterated to a fixed point (the call graph is small)
+    param_states: Dict[Tuple[str, str], frozenset] = {}
+    for _ in range(len(funcs) + 2):
+        changed = False
+        for fa in analyses.values():
+            for call in fa.calls:
+                callee = funcs.get(call.callee)
+                if callee is None:
+                    continue
+                a = callee.args
+                names = [arg.arg for arg in (*a.posonlyargs, *a.args)]
+                if names and names[0] == "self":
+                    names = names[1:]
+                for pos, context in enumerate(call.args):
+                    if pos >= len(names):
+                        break
+                    if isinstance(context, _Param):
+                        context = param_states.get(
+                            (context.func, context.name)
+                        )
+                    if not isinstance(context, frozenset):
+                        continue
+                    key = (call.callee, names[pos])
+                    cur = param_states.get(key, frozenset())
+                    new = cur | context
+                    if new != cur:
+                        param_states[key] = new
+                        changed = True
+        if not changed:
+            break
+
+    out: List[_EmitSite] = []
+    for fa in analyses.values():
+        for site in fa.emits:
+            if isinstance(site.context, _Param):
+                site.context = param_states.get(
+                    (site.context.func, site.context.name)
+                )
+            out.append(site)
+    return out
+
+
+@rule(codes=("GS701", "GS702", "GS703"))
+def state_machine_conformance(ctx: LintContext) -> List[Finding]:
+    cfg = ctx.config
+    if not ctx.has(cfg.analyzer_path) or not ctx.has(cfg.job_state_path):
+        return []
+    parsed = _legal_from(ctx.tree(cfg.analyzer_path), cfg.legal_from_name)
+    if parsed is None:
+        return []
+    table, key_lines, table_line = parsed
+    pre_table = _pre_table_kinds(ctx.tree(cfg.analyzer_path),
+                                 cfg.legal_from_name)
+    aliases = dict(cfg.state_aliases)
+    states_map = _jobstate_map(
+        ctx.tree(cfg.job_state_path), cfg.job_state_class, aliases
+    )
+    analyzer_states = frozenset().union(*table.values()) if table else frozenset()
+
+    sites: List[_EmitSite] = []
+    for path in cfg.emitter_paths:
+        if ctx.has(path):
+            sites.extend(
+                _analyze_emitter(ctx, path, states_map, analyzer_states)
+            )
+
+    out: List[Finding] = []
+    by_kind: Dict[str, List[_EmitSite]] = {}
+    for s in sites:
+        by_kind.setdefault(s.kind, []).append(s)
+
+    flagged_unknown: Set[str] = set()
+    for s in sorted(sites, key=lambda s: (s.path, s.line, s.col)):
+        if s.kind in pre_table:
+            continue  # consumed before the transition table
+        if s.kind not in table:
+            if s.kind not in flagged_unknown:
+                flagged_unknown.add(s.kind)
+                out.append(Finding(
+                    "GS701", s.path, s.line, s.col,
+                    f"engine emits per-job kind '{s.kind}' that "
+                    f"{cfg.analyzer_path}:{cfg.legal_from_name} has no "
+                    "transition rule for — the analyzer will reject the "
+                    "stream",
+                    f"kind:{s.kind}",
+                ))
+            continue
+        if not isinstance(s.context, frozenset):
+            out.append(Finding(
+                "GS703", s.path, s.line, s.col,
+                f"cannot infer the job-state context of this '{s.kind}' "
+                "emit site — add a guard the pass can read or a "
+                "`# lint: job-states[...]` annotation "
+                "(docs/static-analysis.md)",
+                f"{s.kind}@{s.func}",
+            ))
+            continue
+        for state in sorted(s.context - table[s.kind]):
+            out.append(Finding(
+                "GS701", s.path, s.line, s.col,
+                f"engine can emit '{s.kind}' for a job in state "
+                f"'{state}' but {cfg.legal_from_name} only allows "
+                f"{sorted(table[s.kind])} — a replay taking this path "
+                "is a stream error",
+                f"{s.kind}:{state}",
+            ))
+
+    for kind in sorted(table):
+        kind_sites = by_kind.get(kind, [])
+        if not kind_sites:
+            out.append(Finding(
+                "GS702", cfg.analyzer_path,
+                key_lines.get(kind, table_line), 0,
+                f"{cfg.legal_from_name} has a transition rule for "
+                f"'{kind}' but no emitter produces that kind — dead "
+                "armor (or a missing emitter config row)",
+                f"kind:{kind}",
+            ))
+            continue
+        if not all(isinstance(s.context, frozenset) for s in kind_sites):
+            continue  # unresolved site already flagged; can't prove dead
+        produced = frozenset().union(*(s.context for s in kind_sites))
+        for state in sorted(table[kind] - produced):
+            out.append(Finding(
+                "GS702", cfg.analyzer_path,
+                key_lines.get(kind, table_line), 0,
+                f"{cfg.legal_from_name} allows '{kind}' from state "
+                f"'{state}' but no emit site can produce it — dead "
+                "armor the engine's state machine contradicts",
+                f"{kind}:{state}",
+            ))
+    return out

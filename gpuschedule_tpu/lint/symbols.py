@@ -1,0 +1,525 @@
+"""Package-wide symbol table + call graph (ISSUE 14 tentpole).
+
+PR 13's rules were per-file pattern matchers: set-iteration detection
+saw only local bindings and ``self`` attributes of the enclosing class,
+counter liveness matched attribute names package-wide with no notion of
+*which* class owns the counter, and nothing could follow a value through
+a ``from``-import or a function return.  This module is the shared
+whole-program layer those rules (and the new GS7xx state-machine family)
+now sit on:
+
+- **import resolution**: every ``from``-import resolved to its source
+  module (absolute dotted, or relative against the importing file's
+  package) — one implementation, shared with the fork-safety rule;
+- **set provenance**: which module-level names are bound to sets, which
+  functions/methods *return* sets, and which ``self`` attributes hold
+  sets — propagated across imports, function returns, and attribute
+  assignment to a fixed point, so a set built in ``cluster/base.py``
+  and iterated in ``sim/engine.py`` is detectable;
+- **class provenance**: the class an attribute holds (``self._cache =
+  GroupCache()`` types ``_cache`` as ``GroupCache``, following the
+  import to its defining module), plus annotation-based typing of
+  function parameters (``cache: Optional[GroupCache]``) — what lets
+  counter liveness be class-qualified;
+- **call graph**: best-effort resolved edges (bare names, ``self``
+  methods, imported functions, module-qualified calls) for rules that
+  need caller context.
+
+Documented limits (docs/static-analysis.md): inference is assignment-
+and annotation-driven — no inheritance walking, no container-element
+typing, no flow-sensitivity.  A name the table cannot classify is
+*unknown*, and every consuming rule treats unknown conservatively
+(suppressing, never inventing, a finding) except where it demands an
+explicit annotation (GS703).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+# (path, enclosing class name or None, function name)
+FuncKey = Tuple[str, Optional[str], str]
+# (defining path, class name)
+ClassKey = Tuple[str, str]
+
+_SET_CONSTRUCTORS = {"set", "frozenset"}
+_SET_ANNOTATIONS = {"set", "frozenset", "Set", "FrozenSet", "MutableSet",
+                    "AbstractSet"}
+# wrappers whose result is NOT a set even when fed one
+_ORDERING_CALLS = {"sorted", "list", "tuple"}
+
+
+def module_dotted(path: str) -> str:
+    """gpuschedule_tpu/sim/whatif.py -> gpuschedule_tpu.sim.whatif"""
+    return path[:-3].replace("/__init__", "").replace("/", ".")
+
+
+def containing_package(path: str) -> str:
+    """The dotted package a file's relative imports resolve against."""
+    if path.endswith("/__init__.py"):
+        return module_dotted(path)
+    return module_dotted(path).rsplit(".", 1)[0]
+
+
+def _annotation_names(node: Optional[ast.AST]) -> List[str]:
+    """Flatten an annotation expression to its identifier leaves:
+    ``Optional[GroupCache]`` -> ["Optional", "GroupCache"]."""
+    out: List[str] = []
+    if node is None:
+        return out
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.append(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.append(sub.attr)
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            # string annotations: parse the forward reference
+            try:
+                out.extend(_annotation_names(ast.parse(sub.value, mode="eval").body))
+            except SyntaxError:
+                pass
+    return out
+
+
+def _is_set_annotation(node: Optional[ast.AST]) -> bool:
+    names = _annotation_names(node)
+    return bool(names) and names[0] in _SET_ANNOTATIONS
+
+
+def bound_names(fn: ast.AST) -> Set[str]:
+    """Every name BOUND inside a function scope other than by a plain
+    assignment: parameters (own and nested defs'), loop / with /
+    except / comprehension targets, nested def names.  Consumers seed
+    these as NON-sets so a binding that shadows a module-level set is
+    never misread as that set (plain assignments stay flow-classified
+    by the caller and may override)."""
+    out: Set[str] = set()
+
+    def targets(t: ast.AST) -> None:
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                targets(el)
+        elif isinstance(t, ast.Starred):
+            targets(t.value)
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            a = node.args
+            for arg in (*a.posonlyargs, *a.args, *a.kwonlyargs,
+                        a.vararg, a.kwarg):
+                if arg is not None:
+                    out.add(arg.arg)
+            if not isinstance(node, ast.Lambda):
+                out.add(node.name)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            targets(node.target)
+        elif isinstance(node, ast.comprehension):
+            targets(node.target)
+        elif isinstance(node, ast.withitem):
+            if node.optional_vars is not None:
+                targets(node.optional_vars)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            out.add(node.name)
+    return out
+
+
+class SymbolTable:
+    """Parsed-once whole-program view.  Build via
+    ``LintContext.symbols()`` — construction walks every package AST a
+    small constant number of times (the set/return classification runs
+    to a fixed point, bounded by the import-chain depth)."""
+
+    def __init__(self, ctx) -> None:
+        self._ctx = ctx
+        self.paths: List[str] = list(ctx.py_files)
+        self._path_of_module: Dict[str, str] = {}
+        for p in self.paths:
+            self._path_of_module[module_dotted(p)] = p
+
+        # per-module import maps
+        # local name -> (source module dotted, remote symbol name)
+        self.from_imports: Dict[str, Dict[str, Tuple[str, str]]] = {}
+        # local alias -> module dotted ("import x.y as z")
+        self.module_aliases: Dict[str, Dict[str, str]] = {}
+
+        # definitions
+        self.functions: Dict[FuncKey, ast.AST] = {}
+        self.classes: Dict[ClassKey, ast.ClassDef] = {}
+
+        # provenance
+        self.module_sets: Dict[str, Set[str]] = {}
+        self.set_returning: Set[FuncKey] = set()
+        self.class_set_attrs: Dict[ClassKey, Set[str]] = {}
+        self.class_attr_types: Dict[ClassKey, Dict[str, ClassKey]] = {}
+
+        # call graph: caller -> set of resolved callees
+        self.calls: Dict[FuncKey, Set[FuncKey]] = {}
+
+        # pre-collected AST slices the fixpoint re-reads (walking the
+        # trees once here instead of once per iteration keeps the whole
+        # build inside the CI gate's wall-time budget)
+        self._module_binds: Dict[str, List[tuple]] = {}
+        self._fn_rets: Dict[FuncKey, List[ast.AST]] = {}
+        self._fn_assigns: Dict[FuncKey, List[Tuple[str, ast.AST]]] = {}
+        self._cls_attrs: Dict[ClassKey, List[tuple]] = {}
+        # every augmented-assignment target with its context, for the
+        # class-qualified counter-liveness rule:
+        # (path, enclosing class, enclosing FuncKey or None, target)
+        self.aug_assigns: List[
+            Tuple[str, Optional[str], Optional[FuncKey], ast.AST]
+        ] = []
+        self._fn_bound: Dict[FuncKey, Set[str]] = {}
+
+        for path in self.paths:
+            self._index_module(path)
+        self._classify_fixpoint()
+        self._build_call_graph()
+
+    # ---------------------------------------------------------------- #
+    # indexing
+
+    def _index_module(self, path: str) -> None:
+        tree = self._ctx.tree(path)
+        package = containing_package(path)
+        froms: Dict[str, Tuple[str, str]] = {}
+        mods: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mods[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                if node.level == 0:
+                    resolved = node.module or ""
+                else:
+                    parts = package.split(".")
+                    parts = parts[: len(parts) - (node.level - 1)]
+                    if node.module:
+                        parts.append(node.module)
+                    resolved = ".".join(parts)
+                for a in node.names:
+                    froms[a.asname or a.name] = (resolved, a.name)
+        self.from_imports[path] = froms
+        self.module_aliases[path] = mods
+
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[(path, None, node.name)] = node
+            elif isinstance(node, ast.ClassDef):
+                self.classes[(path, node.name)] = node
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self.functions[(path, node.name, sub.name)] = sub
+
+        # module-level bindings: (target names, value, set-annotated?)
+        binds: List[tuple] = []
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                names = [t.id for t in node.targets
+                         if isinstance(t, ast.Name)]
+                if names:
+                    binds.append((names, node.value, False))
+            elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name
+            ):
+                binds.append((
+                    [node.target.id], node.value,
+                    _is_set_annotation(node.annotation),
+                ))
+        self._module_binds[path] = binds
+
+        # per-function return values, straight-line Name assigns, and
+        # augmented-assignment sites (one walk serves all three)
+        for key, fn in list(self.functions.items()):
+            if key[0] != path or key in self._fn_rets:
+                continue
+            rets: List[ast.AST] = []
+            assigns: List[Tuple[str, ast.AST]] = []
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    if not (isinstance(node.value, ast.Constant)
+                            and node.value.value is None):
+                        rets.append(node.value)
+                elif isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            assigns.append((t.id, node.value))
+                elif isinstance(node, ast.AugAssign):
+                    self.aug_assigns.append((path, key[1], key, node.target))
+            self._fn_rets[key] = rets
+            self._fn_assigns[key] = assigns
+        # module- and class-body-level augmented assignments (no
+        # enclosing function)
+        for node in tree.body:
+            if isinstance(node, ast.AugAssign):
+                self.aug_assigns.append((path, None, None, node.target))
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, ast.AugAssign):
+                        self.aug_assigns.append(
+                            (path, node.name, None, sub.target)
+                        )
+
+        # per-class self-attribute sites: (attr, value, annotation)
+        for node in tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            sites: List[tuple] = []
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            sites.append((t.attr, sub.value, None))
+                elif isinstance(sub, ast.AnnAssign):
+                    t = sub.target
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        sites.append((t.attr, sub.value, sub.annotation))
+                    elif isinstance(t, ast.Name) and sub.value is None:
+                        sites.append((t.id, None, sub.annotation))
+            self._cls_attrs[(path, node.name)] = sites
+
+    # ---------------------------------------------------------------- #
+    # resolution helpers
+
+    def path_of_module(self, dotted: str) -> Optional[str]:
+        return self._path_of_module.get(dotted)
+
+    def resolve_import(self, path: str, name: str) -> Optional[Tuple[str, str]]:
+        """Local ``name`` in ``path`` -> (source path, symbol name) when
+        it is a from-import of another package module."""
+        hit = self.from_imports.get(path, {}).get(name)
+        if hit is None:
+            return None
+        src = self.path_of_module(hit[0])
+        if src is None:
+            return None
+        return src, hit[1]
+
+    def resolve_class(self, path: str, name: str) -> Optional[ClassKey]:
+        """A class name referenced in ``path`` -> its defining
+        (path, class), following one from-import hop."""
+        if (path, name) in self.classes:
+            return (path, name)
+        imp = self.resolve_import(path, name)
+        if imp is not None and (imp[0], imp[1]) in self.classes:
+            return (imp[0], imp[1])
+        return None
+
+    def resolve_callable(
+        self, path: str, cls: Optional[str], func: ast.AST
+    ) -> Optional[FuncKey]:
+        """Resolve a Call's func expression to a known FuncKey:
+        bare names (module functions + from-imports), ``self.m``
+        methods, and ``mod.f`` module-qualified calls."""
+        if isinstance(func, ast.Name):
+            if (path, None, func.id) in self.functions:
+                return (path, None, func.id)
+            imp = self.resolve_import(path, func.id)
+            if imp is not None and (imp[0], None, imp[1]) in self.functions:
+                return (imp[0], None, imp[1])
+            return None
+        if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            base = func.value.id
+            if base == "self" and cls is not None:
+                if (path, cls, func.attr) in self.functions:
+                    return (path, cls, func.attr)
+                return None
+            mod = self.module_aliases.get(path, {}).get(base)
+            if mod is not None:
+                target = self.path_of_module(mod)
+                if target is not None and (target, None, func.attr) in self.functions:
+                    return (target, None, func.attr)
+        return None
+
+    # ---------------------------------------------------------------- #
+    # set provenance
+
+    def expr_is_set(
+        self,
+        path: str,
+        cls: Optional[str],
+        node: ast.AST,
+        local_sets: Optional[Set[str]] = None,
+        local_nonsets: Optional[Set[str]] = None,
+    ) -> bool:
+        """Whether an expression provably evaluates to a set.
+        ``local_sets`` / ``local_nonsets`` are the caller's per-function
+        binding classification; names in neither fall back to module /
+        import provenance."""
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.IfExp):
+            # conservative: both arms must be sets
+            return self.expr_is_set(path, cls, node.body, local_sets,
+                                    local_nonsets) and self.expr_is_set(
+                path, cls, node.orelse, local_sets, local_nonsets)
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in _SET_CONSTRUCTORS:
+                return True
+            if isinstance(f, ast.Name) and f.id in _ORDERING_CALLS:
+                return False
+            key = self.resolve_callable(path, cls, f)
+            return key is not None and key in self.set_returning
+        if isinstance(node, ast.Name):
+            if local_sets is not None and node.id in local_sets:
+                return True
+            if local_nonsets is not None and node.id in local_nonsets:
+                return False
+            if node.id in self.module_sets.get(path, ()):
+                return True
+            imp = self.resolve_import(path, node.id)
+            if imp is not None:
+                return imp[1] in self.module_sets.get(imp[0], ())
+            return False
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+        ):
+            if node.value.id == "self" and cls is not None:
+                return node.attr in self.class_set_attrs.get((path, cls), ())
+            mod = self.module_aliases.get(path, {}).get(node.value.id)
+            if mod is not None:
+                target = self.path_of_module(mod)
+                if target is not None:
+                    return node.attr in self.module_sets.get(target, ())
+        return False
+
+    def _function_returns_set(self, key: FuncKey) -> bool:
+        path, cls, _name = key
+        returns = self._fn_rets.get(key, ())
+        if not returns:
+            return False
+        # simple local classification: straight-line Name = <expr>;
+        # params / loop targets pre-seed as NON-sets so a name that
+        # shadows a module-level set is never misread as it (memoized —
+        # the fixpoint revisits unclassified functions every iteration)
+        bound = self._fn_bound.get(key)
+        if bound is None:
+            bound = self._fn_bound[key] = bound_names(self.functions[key])
+        local_sets: Set[str] = set()
+        local_nonsets: Set[str] = set(bound)
+        for name, value in self._fn_assigns.get(key, ()):
+            if self.expr_is_set(path, cls, value, local_sets, local_nonsets):
+                local_sets.add(name)
+                local_nonsets.discard(name)
+            else:
+                local_nonsets.add(name)
+                local_sets.discard(name)
+        return all(
+            self.expr_is_set(path, cls, r, local_sets, local_nonsets)
+            for r in returns
+        )
+
+    def _classify_fixpoint(self) -> None:
+        """Iterate module-set / set-returning / class-attr classification
+        until stable — bounded by the longest provenance chain, tiny in
+        practice.  Reads the pre-collected AST slices, so each iteration
+        costs O(bindings), not a full tree walk."""
+        for _ in range(6):
+            changed = False
+            # module-level set names
+            for path in self.paths:
+                names = self.module_sets.setdefault(path, set())
+                for targets, value, annotated in self._module_binds[path]:
+                    is_set = annotated or (
+                        value is not None
+                        and self.expr_is_set(path, None, value)
+                    )
+                    if is_set:
+                        for t in targets:
+                            if t not in names:
+                                names.add(t)
+                                changed = True
+            # set-returning functions
+            for key in self.functions:
+                if key not in self.set_returning and self._function_returns_set(key):
+                    self.set_returning.add(key)
+                    changed = True
+            # class set attributes (assignment-, annotation-, and
+            # call-provenance driven)
+            for (path, clsname) in self.classes:
+                attrs = self.class_set_attrs.setdefault((path, clsname), set())
+                for target, value, annotation in self._cls_attrs[
+                    (path, clsname)
+                ]:
+                    is_set = _is_set_annotation(annotation) or (
+                        value is not None
+                        and self.expr_is_set(path, clsname, value)
+                    )
+                    if is_set and target not in attrs:
+                        attrs.add(target)
+                        changed = True
+            if not changed:
+                break
+
+        # class attribute types (single pass; no fixpoint needed — the
+        # right-hand side is a direct constructor call)
+        for (path, clsname) in self.classes:
+            types = self.class_attr_types.setdefault((path, clsname), {})
+            for target, value, _annotation in self._cls_attrs[(path, clsname)]:
+                if isinstance(value, ast.IfExp):
+                    # `GroupCache() if armed else None` — type from the
+                    # constructing arm
+                    for arm in (value.body, value.orelse):
+                        if isinstance(arm, ast.Call):
+                            value = arm
+                            break
+                if not (isinstance(value, ast.Call)
+                        and isinstance(value.func, ast.Name)):
+                    continue
+                resolved = self.resolve_class(path, value.func.id)
+                if resolved is not None:
+                    types.setdefault(target, resolved)
+
+    # ---------------------------------------------------------------- #
+    # call graph
+
+    def _build_call_graph(self) -> None:
+        for key, fn in self.functions.items():
+            path, cls, _ = key
+            edges = self.calls.setdefault(key, set())
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    callee = self.resolve_callable(path, cls, node.func)
+                    if callee is not None:
+                        edges.add(callee)
+
+    def callers_of(self, key: FuncKey) -> List[FuncKey]:
+        return sorted(
+            caller for caller, callees in self.calls.items()
+            if key in callees
+        )
+
+    # ---------------------------------------------------------------- #
+    # parameter typing (annotation-driven)
+
+    def param_class(
+        self, key: FuncKey, param: str
+    ) -> Optional[ClassKey]:
+        """The class a function parameter is annotated with (following
+        one import hop); None when unannotated or unresolvable."""
+        fn = self.functions.get(key)
+        if fn is None:
+            return None
+        a = fn.args
+        for arg in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+            if arg.arg == param and arg.annotation is not None:
+                for name in _annotation_names(arg.annotation):
+                    resolved = self.resolve_class(key[0], name)
+                    if resolved is not None:
+                        return resolved
+        return None

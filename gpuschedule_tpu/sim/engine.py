@@ -952,6 +952,9 @@ class Simulator:
         if routing:
             routed, self._net_routes = self._net_routes, {}
         priced, self._net_priced = self._net_priced, {}
+        # _net_members holds running multislice gangs only (registered
+        # at bind, retired at release):
+        # lint: job-states[running] membership provenance for GS7xx
         members = sorted(
             self._net_members.values(), key=lambda j: j.run_seq
         )
@@ -1328,6 +1331,9 @@ class Simulator:
                 fault=payload.kind, fid=self._fault_ids[id(payload)],
             )
 
+    # resolves alloc ids through the live allocation index, so every
+    # returned job holds an allocation:
+    # lint: job-states[running] return provenance for GS7xx
     def _victim_jobs(self, alloc_ids) -> List[Job]:
         """Resolve a cluster-reported alloc_id list to the running jobs
         holding them, in running-set iteration order (ascending run_seq) —

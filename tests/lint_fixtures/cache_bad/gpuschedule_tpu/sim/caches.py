@@ -1,9 +1,11 @@
-"""Bad fixture: a dead counter, a declared-but-unshed cache, an
-undeclared shed, and an undocumented cache name."""
+"""Bad fixture: a dead counter MASKED by a same-named counter in an
+unrelated class (ISSUE 14 class-qualification), a declared-but-unshed
+cache, an undeclared shed, a stale _SNAPSHOT_META row, and an
+undocumented cache name."""
 
 
 class Engine:
-    _DERIVED_CACHES = ("_memo",)            # GS502 unshed (line 5)
+    _DERIVED_CACHES = ("_memo",)            # GS502 unshed
 
     def __init__(self):
         self._hits = 0
@@ -17,15 +19,34 @@ class Engine:
         return None                         # _misses never incremented
 
     def cache_stats(self):
-        # GS501 dead 'miss' counter + GS503 undocumented name (line 21)
+        # GS501 dead 'miss' counter + GS503 undocumented name
         return {"dark_cache": {"hit": self._hits, "miss": self._misses}}
+
+
+class Unrelated:
+    def __init__(self):
+        self._misses = 0
+
+    def poke(self):
+        # pre-ISSUE-14 this bare-name increment masked Engine's dead
+        # counter; class-qualified liveness no longer credits it
+        self._misses += 1
 
 
 class Other:
     def __init__(self):
         self._scratch = {}
 
-    def __getstate__(self):                 # GS502 undeclared (line 24)
+    def __getstate__(self):                 # GS502 undeclared
         state = self.__dict__.copy()
         state["_scratch"] = {}
+        return state
+
+
+class Versioned:
+    _SNAPSHOT_META = ("_schema", "_ghost")  # GS502 meta-stale (_ghost)
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_schema"] = 2
         return state

@@ -1,13 +1,27 @@
-"""Good fixture: live counters, declared + shed derived cache."""
+"""Good fixture: live counters (including one owned by a helper class
+and one incremented through an annotated parameter), declared + shed
+derived cache, and non-cache snapshot metadata under _SNAPSHOT_META."""
+
+
+class Meter:
+    def __init__(self):
+        self.reuses = 0
+
+
+def bump(meter: Meter) -> None:
+    meter.reuses += 1
 
 
 class Engine:
     _DERIVED_CACHES = ("_memo",)
+    _SNAPSHOT_META = ("_schema",)
 
     def __init__(self):
         self._hits = 0
         self._misses = 0
         self._memo = {}
+        self._meter = Meter()
+        self._schema = 2
 
     def lookup(self, key):
         if key in self._memo:
@@ -19,7 +33,12 @@ class Engine:
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_memo"] = {}
+        state["_schema"] = 2
         return state
 
     def cache_stats(self):
-        return {"demo_cache": {"hit": self._hits, "miss": self._misses}}
+        return {"demo_cache": {
+            "hit": self._hits,
+            "miss": self._misses,
+            "reuse": self._meter.reuses,
+        }}

@@ -1,0 +1,18 @@
+"""Bad fixture: cross-module set provenance (ISSUE 14) — an imported
+module-level set, a set-returning imported function, and a self
+attribute bound from one, all iterated bare."""
+
+from gpuschedule_tpu.cluster.topo import MEMBERS, victim_ids
+
+
+class Replayer:
+    def __init__(self):
+        self.targets = victim_ids()
+
+    def emit(self):
+        for m in MEMBERS:
+            print(m)
+        for v in victim_ids():
+            print(v)
+        for t in self.targets:
+            print(t)

@@ -1,0 +1,9 @@
+"""Fixture: sets built in cluster/, consumed in sim/ (ISSUE 14)."""
+
+MEMBERS = {"a", "b"}
+
+
+def victim_ids():
+    out = set()
+    out.add("x")
+    return out

@@ -1,0 +1,16 @@
+"""Good fixture: the same cross-module sets, iterated sorted."""
+
+from gpuschedule_tpu.cluster.topo import MEMBERS, victim_ids
+
+
+class Replayer:
+    def __init__(self):
+        self.targets = victim_ids()
+
+    def emit(self):
+        for m in sorted(MEMBERS):
+            print(m)
+        for v in sorted(victim_ids()):
+            print(v)
+        for t in sorted(self.targets):
+            print(t)

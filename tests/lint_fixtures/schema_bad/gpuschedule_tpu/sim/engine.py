@@ -1,5 +1,6 @@
-"""Bad fixture: an undocumented kind, an undocumented key, and (in the
-doc) a kind that is never emitted."""
+"""Bad fixture: an undocumented kind, an undocumented key, a per-kind
+key violation (the key is documented — for a different kind), a dead
+documented kind, and a dead documented per-kind key."""
 
 
 class Sim:
@@ -7,4 +8,7 @@ class Sim:
         extra = {"speed": 1.0}
         extra["warp"] = 9.0
         metrics.event("start", 0.0, None, chips=4, **extra)   # GS303 warp
-        metrics.event("mystery", 2.0, None, blob=1)           # GS301+GS303
+        metrics.event("mystery", 2.0, None, blob=1)           # GS301
+        metrics.event("stop", 3.0, None, speed=2.0)           # GS303 speed
+        # (documented for start, not stop); stop's documented `chips` is
+        # produced by no stop site -> GS304

@@ -1,4 +1,6 @@
-"""Good fixture: every emitted kind and key is documented."""
+"""Good fixture: every emitted kind and key is documented; a helper
+splatting its **kwargs is opaque, so the documented `chips` it may
+carry is never reported as dead (GS304 regression pin)."""
 
 
 class Sim:
@@ -7,3 +9,6 @@ class Sim:
         extra["track"] = "pod0"
         metrics.event("start", 0.0, None, chips=4, **extra)
         metrics.event("finish", 1.0, None, end_state="done")
+
+    def note(self, metrics, **extra):
+        metrics.event("note", 2.0, None, a=1, **extra)

@@ -1,0 +1,5 @@
+"""Fixture recovery model (clean)."""
+
+
+class RecoveryModel:
+    pass

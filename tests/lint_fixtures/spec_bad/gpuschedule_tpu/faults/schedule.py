@@ -1,0 +1,17 @@
+"""Bad fixture: a stale spec row, an unreachable field, and two rotten
+allowlist rows."""
+
+_SPEC_KEYS = {
+    "mtbf": ("config", "mtbf"),
+    "ghost": ("config", "ghost_knob"),
+}
+
+_UNSPECCED = {
+    "mtbf": "",
+    "phantom": "never existed",
+}
+
+
+class FaultConfig:
+    mtbf: float = 0.0
+    silent: float = 1.0

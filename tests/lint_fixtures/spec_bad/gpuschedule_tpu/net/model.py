@@ -1,0 +1,9 @@
+"""Fixture net config (clean)."""
+
+_SPEC_KEYS = {
+    "os": "oversubscription",
+}
+
+
+class NetConfig:
+    oversubscription: float = 4.0

@@ -1,0 +1,5 @@
+"""Good fixture recovery model."""
+
+
+class RecoveryModel:
+    restore: float = 0.0

@@ -1,0 +1,16 @@
+"""Good fixture: every config field is spec-reachable or allowlisted."""
+
+_SPEC_KEYS = {
+    "mtbf": ("config", "mtbf"),
+    "restore": ("recovery", "restore"),
+    "domain_host": ("weight", "host"),
+}
+
+_UNSPECCED = {
+    "domain_weights": "populated by the weight keys",
+}
+
+
+class FaultConfig:
+    mtbf: float = 0.0
+    domain_weights: dict = None

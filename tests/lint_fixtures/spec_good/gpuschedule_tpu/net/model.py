@@ -1,0 +1,9 @@
+"""Good fixture net config."""
+
+_SPEC_KEYS = {
+    "os": "oversubscription",
+}
+
+
+class NetConfig:
+    oversubscription: float = 4.0

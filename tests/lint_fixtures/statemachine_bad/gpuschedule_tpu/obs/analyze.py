@@ -1,0 +1,22 @@
+"""Fixture analyzer with dead armor: cutoff-from-suspended and the
+whole resize rule are producible by no emit site."""
+
+QUEUED, RUNNING, SUSPENDED = "queued", "running", "suspended"
+
+_LEGAL_FROM = {
+    "start": (QUEUED, SUSPENDED),
+    "preempt": (RUNNING,),
+    "finish": (RUNNING,),
+    "cutoff": (RUNNING, SUSPENDED),
+    "resize": (RUNNING,),
+}
+
+
+def analyze(events):
+    for ev in events:
+        kind = ev.get("event")
+        if kind == "arrival":
+            continue
+        legal = _LEGAL_FROM.get(kind)
+        if legal is None:
+            raise ValueError(kind)

@@ -1,0 +1,29 @@
+"""Bad fixture: a guard admitting a state the analyzer rejects, a
+per-job kind the table doesn't know, an unresolvable context, and
+(analyzer-side) armor no emit site can produce."""
+
+from gpuschedule_tpu.sim.job import JobState
+
+
+class Sim:
+    def starter(self, job, metrics):
+        if job.state not in (JobState.PENDING, JobState.SUSPENDED):
+            raise RuntimeError("bad")
+        metrics.event("start", 0.0, job, chips=2)
+
+    def preempt(self, job, metrics):
+        if job.state not in (JobState.RUNNING, JobState.PENDING):
+            raise RuntimeError("bad")
+        metrics.event("preempt", 1.0, job, suspend=True)
+
+    def zap(self, job, metrics):
+        if job.state is not JobState.RUNNING:
+            raise RuntimeError("bad")
+        metrics.event("zap", 2.0, job, boom=1)
+
+    def weird(self, job, metrics):
+        metrics.event("finish", 3.0, job, end_state="done")
+
+    def horizon(self, metrics):
+        for job in self.running:
+            metrics.event("cutoff", 4.0, job, chips=2)

@@ -1,0 +1,8 @@
+"""Fixture job states."""
+
+
+class JobState:
+    PENDING = "pending"
+    RUNNING = "running"
+    SUSPENDED = "suspended"
+    DONE = "done"

@@ -1,0 +1,20 @@
+"""Fixture analyzer: the transition table plus pre-table dispatch."""
+
+QUEUED, RUNNING, SUSPENDED = "queued", "running", "suspended"
+
+_LEGAL_FROM = {
+    "start": (QUEUED, SUSPENDED),
+    "preempt": (RUNNING,),
+    "finish": (RUNNING,),
+    "cutoff": (RUNNING, QUEUED, SUSPENDED),
+}
+
+
+def analyze(events):
+    for ev in events:
+        kind = ev.get("event")
+        if kind == "arrival":
+            continue
+        legal = _LEGAL_FROM.get(kind)
+        if legal is None:
+            raise ValueError(kind)

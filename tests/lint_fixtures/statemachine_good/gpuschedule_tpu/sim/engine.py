@@ -1,0 +1,35 @@
+"""Good fixture: every emit context derives from a guard, a membership
+loop, caller propagation, or an annotation — and matches the table."""
+
+from gpuschedule_tpu.sim.job import JobState
+
+
+class Sim:
+    def try_start(self, job, metrics):
+        if job.state not in (JobState.PENDING, JobState.SUSPENDED):
+            raise RuntimeError("bad")
+        job.state = JobState.RUNNING
+        metrics.event("start", 0.0, job, chips=2)
+
+    def preempt(self, job, metrics):
+        if job.state is not JobState.RUNNING:
+            raise RuntimeError("bad")
+        metrics.event("preempt", 1.0, job, suspend=True)
+
+    def admit(self, job, metrics):
+        metrics.event("arrival", 0.0, job, chips=2)
+
+    def horizon(self, metrics):
+        for job in self.running:
+            metrics.event("cutoff", 2.0, job, chips=2)
+        for job in self.pending:
+            metrics.event("cutoff", 2.0, job, chips=0)
+
+    def sweep(self, metrics):
+        # lint: job-states[running] fixture membership annotation
+        victims = self.lookup()
+        for job in victims:
+            self._finish(job, metrics)
+
+    def _finish(self, job, metrics):
+        metrics.event("finish", 3.0, job, end_state="done")

@@ -1,6 +1,6 @@
-"""Contract-linter tests (ISSUE 13).
+"""Contract-linter tests (ISSUE 13, whole-program upgrade ISSUE 14).
 
-Three layers:
+Four layers:
 
 1. **Fixture pairs** — each rule family fires on its bad fixture with
    exact finding counts, codes, and locations, and stays silent on the
@@ -8,13 +8,18 @@ Three layers:
 2. **Determinism** — two runs over the same tree render byte-identical
    JSON (the report is diffable and history-store-worthy).
 3. **The tier-1 repo gate** — the full linter over THIS checkout must
-   be clean against tools/lint_baseline.json, mirroring the
-   check_overhead.py / engine_bench.py gate pattern.  A new violation
-   anywhere in the package fails this test until fixed, pragma'd with
-   a reason, or baselined with a justification.
+   be clean against tools/lint_baseline.json, and the gate script must
+   finish inside its wall-time budget.
+4. **Mutation kills** — seeded single-line mutations of the REAL tree
+   (drop a ``_LEGAL_FROM`` entry, widen an emit guard, add an unhashed
+   SPEC key, rename a counter, delete a documented payload-cell key)
+   each produce exactly the expected new finding, proving the
+   whole-program rules are non-vacuous outside the fixtures.
 """
 
 import json
+import re
+import shutil
 import subprocess
 import sys
 from pathlib import Path
@@ -22,7 +27,12 @@ from pathlib import Path
 import pytest
 
 from gpuschedule_tpu.cli import main as cli_main
-from gpuschedule_tpu.lint import LintConfig, load_baseline, run_lint
+from gpuschedule_tpu.lint import (
+    LintConfig,
+    load_baseline,
+    registered_codes,
+    run_lint,
+)
 
 REPO = Path(__file__).resolve().parent.parent
 FIXTURES = REPO / "tests" / "lint_fixtures"
@@ -46,6 +56,9 @@ def test_determinism_good_is_silent():
 def test_determinism_bad_fires_each_subrule():
     r = run_lint(FIXTURES / "determinism_bad")
     assert _codes(r) == [
+        ("GS103", "gpuschedule_tpu/sim/cross.py", 13),
+        ("GS103", "gpuschedule_tpu/sim/cross.py", 15),
+        ("GS103", "gpuschedule_tpu/sim/cross.py", 17),
         ("GS101", "gpuschedule_tpu/sim/replay.py", 10),
         ("GS102", "gpuschedule_tpu/sim/replay.py", 11),
         ("GS103", "gpuschedule_tpu/sim/replay.py", 13),
@@ -54,6 +67,10 @@ def test_determinism_bad_fires_each_subrule():
     ]
     details = [f.detail for f in r.findings]
     assert details == [
+        # cross-module provenance (ISSUE 14): an imported module-level
+        # set, a set-returning imported function, a self attr bound
+        # from one — built in cluster/, iterated in sim/
+        "MEMBERS", "victim_ids()", "self.targets",
         "time.time", "random.random", "order", "datetime.datetime.now",
         "members",
     ]
@@ -90,18 +107,46 @@ def test_schema_good_is_silent():
     assert r.findings == []
 
 
-def test_schema_bad_drifts_both_directions():
+def test_schema_bad_drifts_all_four_directions():
     r = run_lint(FIXTURES / "schema_bad")
     assert _codes(r) == [
         ("GS302", "docs/events.md", 0),
-        ("GS303", "gpuschedule_tpu/sim/engine.py", 9),
-        ("GS301", "gpuschedule_tpu/sim/engine.py", 10),
+        ("GS304", "docs/events.md", 0),
         ("GS303", "gpuschedule_tpu/sim/engine.py", 10),
+        ("GS301", "gpuschedule_tpu/sim/engine.py", 11),
+        ("GS303", "gpuschedule_tpu/sim/engine.py", 12),
     ]
     details = {f.detail for f in r.findings}
     assert details == {
-        "kind:ghost", "key:start.warp", "kind:mystery", "key:mystery.blob",
+        "kind:ghost",        # documented, never emitted
+        "key:stop.chips",    # documented in stop's cell, never produced
+        "key:start.warp",    # emitted, undocumented anywhere
+        "kind:mystery",      # whole kind undocumented (keys subsumed)
+        "key:stop.speed",    # per-kind: documented for start, not stop
     }
+
+
+def test_statemachine_good_is_silent():
+    r = run_lint(FIXTURES / "statemachine_good")
+    assert r.findings == []
+
+
+def test_statemachine_bad_fires_both_directions_and_unresolved():
+    r = run_lint(FIXTURES / "statemachine_bad")
+    assert _codes(r) == [
+        ("GS702", "gpuschedule_tpu/obs/analyze.py", 10),
+        ("GS702", "gpuschedule_tpu/obs/analyze.py", 11),
+        ("GS701", "gpuschedule_tpu/sim/engine.py", 17),
+        ("GS701", "gpuschedule_tpu/sim/engine.py", 22),
+        ("GS703", "gpuschedule_tpu/sim/engine.py", 25),
+    ]
+    assert [f.detail for f in r.findings] == [
+        "cutoff:suspended",   # armor no emit site can produce
+        "kind:resize",        # whole rule dead
+        "preempt:queued",     # guard admits a state the table rejects
+        "kind:zap",           # per-job kind unknown to the analyzer
+        "finish@weird",       # unresolvable context: annotate
+    ]
 
 
 def test_confighash_good_is_silent():
@@ -119,23 +164,52 @@ def test_confighash_bad_uncovered_stale_and_unjustified():
     assert [f.detail for f in r.findings] == ["mystery_knob", "ghost", "out"]
 
 
+def test_spec_good_is_silent():
+    r = run_lint(FIXTURES / "spec_good")
+    assert r.findings == []
+
+
+def test_spec_bad_unreachable_stale_and_rotten_allowlist():
+    r = run_lint(FIXTURES / "spec_bad")
+    assert _codes(r) == [
+        ("GS405", "gpuschedule_tpu/faults/schedule.py", 6),
+        ("GS406", "gpuschedule_tpu/faults/schedule.py", 10),
+        ("GS406", "gpuschedule_tpu/faults/schedule.py", 10),
+        ("GS406", "gpuschedule_tpu/faults/schedule.py", 11),
+        ("GS404", "gpuschedule_tpu/faults/schedule.py", 17),
+    ]
+    assert [f.detail for f in r.findings] == [
+        "ghost->FaultConfig.ghost_knob",   # row targets no declared field
+        "mtbf:stale",                      # allowlisted AND spec-covered
+        "mtbf:unjustified",                # empty reason
+        "phantom:stale",                   # names no field at all
+        "FaultConfig.silent",              # field escapes the spec surface
+    ]
+
+
 def test_cache_good_is_silent():
     r = run_lint(FIXTURES / "cache_good")
     assert r.findings == []
 
 
-def test_cache_bad_dead_counter_shed_drift_and_doc_drift():
+def test_cache_bad_dead_counter_shed_drift_meta_and_doc_drift():
     r = run_lint(FIXTURES / "cache_bad")
     assert _codes(r) == [
-        ("GS502", "gpuschedule_tpu/sim/caches.py", 6),
-        ("GS501", "gpuschedule_tpu/sim/caches.py", 21),
-        ("GS503", "gpuschedule_tpu/sim/caches.py", 21),
-        ("GS502", "gpuschedule_tpu/sim/caches.py", 24),
+        ("GS502", "gpuschedule_tpu/sim/caches.py", 8),
+        ("GS501", "gpuschedule_tpu/sim/caches.py", 23),
+        ("GS503", "gpuschedule_tpu/sim/caches.py", 23),
+        ("GS502", "gpuschedule_tpu/sim/caches.py", 36),
+        ("GS502", "gpuschedule_tpu/sim/caches.py", 47),
     ]
     details = [f.detail for f in r.findings]
     assert details == [
-        "Engine:_memo:unshed", "dark_cache.miss", "dark_cache",
+        "Engine:_memo:unshed",
+        # class-qualified (ISSUE 14): Unrelated's same-named increment
+        # no longer masks Engine's dead counter
+        "dark_cache.miss",
+        "dark_cache",
         "Other:undeclared",
+        "Versioned:_ghost:meta-stale",
     ]
 
 
@@ -210,6 +284,27 @@ def test_cli_lint_refuses_wrong_root(tmp_path):
         cli_main(["lint", "--root", str(tmp_path)])  # exists, no package
 
 
+def test_nested_fixture_trees_are_excluded_from_the_walk(tmp_path):
+    """ISSUE 14 satellite: a tests/ (or lint_fixtures/) subtree INSIDE
+    the scanned package is never linted as product code — a fixture
+    full of deliberate violations must not pollute a --root run."""
+    pkg = tmp_path / "gpuschedule_tpu"
+    (pkg / "tests" / "lint_fixtures" / "gpuschedule_tpu" / "sim").mkdir(
+        parents=True
+    )
+    (pkg / "__init__.py").write_text("")
+    (pkg / "util.py").write_text("X = 1\n")
+    (pkg / "tests" / "lint_fixtures" / "gpuschedule_tpu" / "sim"
+     / "bad.py").write_text(
+        "import random\n\n\n"
+        "def f(seed):\n"
+        "    return random.Random(f\"{seed}:rogue\")\n"
+    )
+    r = run_lint(tmp_path)
+    assert r.files_scanned == 2
+    assert r.findings == []
+
+
 # --------------------------------------------------------------------- #
 # 2. determinism of the report itself
 
@@ -220,7 +315,7 @@ def test_report_json_is_byte_identical_across_runs():
     assert a == b
     doc = json.loads(a)
     assert doc["ok"] is False
-    assert doc["codes"] == {"GS101": 2, "GS102": 1, "GS103": 2}
+    assert doc["codes"] == {"GS101": 2, "GS102": 1, "GS103": 5}
 
 
 def test_repo_report_json_is_byte_identical_across_runs():
@@ -244,7 +339,8 @@ def test_repo_tree_is_clean():
     # non-vacuity: the suppression surfaces are genuinely exercised
     assert r.baselined > 0
     assert r.allowed > 0
-    assert r.rules_run >= 8
+    assert r.rules_run >= 10
+    assert r.rules >= 25          # distinct enforced GS codes
     assert r.files_scanned > 50
 
 
@@ -279,10 +375,14 @@ def test_cli_lint_history_row(tmp_path, capsys):
     assert len(rows) == 1
     assert rows[0].metrics["ok"] == 1
     assert rows[0].metrics["findings"] == 0
+    # coverage trend (ISSUE 14): the enforced-code count rides history
+    assert rows[0].metrics["rules"] == len(registered_codes())
+    assert rows[0].metrics["rules"] >= 25
 
 
 def test_contract_lint_gate_script():
-    """tools/contract_lint.py end-to-end: clean tree, JSON on stdout."""
+    """tools/contract_lint.py end-to-end: clean tree, JSON on stdout,
+    per-rule timings present, whole pass inside the wall-time budget."""
     proc = subprocess.run(
         [sys.executable, str(REPO / "tools" / "contract_lint.py")],
         capture_output=True, text=True, cwd=REPO,
@@ -291,3 +391,233 @@ def test_contract_lint_gate_script():
     doc = json.loads(proc.stdout.strip().splitlines()[-1])
     assert doc["ok"] is True
     assert doc["findings"] == []
+    timing = doc["timing"]
+    assert timing["within_budget"] is True
+    assert timing["total_s"] <= timing["budget_s"]
+    assert timing["rules"]                       # per-rule breakdown
+    assert "state_machine_conformance" in timing["rules"]
+
+
+# --------------------------------------------------------------------- #
+# 4. mutation kills: the whole-program rules are non-vacuous on the
+#    REAL tree, not just on fixtures (ISSUE 14 satellite)
+
+
+@pytest.fixture(scope="module")
+def mutation_tree(tmp_path_factory):
+    """A writable copy of the real package + docs + baseline +
+    fixtures, shared by every mutation test (each restores what it
+    mutates)."""
+    tree = tmp_path_factory.mktemp("mutation_tree")
+    ignore = shutil.ignore_patterns("__pycache__")
+    shutil.copytree(REPO / "gpuschedule_tpu", tree / "gpuschedule_tpu",
+                    ignore=ignore)
+    shutil.copytree(REPO / "docs", tree / "docs", ignore=ignore)
+    shutil.copytree(FIXTURES, tree / "tests" / "lint_fixtures",
+                    ignore=ignore)
+    (tree / "tools").mkdir()
+    shutil.copy(REPO / "tools" / "lint_baseline.json",
+                tree / "tools" / "lint_baseline.json")
+    return tree
+
+
+def _tree_findings(tree):
+    bl = load_baseline(tree / "tools" / "lint_baseline.json")
+    r = run_lint(tree, baseline=bl)
+    return [(f.code, f.detail) for f in r.findings]
+
+
+def _assert_mutation_yields(tree, rel, old, new, expected):
+    p = tree / rel
+    orig = p.read_text()
+    assert old in orig, f"mutation anchor not found in {rel}: {old!r}"
+    p.write_text(orig.replace(old, new, 1))
+    try:
+        assert _tree_findings(tree) == expected
+    finally:
+        p.write_text(orig)
+
+
+def test_mutation_tree_is_clean_unmutated(mutation_tree):
+    assert _tree_findings(mutation_tree) == []
+
+
+def test_gs7xx_kills_removal_of_every_single_legal_from_entry(
+    mutation_tree,
+):
+    """Acceptance: dropping ANY single ``_LEGAL_FROM`` entry yields
+    exactly one new finding — the engine still emits that kind, so the
+    table hole is a future stream error."""
+    path = mutation_tree / "gpuschedule_tpu" / "obs" / "analyze.py"
+    text = path.read_text()
+    rows = re.findall(r'^    "(\w+)": \([A-Z, ]+\),\n', text, flags=re.M)
+    assert len(rows) >= 12, rows
+    for kind in rows:
+        mutated = re.sub(
+            rf'^    "{kind}": \([A-Z, ]+\),\n', "", text, count=1,
+            flags=re.M,
+        )
+        path.write_text(mutated)
+        try:
+            assert _tree_findings(mutation_tree) == [
+                ("GS701", f"kind:{kind}")
+            ], f"removing _LEGAL_FROM[{kind!r}] was not killed"
+        finally:
+            path.write_text(text)
+
+
+def test_gs7xx_kills_single_state_removal_from_an_entry(mutation_tree):
+    # cutoff loses its suspended leg: _close_attribution still emits
+    # cutoff for suspended jobs in the pending set
+    _assert_mutation_yields(
+        mutation_tree, "gpuschedule_tpu/obs/analyze.py",
+        '"cutoff": (RUNNING, QUEUED, SUSPENDED),',
+        '"cutoff": (RUNNING, QUEUED),',
+        [("GS701", "cutoff:suspended")],
+    )
+
+
+def test_gs7xx_kills_engine_emit_guard_widening(mutation_tree):
+    # the engine-side direction: preempt's guard suddenly admits queued
+    # jobs — a state the analyzer's table rejects
+    _assert_mutation_yields(
+        mutation_tree, "gpuschedule_tpu/sim/engine.py",
+        'if job.state is not JobState.RUNNING:\n'
+        '            raise RuntimeError(f"preempt on non-running job {job!r}")',
+        'if job.state not in (JobState.RUNNING, JobState.PENDING):\n'
+        '            raise RuntimeError(f"preempt on non-running job {job!r}")',
+        [("GS701", "preempt:queued")],
+    )
+
+
+def test_gs7xx_kills_dead_armor_direction(mutation_tree):
+    # _close_attribution stops visiting the pending set: the table's
+    # cutoff-from-queued/suspended legs become unproducible armor
+    _assert_mutation_yields(
+        mutation_tree, "gpuschedule_tpu/sim/engine.py",
+        "for job in self.pending:\n            if job.blame_cause is None:",
+        "for job in self.running:\n            if job.blame_cause is None:",
+        [("GS702", "cutoff:queued"), ("GS702", "cutoff:suspended")],
+    )
+
+
+def test_gs4xx_kills_added_unhashed_spec_key(mutation_tree):
+    _assert_mutation_yields(
+        mutation_tree, "gpuschedule_tpu/faults/schedule.py",
+        '    "mtbf": ("config", "mtbf"),',
+        '    "mtbf": ("config", "mtbf"),\n'
+        '    "ghost": ("config", "ghost_knob"),',
+        [("GS405", "ghost->FaultConfig.ghost_knob")],
+    )
+
+
+def test_gs4xx_kills_config_field_escaping_the_spec_surface(mutation_tree):
+    _assert_mutation_yields(
+        mutation_tree, "gpuschedule_tpu/faults/schedule.py",
+        "    hazard_shape: float = 1.0",
+        "    hazard_shape: float = 1.0\n    ghost_knob: float = 0.0",
+        [("GS404", "FaultConfig.ghost_knob")],
+    )
+
+
+def test_gs501_kills_counter_rename(mutation_tree):
+    _assert_mutation_yields(
+        mutation_tree, "gpuschedule_tpu/net/model.py",
+        "self.flow_reuses += 1",
+        "self.flow_reuse += 1",
+        [("GS501", "net_flows.hit")],
+    )
+
+
+def test_gs303_kills_payload_cell_key_removal(mutation_tree):
+    # per-kind enforcement: `prog` stays documented in OTHER rows, but
+    # deleting it from the speed row alone is a violation
+    _assert_mutation_yields(
+        mutation_tree, "docs/events.md",
+        "| `speed` | `speed`, `prog`, [`why`] |",
+        "| `speed` | `speed`, [`why`] |",
+        [("GS303", "key:speed.prog")],
+    )
+
+
+# --------------------------------------------------------------------- #
+# lint --update-baseline (ISSUE 14 satellite)
+
+
+def test_update_baseline_rewrites_deterministically(mutation_tree):
+    engine = mutation_tree / "gpuschedule_tpu" / "net" / "model.py"
+    baseline = mutation_tree / "tools" / "lint_baseline.json"
+    orig_engine = engine.read_text()
+    orig_baseline = baseline.read_text()
+    engine.write_text(
+        orig_engine.replace("self.flow_reuses += 1",
+                            "self.flow_reuse += 1", 1)
+    )
+    try:
+        assert cli_main([
+            "lint", "--root", str(mutation_tree), "--update-baseline",
+        ]) == 0
+        doc = json.loads(baseline.read_text())
+        entries = {(e["code"], e["detail"]): e["justification"]
+                   for e in doc["entries"]}
+        # the new finding landed with the explicit edit-me placeholder
+        assert ("GS501", "net_flows.hit") in entries
+        assert entries[("GS501", "net_flows.hit")].startswith("UNJUSTIFIED")
+        # pre-existing entries kept their human-written justifications
+        assert ("GS101", "time.monotonic") in entries
+        assert "worker-pool" in entries[("GS101", "time.monotonic")]
+        # sorted fingerprints: rewriting is byte-stable
+        first = baseline.read_text()
+        assert cli_main([
+            "lint", "--root", str(mutation_tree), "--update-baseline",
+        ]) == 0
+        assert baseline.read_text() == first
+        # and the gate is green against the rewritten baseline
+        assert cli_main(["lint", "--root", str(mutation_tree)]) == 0
+    finally:
+        engine.write_text(orig_engine)
+        baseline.write_text(orig_baseline)
+
+
+def test_update_baseline_creates_a_new_baseline_path(mutation_tree, tmp_path):
+    # --update-baseline may CREATE the file --baseline points at; every
+    # other mode still refuses a missing explicit baseline
+    target = tmp_path / "fresh_baseline.json"
+    assert cli_main([
+        "lint", "--root", str(mutation_tree),
+        "--baseline", str(target), "--update-baseline",
+    ]) == 0
+    doc = json.loads(target.read_text())
+    # a fresh path starts from zero old entries: the tree's three
+    # known-baselined findings land with UNJUSTIFIED placeholders
+    assert sorted(e["code"] for e in doc["entries"]) == [
+        "GS101", "GS304", "GS304",
+    ]
+    assert all(e["justification"].startswith("UNJUSTIFIED")
+               for e in doc["entries"])
+    with pytest.raises(SystemExit, match="baseline not found"):
+        cli_main(["lint", "--root", str(mutation_tree),
+                  "--baseline", str(tmp_path / "still_missing.json")])
+
+
+def test_update_baseline_refuses_codes_without_fixtures(mutation_tree):
+    engine = mutation_tree / "gpuschedule_tpu" / "net" / "model.py"
+    fixtures = mutation_tree / "tests" / "lint_fixtures"
+    moved = mutation_tree / "tests" / "_parked"
+    baseline = mutation_tree / "tools" / "lint_baseline.json"
+    orig_engine = engine.read_text()
+    orig_baseline = baseline.read_text()
+    engine.write_text(
+        orig_engine.replace("self.flow_reuses += 1",
+                            "self.flow_reuse += 1", 1)
+    )
+    fixtures.rename(moved)  # no fixtures -> nothing is baselinable
+    try:
+        with pytest.raises(SystemExit, match="zero fixtures"):
+            cli_main([
+                "lint", "--root", str(mutation_tree), "--update-baseline",
+            ])
+        assert baseline.read_text() == orig_baseline  # nothing written
+    finally:
+        moved.rename(fixtures)
+        engine.write_text(orig_engine)

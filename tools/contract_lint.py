@@ -1,16 +1,23 @@
-"""Contract-linter CI gate (ISSUE 13 satellite).
+"""Contract-linter CI gate (ISSUE 13; timing budget ISSUE 14).
 
 Mirrors the ``check_overhead.py`` / ``engine_bench.py`` gate pattern:
-run the full contract linter over this checkout, print one
-deterministic JSON document, exit 0 when the tree is clean (every
-finding fixed, pragma-allowed, or baselined against
-``tools/lint_baseline.json``) and 1 otherwise.  The JSON is
-byte-identical across repeated runs on the same tree, so the artifact
+run the full contract linter over this checkout, print one JSON
+document, exit 0 when the tree is clean (every finding fixed,
+pragma-allowed, or baselined against ``tools/lint_baseline.json``) AND
+the whole pass finished inside its wall-time budget; 1 otherwise.
+
+The report fields (``ok``/``findings``/``codes``/...) are byte-identical
+across repeated runs on the same tree, so that part of the artifact
 diffs cleanly and the summary block can ride the PR-10 history store
-(``python -m gpuschedule_tpu lint --history STORE`` appends it).
+(``python -m gpuschedule_tpu lint --history STORE`` appends it).  The
+``timing`` block is the one deliberate exception — it is measurement,
+not contract: total wall seconds, the budget, and per-rule timings, so
+a symbol-table or rule regression that would slow the tier-1 gate shows
+up IN the gate instead of as mysterious CI drag.  Budget:
+``GSTPU_LINT_BUDGET_S`` (default 3.0 s; the pass runs ~1.5 s warm).
 
 Run directly, or through the tier-1 pytest wrapper
-(tests/test_contract_lint.py::test_repo_tree_is_clean):
+(tests/test_contract_lint.py::test_contract_lint_gate_script):
 
     python tools/contract_lint.py
 """
@@ -19,6 +26,7 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 from pathlib import Path
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -27,14 +35,34 @@ from gpuschedule_tpu.lint import load_baseline, run_lint
 
 ROOT = Path(__file__).resolve().parent.parent
 BASELINE = ROOT / "tools" / "lint_baseline.json"
+BUDGET_S = float(os.environ.get("GSTPU_LINT_BUDGET_S", "3.0"))
 
 
 def run_gate() -> dict:
     baseline = load_baseline(BASELINE) if BASELINE.is_file() else None
+    t0 = time.perf_counter()
     report = run_lint(ROOT, baseline=baseline)
+    total_s = time.perf_counter() - t0
     doc = report.to_json()
+    doc["timing"] = {
+        "budget_s": BUDGET_S,
+        "total_s": round(total_s, 3),
+        "within_budget": total_s <= BUDGET_S,
+        "rules": {
+            name: round(seconds, 3)
+            for name, seconds in sorted(report.timings.items())
+            if name != "total"
+        },
+    }
     for f in report.findings:
         print(f.render(), file=sys.stderr)
+    if not doc["timing"]["within_budget"]:
+        print(
+            f"contract-lint: pass took {total_s:.2f}s, over the "
+            f"{BUDGET_S:.1f}s budget (GSTPU_LINT_BUDGET_S) — the "
+            "tier-1 gate must stay fast; profile doc['timing']['rules']",
+            file=sys.stderr,
+        )
     return doc
 
 
@@ -43,4 +71,4 @@ if __name__ == "__main__":
     import json
 
     print(json.dumps(res, sort_keys=True))
-    sys.exit(0 if res["ok"] else 1)
+    sys.exit(0 if res["ok"] and res["timing"]["within_budget"] else 1)
