@@ -262,6 +262,21 @@ def _cmd_resume(args) -> int:
         # the same process-bound scope as the obs registry exception
         sim.metrics.cache_telemetry = True
         sim._cache_telemetry = True
+    if args.flush_events is not None:
+        # re-arm the tailable-sink flush cadence (ISSUE 15): the cadence
+        # is process-bound output plumbing (like the sink handle itself,
+        # deliberately not in the snapshot), so the resumed leg must
+        # re-request it — next strict multiple past the restored clock
+        if args.flush_events <= 0.0:
+            raise SystemExit(
+                f"--flush-events must be > 0 seconds, got {args.flush_events}"
+            )
+        fe = float(args.flush_events)
+        sim.metrics._flush_every = fe
+        nxt = fe * (math.floor(sim.now / fe) + 1.0)
+        while nxt <= sim.now:  # float-rounding guard
+            nxt += fe
+        sim.metrics._flush_next = nxt
     with sim.metrics:
         res = sim.run()
     print(json.dumps(res.summary(), sort_keys=True))
@@ -344,6 +359,10 @@ def cmd_run(args) -> int:
         raise SystemExit(
             f"--sample-interval must be > 0 seconds, got {args.sample_interval}"
         )
+    if args.flush_events is not None and args.flush_events <= 0.0:
+        raise SystemExit(
+            f"--flush-events must be > 0 seconds, got {args.flush_events}"
+        )
     # Attribution/sampling (ISSUE 5) are observability, not experiment
     # config: they are deliberately NOT in the config hash, so an
     # attribution-armed capture stays `compare`-compatible with (and,
@@ -355,6 +374,7 @@ def cmd_run(args) -> int:
         run_meta=run_meta,
         attribution=bool(args.attrib),
         cache_telemetry=bool(args.cache_stats),
+        flush_interval_s=args.flush_events,
     )
     # Wall-clock self-profiling (ISSUE 10): --self-profile attaches the
     # phase profiler and selects the engine's profiled loop body; the
@@ -464,12 +484,26 @@ def cmd_report(args) -> int:
             selfprof = load_profile(args.selfprof)
         except (OSError, ValueError) as e:
             raise SystemExit(str(e)) from None
+    alerts = None
+    if args.alerts:
+        # the watchtower's side stream (ISSUE 15): skip its header, keep
+        # the alert records — the report's Alerts panel input
+        from gpuschedule_tpu.obs import iter_jsonl_records
+
+        try:
+            alerts = [
+                rec for rec in iter_jsonl_records(args.alerts)
+                if rec.get("event") == "alert"
+            ]
+        except StreamError as e:
+            raise SystemExit(str(e)) from None
     try:
         analysis = analyze_file(args.events, require_header=not args.no_header,
                                 low_memory=args.low_mem)
     except (SchemaError, StreamError) as e:
         raise SystemExit(str(e)) from None
-    out = write_report(analysis, args.out, title=args.title, selfprof=selfprof)
+    out = write_report(analysis, args.out, title=args.title, selfprof=selfprof,
+                       alerts=alerts)
     if args.json:
         from pathlib import Path
 
@@ -681,6 +715,83 @@ def cmd_history(args) -> int:
     return 0
 
 
+def cmd_watch(args) -> int:
+    """Live-tail watchtower (ISSUE 15): tail an events.jsonl stream —
+    one-shot batch (default), ``--replay`` (paced as-if-live by sim
+    time), or ``--follow`` (polling a growing file) — through the
+    rolling-window detector set, printing each alert as one JSON line
+    the moment its window closes, and a final ``{"watch": ...}`` summary
+    line.  The alert sequence is byte-identical across all three modes
+    (the determinism contract, tests/test_watch.py)."""
+    from gpuschedule_tpu.obs import MetricsRegistry, StreamError
+    from gpuschedule_tpu.obs.watch import (
+        AlertStream,
+        Watcher,
+        follow_stream,
+        iter_stream,
+        load_rules,
+        replay_stream,
+        run_watch,
+    )
+
+    if args.follow and args.replay:
+        raise SystemExit("--follow and --replay are mutually exclusive")
+    if args.poll <= 0.0:
+        raise SystemExit(f"--poll must be > 0 seconds, got {args.poll}")
+    if args.speed < 0.0:
+        raise SystemExit(f"--speed must be >= 0, got {args.speed}")
+    try:
+        rules = load_rules(args.rules)
+        if args.window is not None:
+            if args.window <= 0.0:
+                raise ValueError(f"--window must be > 0, got {args.window}")
+            rules["window_s"] = float(args.window)
+        if args.ring is not None:
+            if args.ring < 1:
+                raise ValueError(f"--ring must be >= 1, got {args.ring}")
+            rules["ring"] = int(args.ring)
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+    registry = MetricsRegistry()
+    history = None
+    if args.history:
+        from gpuschedule_tpu.obs import HistoryStore
+
+        history = HistoryStore(args.history)
+    watcher = Watcher(
+        rules,
+        alerts=AlertStream(args.alerts),
+        flight_dir=args.flight_dir,
+        snapshot=args.snapshot,
+        registry=registry,
+        history=history,
+        source=str(args.events),
+    )
+    if args.follow:
+        stream = follow_stream(
+            args.events, poll_s=args.poll,
+            idle_timeout_s=args.idle_timeout, max_wall_s=args.max_wall,
+        )
+    elif args.replay:
+        stream = replay_stream(args.events, speed=args.speed)
+    else:
+        stream = iter_stream(args.events)
+    try:
+        summary = run_watch(
+            stream, watcher,
+            on_alert=lambda a: print(json.dumps(a, sort_keys=True)),
+        )
+    except StreamError as e:
+        raise SystemExit(str(e)) from None
+    finally:
+        if history is not None:
+            history.close()
+    print(json.dumps({"watch": summary}, sort_keys=True))
+    if args.prom:
+        registry.write(prom_path=args.prom)
+    return 0
+
+
 def cmd_whatif(args) -> int:
     """Interactive what-if queries against a mirrored replay (ISSUE 12):
     build the world exactly like ``run``, advance the engine to ``--at``
@@ -702,15 +813,6 @@ def cmd_whatif(args) -> int:
         parse_drain_spec,
     )
 
-    net_model = build_net(args)
-    if args.placement == "contention" and net_model is None:
-        raise SystemExit(
-            "--placement contention scores pods by residual DCN bandwidth "
-            "and needs the fabric model: add --net"
-        )
-    cluster = build_cluster(args, net=net_model)
-    jobs = load_jobs(args)
-    fault_plan = build_fault_plan(args, cluster, jobs)
     queries = []
     try:
         for spec in args.admit or []:
@@ -728,21 +830,59 @@ def cmd_whatif(args) -> int:
         )
     if args.at < 0.0:
         raise SystemExit(f"--at must be >= 0, got {args.at}")
-    # the mirror runs with attribution armed so every speculative delta
-    # decomposes by cause (the PR-5 machinery); whatif has no byte-compat
-    # surface of its own to preserve
-    metrics = MetricsLog(attribution=True)
-    try:
-        sim = Simulator(
-            cluster, build_policy(args), jobs,
-            metrics=metrics,
-            max_time=args.max_time or float("inf"),
-            faults=fault_plan,
-            net=net_model,
-            accounting=args.accounting,
-        )
-    except ValueError as e:
-        raise SystemExit(str(e)) from None
+    if args.resume:
+        # flight-recorder handshake (ISSUE 15): mirror from a pinned
+        # engine snapshot (`watch --flight-dir` + `run --snapshot`)
+        # instead of rebuilding the world — world-building flags are
+        # ignored, the snapshot IS the world.  The mirror must never
+        # write into (or truncate!) the watched run's event stream, so
+        # the sink is detached and recording disarmed.
+        from gpuschedule_tpu.sim.snapshot import SnapshotError
+
+        try:
+            sim = Simulator.restore(args.resume, events_sink=False)
+        except SnapshotError as e:
+            raise SystemExit(str(e)) from None
+        sim.metrics.record_events = False
+        sim.metrics.events = []
+        sim._snap_path = None
+        sim._snap_every = None
+        sim._snap_next = float("inf")
+        # the snapshotted run's --max-time was an output-capture cutoff,
+        # not a property of the world: speculating past the incident is
+        # the whole point, so the mirror's bound is --horizon (and an
+        # explicit --max-time on THIS invocation, when given)
+        sim.max_time = args.max_time or float("inf")
+        if args.at < sim.now:
+            raise SystemExit(
+                f"--at {args.at} is before the snapshot instant "
+                f"(t={sim.now}); pin an earlier snapshot"
+            )
+    else:
+        net_model = build_net(args)
+        if args.placement == "contention" and net_model is None:
+            raise SystemExit(
+                "--placement contention scores pods by residual DCN "
+                "bandwidth and needs the fabric model: add --net"
+            )
+        cluster = build_cluster(args, net=net_model)
+        jobs = load_jobs(args)
+        fault_plan = build_fault_plan(args, cluster, jobs)
+        # the mirror runs with attribution armed so every speculative
+        # delta decomposes by cause (the PR-5 machinery); whatif has no
+        # byte-compat surface of its own to preserve
+        metrics = MetricsLog(attribution=True)
+        try:
+            sim = Simulator(
+                cluster, build_policy(args), jobs,
+                metrics=metrics,
+                max_time=args.max_time or float("inf"),
+                faults=fault_plan,
+                net=net_model,
+                accounting=args.accounting,
+            )
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
     sim.run_until(args.at)
     # deterministic user errors must exit cleanly BEFORE evaluation — a
     # pooled worker would otherwise retry them with backoff and surface
@@ -780,17 +920,28 @@ def cmd_whatif(args) -> int:
         raise SystemExit(str(e)) from None
     finally:
         service.close()
-    chash = _run_config_hash(args)
-    run_meta = {
-        "run_id": f"{args.policy}-s{args.seed}-{chash}",
-        "seed": args.seed, "policy": args.policy, "config_hash": chash,
-    }
+    if args.resume:
+        # the mirror's identity is the snapshotted run's, not the
+        # (ignored) world flags'
+        rm = sim.metrics.run_meta or {}
+        chash = str(rm.get("config_hash") or "resumed")
+        run_meta = {
+            "run_id": str(rm.get("run_id") or f"resumed-{sim.policy.name}"),
+            "seed": rm.get("seed"), "policy": sim.policy.name,
+            "config_hash": chash,
+        }
+    else:
+        chash = _run_config_hash(args)
+        run_meta = {
+            "run_id": f"{args.policy}-s{args.seed}-{chash}",
+            "seed": args.seed, "policy": args.policy, "config_hash": chash,
+        }
     doc = jsonable({
         "at_s": sim.now,
         "requested_at_s": args.at,
         "horizon_s": args.horizon,
         "pool": args.pool,
-        "policy": args.policy,
+        "policy": run_meta["policy"],
         "run_id": run_meta["run_id"],
         "config_hash": chash,
         "mirror": {
@@ -1607,6 +1758,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                           "history store at STORE (created if missing), "
                           "keyed by run_id/config_hash — `history trend` "
                           "renders trajectories across invocations")
+    run.add_argument("--flush-events", type=float, default=None,
+                     metavar="SECONDS",
+                     help="tailable-sink flush cadence (ISSUE 15): flush "
+                          "the --events stream to disk at least every "
+                          "SECONDS of sim time, so `watch --follow` is "
+                          "never more than one interval behind the "
+                          "replay.  Default: 512-record batching only "
+                          "(byte-identical to the historical writer)")
     run.set_defaults(fn=cmd_run)
 
     wi = sub.add_parser(
@@ -1621,6 +1780,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="sim time to mirror the world at: the engine "
                          "replays to the last batch at or before this "
                          "instant and pauses there")
+    wi.add_argument("--resume", metavar="SNAPSHOT",
+                    help="mirror from an engine snapshot (e.g. a flight-"
+                         "recorder pin from `watch --flight-dir`) instead "
+                         "of rebuilding the world: restore, replay "
+                         "forward to --at, and serve queries there.  "
+                         "World-building flags are ignored — the "
+                         "snapshot is the world (ISSUE 15)")
     wi.add_argument("--horizon", type=float, default=86_400.0,
                     metavar="SECONDS",
                     help="bounded speculative-replay horizon per query "
@@ -1758,7 +1924,72 @@ def main(argv: Optional[List[str]] = None) -> int:
                      help="fold a `run --self-profile` document into the "
                           "report's Engine-health panel (wall-clock "
                           "phase stacked bar)")
+    rep.add_argument("--alerts", metavar="ALERTS_JSONL",
+                     help="fold a `watch --alerts` side stream into the "
+                          "report: timeline ticks on the occupancy chart "
+                          "plus a per-detector Alerts panel")
     rep.set_defaults(fn=cmd_report)
+
+    wt = sub.add_parser(
+        "watch",
+        help="live-tail watchtower (ISSUE 15): stream an events.jsonl "
+             "through rolling-window detectors (queue-depth surge, "
+             "goodput collapse, fragmentation creep, hazard spike, "
+             "multi-window SLO burn rate), emitting schema-additive "
+             "alert records, history rows, and watch_alerts_total "
+             "counters, with a flight recorder for whatif replay",
+    )
+    wt.add_argument("--events", required=True, metavar="EVENTS_JSONL",
+                    help="the stream to watch (written by `run --events`; "
+                         ".gz accepted in batch/--replay modes)")
+    wt.add_argument("--follow", action="store_true",
+                    help="tail a GROWING file: poll for appends, retain "
+                         "mid-record truncated tails until the writer "
+                         "completes them")
+    wt.add_argument("--replay", action="store_true",
+                    help="pace a finished stream as-if-live by sim time "
+                         "(deterministic: any --speed yields the batch "
+                         "mode's exact alert sequence)")
+    wt.add_argument("--rules", metavar="RULES_JSON",
+                    help="declarative detector config overlaying the "
+                         "defaults (obs/watch.py DEFAULT_RULES); unknown "
+                         "detectors/keys are rejected")
+    wt.add_argument("--window", type=float, metavar="SECONDS",
+                    help="detector window length (overrides rules)")
+    wt.add_argument("--ring", type=int, metavar="N",
+                    help="flight-recorder ring size in raw events "
+                         "(overrides rules)")
+    wt.add_argument("--alerts", metavar="PATH",
+                    help="write the alert side stream here (JSONL behind "
+                         "its own versioned header; see docs/events.md)")
+    wt.add_argument("--flight-dir", metavar="DIR",
+                    help="flight recorder: on each alert, dump the last "
+                         "--ring raw events (and pin the watched run's "
+                         "newest --snapshot engine state) into DIR")
+    wt.add_argument("--snapshot", metavar="PATH",
+                    help="the watched run's `--snapshot` file: each alert "
+                         "pins a copy (plus its .meta.json sim-time "
+                         "sidecar) so `whatif --resume` replays the "
+                         "minutes before the incident")
+    wt.add_argument("--history", metavar="STORE",
+                    help="append one history row per alert (kind 'watch', "
+                         "label = detector) to the sqlite store")
+    wt.add_argument("--prom", metavar="PATH",
+                    help="write watch_alerts_total{detector} in the "
+                         "Prometheus text exposition format")
+    wt.add_argument("--speed", type=float, default=0.0, metavar="X",
+                    help="--replay pacing: X sim seconds per wall second "
+                         "(0 = no pacing, the default)")
+    wt.add_argument("--poll", type=float, default=0.5, metavar="SECONDS",
+                    help="--follow poll interval (wall)")
+    wt.add_argument("--idle-timeout", type=float, default=None,
+                    metavar="SECONDS",
+                    help="--follow: stop after this long without growth "
+                         "(default: tail forever)")
+    wt.add_argument("--max-wall", type=float, default=None,
+                    metavar="SECONDS",
+                    help="--follow: hard wall-clock stop")
+    wt.set_defaults(fn=cmd_watch)
 
     cmpr = sub.add_parser(
         "compare",

@@ -1297,6 +1297,12 @@ class TpuCluster(OverlayMixin, ClusterBase):
         # fragmentation() would re-run the identical descents.
         pods = []
         largest = 0
+        # per-pod hazard scores ride the sample only when a hazard model
+        # is bound (ISSUE 15 satellite): the watchtower's hazard-spike
+        # detector and the Perfetto health counter track read risk
+        # straight from the stream instead of re-deriving it from fault
+        # records; hazard-free runs keep byte-identical sample payloads
+        hazard_armed = getattr(self, "_hazard_model", None) is not None
         for p in range(self.num_pods):
             free_p = self.pod_free_chips(p)
             box = (
@@ -1305,10 +1311,13 @@ class TpuCluster(OverlayMixin, ClusterBase):
                 )
                 if free_p else 0
             )
-            pods.append({
+            entry = {
                 "used": self.pod_used_chips(p),
                 "frag": 1.0 - box / free_p if free_p else 0.0,
-            })
+            }
+            if hazard_armed:
+                entry["hazard"] = self.hazard_score(("pod", p))
+            pods.append(entry)
             largest = max(largest, box)
         free = self.free_chips
         if free == 0:

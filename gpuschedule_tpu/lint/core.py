@@ -79,15 +79,23 @@ class LintConfig:
     exclude_dirs: Tuple[str, ...] = ("tests", "lint_fixtures")
     # rule GS1xx: modules whose replay semantics must be deterministic
     determinism_dirs: Tuple[str, ...] = ("sim", "net", "faults", "cluster")
+    # ...plus individual files outside those dirs whose OUTPUT must be a
+    # pure function of the stream they read: the watchtower's alert
+    # sequence is a determinism contract (ISSUE 15), so its wall-clock
+    # reads (follow-mode polling) carry reasoned pragmas like the
+    # engine's own measurement sites
+    determinism_files: Tuple[str, ...] = (f"{PACKAGE}/obs/watch.py",)
     # rule GS3xx: the event emitters and their schema document.  Every
     # path in emitter_paths is scanned for ``.event(...)`` calls — the
-    # engine is joined by the what-if and snapshot layers so a second
-    # emitter growing an event site is linted from day one (ISSUE 14)
+    # engine is joined by the what-if / snapshot layers and the
+    # watchtower's alert side stream (ISSUE 15) so a second emitter
+    # growing an event site is linted from day one (ISSUE 14)
     engine_path: str = f"{PACKAGE}/sim/engine.py"
     emitter_paths: Tuple[str, ...] = (
         f"{PACKAGE}/sim/engine.py",
         f"{PACKAGE}/sim/whatif.py",
         f"{PACKAGE}/sim/snapshot.py",
+        f"{PACKAGE}/obs/watch.py",
     )
     events_doc_path: str = "docs/events.md"
     # rule GS4xx: the argparse definitions and the shared hash table;

@@ -58,7 +58,8 @@ def _target_files(ctx: LintContext) -> List[str]:
     dirs = tuple(
         f"{ctx.config.package}/{d}/" for d in ctx.config.determinism_dirs
     )
-    return [p for p in ctx.py_files if p.startswith(dirs)]
+    extras = set(getattr(ctx.config, "determinism_files", ()))
+    return [p for p in ctx.py_files if p.startswith(dirs) or p in extras]
 
 
 def _rng_violation(name: str) -> bool:

@@ -52,10 +52,23 @@ from gpuschedule_tpu.obs.analyze import (
     RunAnalysis,
     RunHeader,
     SchemaError,
+    StreamCursor,
     StreamError,
     analyze_events,
     analyze_file,
     config_hash,
+    iter_jsonl_items,
+    iter_jsonl_records,
+)
+from gpuschedule_tpu.obs.watch import (
+    DEFAULT_RULES,
+    AlertStream,
+    Watcher,
+    follow_stream,
+    iter_stream,
+    load_rules,
+    replay_stream,
+    run_watch,
 )
 from gpuschedule_tpu.obs.compare import (
     CompareResult,
@@ -97,10 +110,21 @@ __all__ = [
     "RunAnalysis",
     "RunHeader",
     "SchemaError",
+    "StreamCursor",
     "StreamError",
     "analyze_events",
     "analyze_file",
     "config_hash",
+    "iter_jsonl_items",
+    "iter_jsonl_records",
+    "DEFAULT_RULES",
+    "AlertStream",
+    "Watcher",
+    "follow_stream",
+    "iter_stream",
+    "load_rules",
+    "replay_stream",
+    "run_watch",
     "CompareResult",
     "MatrixResult",
     "compare_matrix",

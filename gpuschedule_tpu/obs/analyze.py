@@ -85,6 +85,115 @@ class StreamError(ValueError):
     transition, non-monotonic time, or two concatenated runs."""
 
 
+class StreamCursor:
+    """Incremental JSONL ingestion (ISSUE 15): feed text chunks as they
+    arrive and get back complete parsed records.
+
+    The one invariant that makes a *growing* file tailable: a trailing
+    line that has not yet received its newline is **retained, not
+    parsed and not skipped** — mid-record truncation is the normal state
+    of a stream another process is still appending to, so the fragment
+    waits in the cursor and is re-read whole once the writer completes
+    it.  A *complete* line that fails to parse is corruption and raises
+    :class:`StreamError` immediately.
+
+    One cursor serves every ingestion mode: ``analyze_file`` (one-shot,
+    both memory modes) drives it to :meth:`finish`, where a leftover
+    fragment IS corruption; ``watch --follow`` feeds whatever bytes the
+    poll loop found and simply keeps going.
+
+    Yields ``(lineno, raw_line, record)`` tuples so tailing consumers
+    (the watchtower's flight recorder) can keep the writer's exact bytes
+    without re-serializing."""
+
+    def __init__(self, name: str = "<stream>"):
+        self.name = name
+        self.lineno = 0
+        self._pending = ""
+
+    @property
+    def pending(self) -> str:
+        """The retained (newline-less) tail fragment, if any."""
+        return self._pending
+
+    def _parse(self, line: str) -> Optional[Tuple[int, str, dict]]:
+        self.lineno += 1
+        stripped = line.strip()
+        if not stripped:
+            return None
+        try:
+            return (self.lineno, line, json.loads(stripped))
+        except json.JSONDecodeError as e:
+            raise StreamError(
+                f"{self.name}:{self.lineno}: truncated or corrupt JSONL "
+                f"record ({e}) — was the writer killed mid-record?"
+            ) from None
+
+    def feed(self, chunk: str) -> List[Tuple[int, str, dict]]:
+        """Absorb one text chunk; return the complete records it closed.
+        One split per chunk (never a per-line re-slice of the remaining
+        buffer) keeps ingestion linear in the stream length — this is
+        the hot path of every ``analyze``/``report``/``compare``
+        invocation, not just the tail loop."""
+        out: List[Tuple[int, str, dict]] = []
+        lines = (self._pending + chunk).split("\n")
+        self._pending = lines.pop()
+        for line in lines:
+            item = self._parse(line)
+            if item is not None:
+                out.append(item)
+        return out
+
+    def finish(self, *, strict: bool = True) -> List[Tuple[int, str, dict]]:
+        """End of stream.  A retained fragment is parsed if it is a whole
+        record (the writer just never wrote the final newline); a
+        fragment that does not parse raises under ``strict`` (one-shot
+        readers: the file is truncated) and is dropped otherwise (a tail
+        the live writer never completed before the watcher gave up)."""
+        tail, self._pending = self._pending, ""
+        if not tail.strip():
+            return []
+        if strict:
+            item = self._parse(tail)
+            return [item] if item is not None else []
+        try:
+            return [x for x in (self._parse(tail),) if x is not None]
+        except StreamError:
+            return []
+
+
+def iter_jsonl_items(path) -> Iterator[Tuple[int, str, dict]]:
+    """One-shot streaming iteration over an events.jsonl(.gz) file via
+    :class:`StreamCursor` — the same incremental reader the watchtower
+    tails with, driven to completion: unreadable files and truncated or
+    corrupt records raise :class:`StreamError` (the CLI's exit-2
+    "not comparable" bucket, never a raw traceback).  Yields
+    ``(lineno, raw_line, record)`` so consumers that need the writer's
+    exact bytes (the watchtower's flight recorder) share this one
+    drive loop."""
+    opener = gzip.open if str(path).endswith(".gz") else open
+    cursor = StreamCursor(name=str(path))
+    try:
+        with opener(path, "rt") as f:
+            while True:
+                chunk = f.read(1 << 16)
+                if not chunk:
+                    break
+                for item in cursor.feed(chunk):
+                    yield item
+        for item in cursor.finish():
+            yield item
+    except (OSError, EOFError) as e:
+        # gzip corruption raises BadGzipFile (an OSError) or EOFError
+        raise StreamError(f"cannot read event stream {path}: {e}") from None
+
+
+def iter_jsonl_records(path) -> Iterator[dict]:
+    """:func:`iter_jsonl_items` without the raw-byte plumbing — the
+    record view ``analyze_file`` and the report/compare CLIs consume."""
+    return (rec for _, _, rec in iter_jsonl_items(path))
+
+
 def config_hash(config: dict) -> str:
     """Stable 12-hex-digit digest of a run configuration (sorted-key JSON
     over the given mapping).  The CLI hashes the *experiment* config —
@@ -180,6 +289,16 @@ class JobRecord:
     # seconds, adopted from event "blame" snapshots (empty when the run
     # was captured without attribution)
     delay_legs: Dict[str, float] = field(default_factory=dict)
+    # three-way split of the folded net-degraded stretch (ISSUE 15,
+    # retiring the PR-5 omission): the analyzer derives it from the
+    # locality ladder the stream already carries — placement events
+    # (start/migrate/resize/rebind) carry the allocation's STATIC factor,
+    # `net` re-prices carry the DYNAMIC one, and the `track` prefix says
+    # whether a static toll is the multislice DCN term or a GPU locality
+    # tier.  Keys: `dcn-contention` (speed x (static - dynamic)),
+    # `multislice-toll` / `gpu-locality` (speed x (1 - static)).  Empty
+    # whenever every factor was 1.0.
+    net_legs: Dict[str, float] = field(default_factory=dict)
 
     def wait(self) -> Optional[float]:
         if self.first_start_t is None:
@@ -281,6 +400,7 @@ class JobRecord:
             "demand_gbps": self.demand_gbps,
             **({"reroutes": self.reroutes} if self.reroutes else {}),
             **({"delay_legs": dict(self.delay_legs)} if self.delay_legs else {}),
+            **({"net_legs": dict(self.net_legs)} if self.net_legs else {}),
         }
 
 
@@ -417,6 +537,13 @@ class _Active:
     chips_alloc: int = 0
     speed: float = 0.0
     locality: float = 1.0
+    # the net-degraded split's inputs (ISSUE 15): the STATIC locality of
+    # the current placement (what the last start/migrate/resize/rebind
+    # carried — `net` re-prices move `locality` but never this), and
+    # whether that placement is a GPU gang (track prefix), which names
+    # the static toll's cause
+    static_loc: float = 1.0
+    gpu: bool = False
     slow: float = 1.0          # straggler multiplier (faults/, ISSUE 6)
     overhead_left: float = 0.0
     t_prog: float = 0.0        # time of the last adopted snapshot
@@ -694,6 +821,24 @@ class RunAnalysis:
             row["down_s"] += dur
         return dict(sorted(out.items()))
 
+    def net_degraded_split(self) -> Dict[str, float]:
+        """The folded ``net-degraded`` leg split three ways (ISSUE 15,
+        retiring the PR-5 omission): per-segment seconds summed over jobs
+        in arrival order with sorted keys — ``dcn-contention`` (the gap
+        between the placement's static factor and the ``net``-repriced
+        dynamic one), ``multislice-toll`` (the static DCN term a
+        multislice gang pays even on an idle fabric), ``gpu-locality``
+        (scattered-gang placement tiers).  Derived by the analyzer from
+        the stream's locality ladder — no new event fields, so historical
+        streams split retroactively.  On attribution-armed runs the three
+        segments sum to ``delay_by_cause()['net-degraded']`` up to float
+        re-association.  Empty when no job ever ran below locality 1.0."""
+        out: Dict[str, float] = {}
+        for r in self.jobs:
+            for k in sorted(r.net_legs):
+                out[k] = out.get(k, 0.0) + r.net_legs[k]
+        return out
+
     def network(self) -> dict:
         """The network panel's data: per-link utilization series/means and
         the per-job bandwidth-share table (jobs the contention model
@@ -725,6 +870,7 @@ class RunAnalysis:
                 for name, series in sorted(self.net_links.items())
             },
             "jobs": jobs,
+            "net_degraded_split": self.net_degraded_split(),
         }
 
     def summary(self) -> Dict[str, object]:
@@ -977,6 +1123,25 @@ def analyze_events(
                     f"(expected work {expect}, snapshot {prog['work']}): "
                     "the stream is missing a transition"
                 )
+            if run > 0.0:
+                # net-degraded three-way split (ISSUE 15): the same
+                # productive span the engine's RUN_LEGS arithmetic
+                # charges, split along the locality ladder — the static
+                # toll (placement-carried factor) vs the contention gap
+                # (static minus the `net`-repriced dynamic factor)
+                if a.static_loc != 1.0:
+                    nl = r.net_legs
+                    key = "gpu-locality" if a.gpu else "multislice-toll"
+                    nl[key] = (
+                        nl.get(key, 0.0)
+                        + a.speed * (1.0 - a.static_loc) * run
+                    )
+                if a.locality != a.static_loc:
+                    nl = r.net_legs
+                    nl["dcn-contention"] = (
+                        nl.get("dcn-contention", 0.0)
+                        + a.speed * (a.static_loc - a.locality) * run
+                    )
         r.work = prog["work"]
         r.service = prog["service"]
         r.lost_service = prog["lost_service"]
@@ -1113,6 +1278,12 @@ def analyze_events(
             continue
         if kind == "repair":
             continue
+        if kind == "alert":
+            # watchtower detection record (ISSUE 15, obs/watch.py):
+            # alerts live in their own side stream, but a combined or
+            # hand-concatenated file must analyze cleanly — counted,
+            # never a lifecycle transition
+            continue
         if kind == "cache":
             # trailing cache-telemetry table (ISSUE 10): the engine's
             # unified {cache: {outcome: count}} harvest — a later record
@@ -1197,6 +1368,11 @@ def analyze_events(
             a.chips_alloc = int(ev.get("chips", a.rec.chips))
             a.speed = float(ev.get("speed", 1.0))
             a.locality = float(ev.get("locality", 1.0))
+            # the start event carries the STATIC placement factor (the
+            # engine binds it before any net re-price): the net-degraded
+            # split's toll baseline; the track prefix names its cause
+            a.static_loc = a.locality
+            a.gpu = str(ev.get("track", "")).startswith("gpu/")
             # placement-changing events carry slow_factor only when a
             # straggler chip paces the gang; absence means full rate
             a.slow = float(ev.get("slow_factor", 1.0))
@@ -1286,6 +1462,12 @@ def analyze_events(
             a.chips_alloc = new_chips
             a.speed = float(ev.get("speed", a.speed))
             a.locality = float(ev.get("locality", a.locality))
+            # placement moved: the carried locality is again the new
+            # allocation's STATIC factor (the engine re-binds before
+            # emitting; any net re-price follows as its own event)
+            a.static_loc = a.locality
+            if "track" in ev:
+                a.gpu = str(ev.get("track", "")).startswith("gpu/")
             a.slow = float(ev.get("slow_factor", 1.0))
             sample(t)
         elif kind == "revoke":
@@ -1423,28 +1605,12 @@ def analyze_file(path, *, low_memory: bool = False, **kwargs) -> RunAnalysis:
     additionally spills finished job records to a sqlite temp store
     (:class:`JobSpill`) so the whole analysis — aggregates, exact
     quantiles, report tables — runs at O(active jobs) resident memory
-    with byte-identical output (the ISSUE 9 streaming analyzer)."""
+    with byte-identical output (the ISSUE 9 streaming analyzer).
 
-    def records():
-        opener = gzip.open if str(path).endswith(".gz") else open
-        try:
-            with opener(path, "rt") as f:
-                for lineno, line in enumerate(f, 1):
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        yield json.loads(line)
-                    except json.JSONDecodeError as e:
-                        raise StreamError(
-                            f"{path}:{lineno}: truncated or corrupt JSONL "
-                            f"record ({e}) — was the writer killed mid-"
-                            f"record?"
-                        ) from None
-        except (OSError, EOFError) as e:
-            # gzip corruption raises BadGzipFile (an OSError) or EOFError
-            raise StreamError(f"cannot read event stream {path}: {e}") from None
-
+    Ingestion rides :func:`iter_jsonl_records` — the same incremental
+    :class:`StreamCursor` machinery the live-tail watchtower
+    (``obs/watch.py``) polls a growing file with, driven here in
+    one-shot mode (ISSUE 15 shared-reader refactor)."""
     if low_memory:
         kwargs["spill"] = JobSpill()
-    return analyze_events(records(), **kwargs)
+    return analyze_events(iter_jsonl_records(path), **kwargs)

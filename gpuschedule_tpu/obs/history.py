@@ -153,6 +153,31 @@ class HistoryStore:
             out = out[-last:] if last > 0 else []
         return out
 
+    def count(
+        self,
+        *,
+        kind: Optional[str] = None,
+        config_hash: Optional[str] = None,
+        run_id: Optional[str] = None,
+        label: Optional[str] = None,
+    ) -> int:
+        """Matching-row count without materializing the rows — how the
+        watchtower's incident drill proves its alert counter and this
+        ledger agree (ISSUE 15)."""
+        clauses, params = [], []
+        for col, val in (
+            ("kind", kind), ("config_hash", config_hash),
+            ("run_id", run_id), ("label", label),
+        ):
+            if val is not None:
+                clauses.append(f"{col} = ?")
+                params.append(val)
+        where = (" WHERE " + " AND ".join(clauses)) if clauses else ""
+        (n,) = self._db.execute(
+            f"SELECT COUNT(*) FROM runs{where}", params
+        ).fetchone()
+        return int(n)
+
     def close(self) -> None:
         self._db.close()
 

@@ -191,6 +191,16 @@ class Counter(_Metric):
     def value(self) -> float:
         return self._value
 
+    def labeled_values(self) -> Dict[Tuple[str, ...], float]:
+        """Per-child values keyed by label-value tuple (the unlabeled
+        family reads as ``{(): value}``) — the read-back surface the
+        watchtower uses to prove its ``watch_alerts_total{detector}``
+        family and the history ledger agree alert for alert (ISSUE 15)."""
+        if not self.labelnames:
+            return {(): self._value}
+        with self._lock:
+            return {lv: c._value for lv, c in self._children.items()}
+
     def samples(self):
         return [
             (self.name, c._labelvalues, c._value) for c in self._self_or_children()

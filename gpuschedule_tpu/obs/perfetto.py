@@ -261,6 +261,20 @@ def trace_events(events: Iterable[dict]) -> List[dict]:
                 "ts": t_us, "pid": pid, "tid": tid,
                 "args": {"pending": ev.get("pending", 0)},
             })
+            pods = ev.get("pods")
+            if pods and any("hazard" in p for p in pods):
+                # per-pod hazard health track (ISSUE 15): present only on
+                # hazard-armed captures, so historical traces are
+                # byte-identical
+                pid, tid = ids.ids("cluster/hazard")
+                timed.append({
+                    "name": "pod hazard", "cat": "sample", "ph": "C",
+                    "ts": t_us, "pid": pid, "tid": tid,
+                    "args": {
+                        f"pod{i}": float(p.get("hazard", 0.0))
+                        for i, p in enumerate(pods)
+                    },
+                })
         # arrival / speed / rationale-only events carry no timeline geometry
 
     # horizon cutoff: unfinished occupancies and unrepaired outages extend

@@ -157,6 +157,24 @@ def _grid_and_axes(t_max: float, v_max: float, unit_div: float,
     return parts
 
 
+def _mark_lines(
+    marks: Optional[Sequence[Tuple[float, str]]], t_max: float, v_max: float
+) -> List[str]:
+    """Vertical event ticks on a time chart (the Alerts panel's timeline
+    marks, ISSUE 15): a dashed line at each (t, label) with a native
+    tooltip — identity never color-alone (the label rides the title and
+    the per-detector table repeats every value)."""
+    parts: List[str] = []
+    for t, label in marks or ():
+        x, _ = _xy(t, 0.0, t_max, v_max)
+        parts.append(
+            f'<line class="mark" x1="{x:.1f}" y1="{_MT}" '
+            f'x2="{x:.1f}" y2="{_H - _MB}">'
+            f"<title>{_esc(label)} at t = {_esc(_fmt_dur(t))}</title></line>"
+        )
+    return parts
+
+
 def _step_series_chart(
     pts: Sequence[Tuple[float, float]],
     *,
@@ -167,6 +185,7 @@ def _step_series_chart(
     cap_line: Optional[float] = None,
     area: bool = True,
     hover_fmt=_fmt_num,
+    marks: Optional[Sequence[Tuple[float, str]]] = None,
 ) -> str:
     """One single-series step-after chart (line + optional 10% wash).
     Single series: the panel title names it, so no legend box."""
@@ -210,6 +229,7 @@ def _step_series_chart(
         f'<path d="{d}" fill="none" stroke="var({series_var})" '
         f'stroke-width="2" stroke-linejoin="round" stroke-linecap="round"/>'
     )
+    parts += _mark_lines(marks, t_max, vmax)
     # hover layer: one invisible hit band per decimated sample with a
     # native tooltip (self-contained; no script needed)
     band = (_W - _ML - _MR) / max(1, len(pts))
@@ -237,6 +257,7 @@ def _multi_step_chart(
     v_max: float = 1.0,
     y_fmt=_fmt_pct,
     cap_line: Optional[float] = None,
+    marks: Optional[Sequence[Tuple[float, str]]] = None,
 ) -> str:
     """Several step-after series on one axis (the network panel's link-
     utilization view; the occupancy panel's demand-vs-physical overlay).
@@ -283,6 +304,7 @@ def _multi_step_chart(
             f'<text class="dlabel" x="{min(ex + 6, _W - 90):.1f}" '
             f'y="{ey - 5:.1f}">{_esc(name)}</text>'
         )
+    parts += _mark_lines(marks, t_max, v_max)
     parts.append("</svg>")
     return "".join(parts)
 
@@ -479,6 +501,7 @@ svg { width: 100%; height: auto; display: block; }
 svg .grid { stroke: var(--grid); stroke-width: 1; }
 svg .axis { stroke: var(--baseline); stroke-width: 1; }
 svg .cap { stroke: var(--baseline); stroke-width: 1; stroke-dasharray: none; }
+svg .mark { stroke: var(--series-2); stroke-width: 1.5; stroke-dasharray: 4 3; }
 svg .tick { fill: var(--muted); font-size: 11px; }
 svg .dlabel { fill: var(--text-secondary); font-size: 12px; }
 svg .inbar { fill: #ffffff; font-size: 12px; }
@@ -728,18 +751,22 @@ def _occupancy_chart(
     occ_pts: List[Tuple[float, float]],
     t_max: float,
     total_chips: Optional[int],
+    alert_marks: Optional[List[Tuple[float, str]]] = None,
 ) -> str:
     """The occupancy panel's chart: the demand series alone (historic
     view), or — when the run carried cluster ``sample`` events — demand
     overlaid on *physical* occupancy.  Demand above physical is overlay
     packing made visible (the ROADMAP PR-3 demand-only omission,
-    retired); physical above zero while demand gaps are health holes."""
+    retired); physical above zero while demand gaps are health holes.
+    ``alert_marks`` (the watchtower's detections, ISSUE 15) draw as
+    dashed timeline ticks."""
     phys_pts = [(t, float(u)) for t, u, _, _ in analysis.sample_series]
     if not phys_pts:
         return _step_series_chart(
             occ_pts, series_var="--series-1", label="chips allocated",
             t_max=t_max,
             cap_line=float(total_chips) if total_chips else None,
+            marks=alert_marks,
         )
     v_max = max(
         max((v for _, v in occ_pts), default=1.0),
@@ -751,7 +778,39 @@ def _occupancy_chart(
         label="chip occupancy: demand vs physical",
         t_max=t_max, v_max=v_max, y_fmt=_fmt_num,
         cap_line=float(total_chips) if total_chips else None,
+        marks=alert_marks,
     )
+
+
+def _alerts_panel(alerts: List[dict]) -> str:
+    """The watchtower panel (ISSUE 15): one row per alert (time,
+    detector, severity, value vs threshold, blamed cause) plus a
+    per-detector rollup — the table half of the occupancy chart's
+    timeline ticks, so no detection is reachable only through a mark."""
+    rows = []
+    per: dict = {}
+    for a in alerts:
+        det = str(a.get("detector", "?"))
+        per[det] = per.get(det, 0) + 1
+        rows.append(
+            f"<tr><td>{_esc(_fmt_dur(float(a.get('t', 0.0))))}</td>"
+            f"<td>{_esc(det)}</td>"
+            f"<td>{_esc(a.get('severity', '–'))}</td>"
+            f"<td>{_esc(_fmt_num(a.get('value')))}</td>"
+            f"<td>{_esc(_fmt_num(a.get('threshold')))}</td>"
+            f"<td>{_esc(a.get('cause', '–'))}</td></tr>"
+        )
+    rollup = " · ".join(
+        f"{det} ×{n}" for det, n in sorted(per.items())
+    )
+    return f"""
+<h2>Alerts</h2>
+<div class="panel">
+  <p class="meta">{len(alerts)} watchtower detections — {_esc(rollup)}</p>
+  <table><thead><tr><th>t</th><th>detector</th><th>severity</th>
+  <th>value</th><th>threshold</th><th>blamed cause</th></tr></thead>
+  <tbody>{''.join(rows)}</tbody></table>
+</div>"""
 
 
 def render_report(
@@ -759,12 +818,15 @@ def render_report(
     *,
     title: Optional[str] = None,
     selfprof: Optional[dict] = None,
+    alerts: Optional[List[dict]] = None,
 ) -> str:
     """The whole report as one HTML string (write it anywhere; it never
     references the network or the filesystem).  ``selfprof`` (the
     summary block of a ``run --self-profile`` document, via
     ``report --selfprof``) adds the wall-clock phase bar to the
-    Engine-health panel."""
+    Engine-health panel; ``alerts`` (the watchtower side stream, via
+    ``report --alerts``) adds timeline ticks on the occupancy chart and
+    the per-detector Alerts panel (ISSUE 15)."""
     h = analysis.header
     s = analysis.summary()
     dists = analysis.distributions()
@@ -821,7 +883,26 @@ def render_report(
               f"{_fmt_num(gp['total_chip_s'])} chip-s total"),
     ]
 
+    alert_marks = [
+        (float(a.get("t", 0.0)), f"{a.get('detector', '?')} alert")
+        for a in (alerts or [])
+    ]
+    alerts_panel = _alerts_panel(alerts) if alerts else ""
+
     net = analysis.network()
+    # three-way net-degraded split (ISSUE 15): rendered whenever any job
+    # ran below locality 1.0 — with or without the contention model
+    # (network() already derived it; don't rescan the job list)
+    split = net["net_degraded_split"]
+    split_panel = ""
+    if split:
+        split_panel = (
+            '<p class="meta">net-degraded stretch by segment</p>'
+            + _stacked_bar(
+                sorted(split.items()), label="net-degraded split", unit="s",
+                empty_note="no net-degraded time",
+            )
+        )
     net_panel = ""
     if analysis.net_links:
         max_links = 6  # core + 5 busiest uplinks; the table lists them all
@@ -850,6 +931,15 @@ def render_report(
   {drop_note}
   {_net_links_table(analysis, net)}
   {_net_jobs_table(net)}
+  {split_panel}
+</div>"""
+    elif split_panel:
+        # no contention model, but static tolls / GPU tiers stretched
+        # run time: the split still gets its panel
+        net_panel = f"""
+<h2>Network</h2>
+<div class="panel">
+  {split_panel}
 </div>"""
 
     # Attribution panel (ISSUE 5): where wait and JCT time went, cause by
@@ -961,8 +1051,9 @@ def render_report(
 
 <h2>Chip occupancy</h2>
 <div class="panel">
-{_occupancy_chart(analysis, occ_pts, t_max, total_chips)}
+{_occupancy_chart(analysis, occ_pts, t_max, total_chips, alert_marks)}
 </div>
+{alerts_panel}
 
 <h2>Pending queue</h2>
 <div class="panel">
@@ -997,9 +1088,12 @@ def write_report(
     *,
     title: Optional[str] = None,
     selfprof: Optional[dict] = None,
+    alerts: Optional[List[dict]] = None,
 ) -> Path:
     out = Path(path)
     if out.parent and not out.parent.exists():
         out.parent.mkdir(parents=True, exist_ok=True)
-    out.write_text(render_report(analysis, title=title, selfprof=selfprof))
+    out.write_text(render_report(
+        analysis, title=title, selfprof=selfprof, alerts=alerts
+    ))
     return out

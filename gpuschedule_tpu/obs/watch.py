@@ -1,0 +1,970 @@
+"""Live-tail watchtower (ISSUE 15 tentpole): streaming detectors, SLO
+burn-rate alerts, and a flight recorder over an events.jsonl stream.
+
+Every earlier analytics surface is post-hoc — ``analyze``/``report``/
+``compare`` read a *finished* stream.  The paper's operational setting
+(Philly-style fleet operation) is continuous monitoring of a live
+cluster; this module is that loop: an **incremental** analyzer that
+tails a (possibly still growing) stream, maintains O(active-jobs)
+rolling-window state, and evaluates a declarative detector set at every
+sim-time window boundary:
+
+- ``queue-depth-surge`` — pending depth both deep and sharply up within
+  one window;
+- ``goodput-collapse`` — the cluster's work velocity (sum of running
+  effective rates, piecewise-exact) falls below a fraction of its own
+  trailing baseline while demand remains;
+- ``frag-creep`` — fragmentation (from ``sample`` records) above a
+  threshold for N consecutive windows;
+- ``hazard-spike`` — any pod's hazard score (hazard-armed ``sample``
+  records, ISSUE 15 satellite) past a threshold;
+- ``slo-burn`` — multi-window SLO burn-rate alerting à la SRE: the
+  queueing-delay SLO's error budget burning faster than ``fast_burn``
+  over the last window AND faster than ``slow_burn`` over the trailing
+  slow window, so a blip neither pages nor hides a slow leak.
+
+Detections are **latched** (rising-edge): a detector fires once when its
+condition becomes true and re-arms only after a window where it is
+false, so a persistent outage is one alert, not one per window.
+
+Every alert lands in four places: the **side stream** (schema-additive
+``alert`` records behind their own versioned header — docs/events.md),
+one PR-10 **history row** (kind ``watch``, label = detector), the
+labeled ``watch_alerts_total{detector}`` **registry family**, and — when
+a flight recorder is armed — a **ring-buffer dump** of the last N raw
+events plus a pin of the watched run's nearest periodic engine snapshot
+(``run --snapshot``; the ``<snapshot>.meta.json`` sidecar names its sim
+instant), so ``whatif`` can immediately restore and replay the minutes
+before the incident.
+
+Determinism contract (pinned by tests/test_watch.py): the alert sequence
+is a pure function of (record sequence, rules) — byte-identical across
+one-shot batch, ``--replay`` (paced as-if-live by sim time), and
+``--follow`` (polling a growing file in arbitrary chunks, including
+mid-record truncated tails, which the shared
+:class:`~gpuschedule_tpu.obs.analyze.StreamCursor` retains and re-reads
+whole).  Wall clocks pace delivery only; alert content derives from sim
+time alone.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+import os
+import shutil
+import time
+from collections import deque
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from gpuschedule_tpu.obs.analyze import (
+    RunHeader,
+    StreamCursor,
+    StreamError,
+    iter_jsonl_items,
+)
+from gpuschedule_tpu.obs.metrics import exact_quantile
+
+# Version of the alert side-stream schema (independent of the main event
+# stream's EVENT_SCHEMA: the two streams version separately — an alert
+# payload change must not force re-capturing replay streams, and vice
+# versa; docs/events.md records the policy).
+ALERTS_SCHEMA = 1
+
+DETECTORS = (
+    "queue-depth-surge",
+    "goodput-collapse",
+    "frag-creep",
+    "hazard-spike",
+    "slo-burn",
+)
+
+# Alert severities: "page" for the two failure modes that demand a human
+# now (work is not getting done / the SLO budget is burning at both
+# horizons), "ticket" for the creeping kinds.
+_SEVERITY = {
+    "queue-depth-surge": "ticket",
+    "goodput-collapse": "page",
+    "frag-creep": "ticket",
+    "hazard-spike": "ticket",
+    "slo-burn": "page",
+}
+
+# The declarative detector config (`watch --rules rules.json`): operators
+# tune thresholds without code.  Omitting a detector key (or setting it
+# to false/null) disables that detector; unknown detectors or knob names
+# are rejected at load, not silently ignored.
+DEFAULT_RULES: dict = {
+    "window_s": 300.0,
+    # trailing windows feeding the goodput-collapse baseline (windows
+    # spent in an active collapse are excluded, so the baseline does not
+    # decay toward the outage it is measuring)
+    "baseline_windows": 6,
+    # flight-recorder ring size (raw events kept for the incident dump)
+    "ring": 512,
+    "detectors": {
+        "queue-depth-surge": {"min_pending": 8.0, "surge_factor": 2.0},
+        "goodput-collapse": {"collapse_frac": 0.5, "min_velocity": 0.05},
+        "frag-creep": {"frag_threshold": 0.5, "windows": 3},
+        "hazard-spike": {"hazard_threshold": 1.0},
+        "slo-burn": {
+            "wait_slo_s": 3600.0,
+            "target": 0.95,
+            "fast_burn": 10.0,
+            "slow_burn": 2.0,
+            "slow_windows": 12,
+        },
+    },
+}
+
+
+def load_rules(source=None) -> dict:
+    """The effective rules dict: :data:`DEFAULT_RULES` overlaid with a
+    JSON file (path) or a dict.  Unknown top-level keys, unknown
+    detector names, unknown knob names, and non-positive windows are
+    rejected — a typo'd threshold must not silently run the defaults."""
+    rules = copy.deepcopy(DEFAULT_RULES)
+    if source is None:
+        return rules
+    if isinstance(source, (str, Path)):
+        try:
+            doc = json.loads(Path(source).read_text())
+        except OSError as e:
+            raise ValueError(f"cannot read rules file {source}: {e}") from None
+        except json.JSONDecodeError as e:
+            raise ValueError(f"rules file {source} is not JSON: {e}") from None
+    else:
+        doc = source
+    if not isinstance(doc, dict):
+        raise ValueError("rules must be a JSON object")
+    unknown = sorted(set(doc) - set(DEFAULT_RULES))
+    if unknown:
+        raise ValueError(
+            f"unknown rules keys {unknown}; known: {sorted(DEFAULT_RULES)}"
+        )
+    if "window_s" in doc:
+        v = float(doc["window_s"])
+        if not v > 0:
+            raise ValueError(f"rules.window_s must be > 0, got {doc['window_s']}")
+        rules["window_s"] = v
+    for key in ("baseline_windows", "ring"):
+        if key in doc:
+            # whole windows/records only: int(0.5) would silently yield
+            # 0 and disable the detector/recorder the knob configures
+            v = doc[key]
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                raise ValueError(
+                    f"rules.{key} must be an integer >= 1, got {v!r}"
+                )
+            rules[key] = v
+    dets = doc.get("detectors")
+    if dets is not None:
+        if not isinstance(dets, dict):
+            raise ValueError("rules.detectors must be an object")
+        bad = sorted(set(dets) - set(DETECTORS))
+        if bad:
+            raise ValueError(
+                f"unknown detectors {bad}; known: {sorted(DETECTORS)}"
+            )
+        for name in sorted(dets):
+            cfg = dets[name]
+            if cfg in (None, False):
+                rules["detectors"].pop(name, None)
+                continue
+            if not isinstance(cfg, dict):
+                raise ValueError(
+                    f"rules.detectors[{name!r}] must be an object, "
+                    "false, or null"
+                )
+            base = dict(DEFAULT_RULES["detectors"][name])
+            bad_keys = sorted(set(cfg) - set(base))
+            if bad_keys:
+                raise ValueError(
+                    f"unknown keys {bad_keys} for detector {name!r}; "
+                    f"known: {sorted(base)}"
+                )
+            for k in sorted(cfg):
+                base[k] = float(cfg[k])
+            rules["detectors"][name] = base
+    return rules
+
+
+def rules_digest(rules: dict) -> str:
+    """Stable 12-hex digest of the effective rules (sorted-key JSON) —
+    stamped into the side-stream header so an alert sequence is
+    auditable against the exact thresholds that produced it."""
+    blob = json.dumps(rules, sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+# --------------------------------------------------------------------- #
+# the alert side stream
+
+
+class AlertStream:
+    """The alert side stream: JSONL records in the main stream's shape
+    (``{"t", "event", ...}``) behind their OWN versioned header
+    (``{"schema": ALERTS_SCHEMA, "stream": "alerts", ...}``), flushed
+    per record (alerts are rare and a tailing pager must see them now).
+    With no path, records are only collected in memory."""
+
+    def __init__(self, path=None):
+        self.records: List[dict] = []
+        self._fh = None
+        if path is not None:
+            p = Path(path)
+            if p.parent and not p.parent.exists():
+                p.parent.mkdir(parents=True, exist_ok=True)
+            self._fh = open(p, "w")
+
+    def write_header(self, meta: dict) -> None:
+        self._write({"schema": ALERTS_SCHEMA, "stream": "alerts", **meta})
+
+    def event(self, kind: str, t: float, job=None, **extra) -> dict:
+        """One side-stream record (mirrors ``MetricsLog.event``'s
+        signature so the contract linter's GS3xx schema rules cover this
+        emitter exactly like the engine's)."""
+        rec: dict = {"t": t, "event": kind}
+        if job is not None:
+            rec["job"] = job
+        rec.update(extra)
+        self._write(rec)
+        return rec
+
+    def _write(self, rec: dict) -> None:
+        self.records.append(rec)
+        if self._fh is not None:
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+# --------------------------------------------------------------------- #
+# rolling per-job state
+
+
+@dataclass
+class _WJob:
+    """One active job's rolling state (the O(active-jobs) part)."""
+
+    chips: int
+    submit_t: float
+    state: str = "queued"          # queued | running | suspended
+    cause: Optional[str] = None    # open wait interval's blame (ISSUE 5)
+    alloc: int = 0
+    speed: float = 0.0
+    loc: float = 1.0
+    static_loc: float = 1.0
+    slow: float = 1.0
+    gpu: bool = False
+    started: bool = False
+
+
+class Watcher:
+    """The incremental analyzer: feed records (in stream order), collect
+    alerts.  Evaluation happens at sim-time window boundaries only, so
+    the alert sequence is a pure function of (records, rules) whatever
+    wall-clock cadence delivered them."""
+
+    def __init__(
+        self,
+        rules: Optional[dict] = None,
+        *,
+        alerts: Optional[AlertStream] = None,
+        flight_dir=None,
+        snapshot=None,
+        registry=None,
+        history=None,
+        source: str = "",
+    ):
+        self.rules = rules if rules is not None else load_rules()
+        self.w = float(self.rules["window_s"])
+        self.sink = alerts if alerts is not None else AlertStream()
+        self.flight_dir = Path(flight_dir) if flight_dir else None
+        self.snapshot = Path(snapshot) if snapshot else None
+        self._history = history
+        self._reg_alerts = None
+        if registry is not None:
+            self._reg_alerts = registry.counter(
+                "watch_alerts_total",
+                "watchtower detections by detector (ISSUE 15)",
+                labelnames=("detector",),
+            )
+        self.header: Optional[RunHeader] = None
+        self.source = source
+        self._header_out = False
+
+        # stream-wide state
+        self.ring: deque = deque(maxlen=int(self.rules["ring"]))
+        self.n_events = 0
+        self.end_t = 0.0
+        self.anomalies = 0
+        self.counts: Dict[str, int] = {}
+        self.alerts: List[dict] = []
+        self.alert_counts: Dict[str, int] = {}
+        self._seq = 0
+
+        # O(active) job state + aggregate rates (piecewise-constant
+        # between records; every mutation goes rates-off -> edit ->
+        # rates-on, so the aggregates track the active set exactly)
+        self._jobs: Dict[str, _WJob] = {}
+        self._used = 0
+        self._running = 0
+        self._pending = 0
+        self._vel = 0.0          # sum of running effective rates
+        self._toll_rate = 0.0    # speed x (1 - static_loc), TPU multislice
+        self._gpu_rate = 0.0     # speed x (1 - static_loc), GPU gangs
+        self._cont_rate = 0.0    # speed x (static_loc - loc): DCN contention
+        self._strag_rate = 0.0   # speed x loc x (1 - slow)
+        self._share_rate = 0.0   # (1 - speed)
+        self._cause_n: Dict[str, int] = {}  # waiting jobs per blame cause
+
+        # window accumulators (reset at each boundary)
+        self._wend: Optional[float] = None
+        self._last_t: Optional[float] = None
+        self._occ_int = 0.0
+        self._pend_int = 0.0
+        self._vel_int = 0.0
+        self._leg_int: Dict[str, float] = {}
+        self._wait_int: Dict[str, float] = {}
+        self._win_waits: List[float] = []
+        self._win_breached = 0
+        self._win_lost = 0.0
+        self._win_revocations = 0
+        self._win_faults = 0
+        self._win_frag: Optional[float] = None
+        self._win_hazard: Optional[float] = None
+        self._win_pend_start = 0
+
+        # trailing-window memory
+        self._vel_hist: deque = deque(maxlen=int(self.rules["baseline_windows"]))
+        slo = self.rules["detectors"].get("slo-burn") or {}
+        self._slo_hist: deque = deque(maxlen=int(slo.get("slow_windows", 12)))
+        # sample observations are piecewise-constant signals: a window
+        # containing no `sample` record (capture's --sample-interval
+        # longer than — or misaligned with — window_s) HOLDS the last
+        # observation instead of reading as healthy, else frag-creep /
+        # hazard-spike go silently dead under coarse sampling
+        self._frag_held: Optional[float] = None
+        self._hazard_held: Optional[float] = None
+        self._frag_streak = 0
+        self._active_alerts: set = set()
+        self.windows = 0
+
+    # ------------------------------------------------------------------ #
+    # aggregate-rate bookkeeping
+
+    def _rates(self, j: _WJob, sign: float) -> None:
+        self._vel += sign * j.speed * j.loc * j.slow
+        if j.speed != 1.0:
+            self._share_rate += sign * (1.0 - j.speed)
+        if j.static_loc != 1.0:
+            amt = sign * j.speed * (1.0 - j.static_loc)
+            if j.gpu:
+                self._gpu_rate += amt
+            else:
+                self._toll_rate += amt
+        if j.loc != j.static_loc:
+            self._cont_rate += sign * j.speed * (j.static_loc - j.loc)
+        if j.slow != 1.0:
+            self._strag_rate += sign * j.speed * j.loc * (1.0 - j.slow)
+
+    def _cause(self, j: _WJob, cause: Optional[str]) -> None:
+        """Move a waiting job's open blame cause (attribution-armed
+        streams carry it on arrival/preempt/revoke; bare streams bucket
+        under 'unattributed')."""
+        if j.cause is not None:
+            self._cause_n[j.cause] = self._cause_n.get(j.cause, 0) - 1
+        j.cause = cause
+        if cause is not None:
+            self._cause_n[cause] = self._cause_n.get(cause, 0) + 1
+
+    def _integrate(self, t: float) -> None:
+        last = self._last_t
+        if last is None:
+            self._last_t = t
+            return
+        dt = t - last
+        if dt <= 0.0:
+            return
+        self._occ_int += self._used * dt
+        self._pend_int += self._pending * dt
+        self._vel_int += self._vel * dt
+        li = self._leg_int
+        if self._cont_rate:
+            li["dcn-contention"] = li.get("dcn-contention", 0.0) + self._cont_rate * dt
+        if self._toll_rate:
+            li["multislice-toll"] = li.get("multislice-toll", 0.0) + self._toll_rate * dt
+        if self._gpu_rate:
+            li["gpu-locality"] = li.get("gpu-locality", 0.0) + self._gpu_rate * dt
+        if self._strag_rate:
+            li["straggler"] = li.get("straggler", 0.0) + self._strag_rate * dt
+        if self._share_rate > 0.0:
+            li["policy-share"] = li.get("policy-share", 0.0) + self._share_rate * dt
+        wi = self._wait_int
+        for cause in sorted(self._cause_n):
+            n = self._cause_n[cause]
+            if n > 0:
+                wi[cause] = wi.get(cause, 0.0) + n * dt
+        self._last_t = t
+
+    # ------------------------------------------------------------------ #
+    # record ingestion
+
+    def feed(self, rec: dict, raw: Optional[str] = None) -> List[dict]:
+        """Absorb one stream record; returns the alerts any window
+        boundaries it crossed fired (possibly empty)."""
+        self.ring.append(raw if raw is not None else json.dumps(rec))
+        if "schema" in rec and "event" not in rec:
+            # identity header: adopt, but never refuse — the watchtower
+            # is an operator tool and bare streams must still watch
+            try:
+                self.header = RunHeader.from_record(rec)
+            except ValueError:
+                self.anomalies += 1
+            return []
+        kind = rec.get("event")
+        if kind is None:
+            self.anomalies += 1
+            return []
+        t = float(rec.get("t", 0.0))
+        fired = self._advance_to(t)
+        self.n_events += 1
+        self.end_t = max(self.end_t, t)
+        self.counts[kind] = self.counts.get(kind, 0) + 1
+        self._apply(kind, rec, t)
+        return fired
+
+    def _advance_to(self, t: float) -> List[dict]:
+        if self._wend is None:
+            # windows anchor at sim t=0 whatever the first record's time,
+            # so two watchers of one stream agree on every boundary
+            self._wend = self.w
+            while self._wend <= t - self.w:
+                self._wend += self.w  # skip genuinely empty leading span
+            self._last_t = min(t, self._wend - self.w)
+        fired: List[dict] = []
+        while t >= self._wend:
+            self._integrate(self._wend)
+            fired.extend(self._close_window(self._wend))
+            self._wend += self.w
+        self._integrate(t)
+        return fired
+
+    def _get(self, rec: dict) -> Optional[_WJob]:
+        j = self._jobs.get(rec.get("job"))
+        if j is None:
+            self.anomalies += 1
+        return j
+
+    def _apply(self, kind: str, rec: dict, t: float) -> None:
+        if kind == "arrival":
+            job_id = rec.get("job")
+            if job_id is None or job_id in self._jobs:
+                self.anomalies += 1
+                return
+            j = _WJob(chips=int(rec.get("chips", 0)), submit_t=t)
+            self._jobs[job_id] = j
+            self._pending += 1
+            self._cause(j, rec.get("cause") or "unattributed")
+        elif kind == "start":
+            j = self._get(rec)
+            if j is None or j.state == "running":
+                return
+            self._cause(j, None)
+            j.state = "running"
+            j.alloc = int(rec.get("chips", j.chips))
+            j.speed = float(rec.get("speed", 1.0))
+            j.loc = float(rec.get("locality", 1.0))
+            j.static_loc = j.loc
+            j.gpu = str(rec.get("track", "")).startswith("gpu/")
+            j.slow = float(rec.get("slow_factor", 1.0))
+            self._used += j.alloc
+            self._running += 1
+            self._pending -= 1
+            self._rates(j, +1.0)
+            if not j.started:
+                j.started = True
+                wait = t - j.submit_t
+                self._win_waits.append(wait)
+                slo = self.rules["detectors"].get("slo-burn")
+                if slo is not None and wait > slo["wait_slo_s"]:
+                    self._win_breached += 1
+        elif kind in ("preempt", "revoke"):
+            j = self._get(rec)
+            if j is None or j.state != "running":
+                return
+            self._rates(j, -1.0)
+            self._used -= j.alloc
+            self._running -= 1
+            self._pending += 1
+            j.alloc = 0
+            j.speed = 0.0
+            j.loc = j.static_loc = j.slow = 1.0
+            j.state = (
+                "suspended"
+                if kind == "preempt" and rec.get("suspend", True)
+                else "queued"
+            )
+            self._cause(j, rec.get("cause") or "unattributed")
+            if kind == "revoke":
+                self._win_revocations += 1
+                self._win_lost += float(rec.get("lost_work", 0.0))
+        elif kind in ("finish", "cutoff"):
+            # cutoff is a horizon-terminal record: for the watcher both
+            # mean "this job leaves the rolling state for good"
+            j = self._get(rec)
+            if j is None:
+                return
+            if j.state == "running":
+                self._rates(j, -1.0)
+                self._used -= j.alloc
+                self._running -= 1
+            else:
+                self._pending -= 1
+                self._cause(j, None)
+            del self._jobs[rec["job"]]
+        elif kind == "speed":
+            j = self._get(rec)
+            if j is None or j.state != "running":
+                return
+            self._rates(j, -1.0)
+            j.speed = float(rec.get("speed", j.speed))
+            self._rates(j, +1.0)
+        elif kind == "slow":
+            j = self._get(rec)
+            if j is None or j.state != "running":
+                return
+            self._rates(j, -1.0)
+            j.slow = float(rec.get("slow_factor", j.slow))
+            self._rates(j, +1.0)
+        elif kind == "net":
+            j = self._get(rec)
+            if j is None or j.state != "running":
+                return
+            self._rates(j, -1.0)
+            j.loc = float(rec.get("locality", j.loc))
+            self._rates(j, +1.0)
+        elif kind in ("migrate", "resize", "rebind"):
+            j = self._get(rec)
+            if j is None or j.state != "running":
+                return
+            self._rates(j, -1.0)
+            new_chips = int(rec.get("chips", j.alloc))
+            self._used += new_chips - j.alloc
+            j.alloc = new_chips
+            j.speed = float(rec.get("speed", j.speed))
+            j.loc = float(rec.get("locality", j.loc))
+            j.static_loc = j.loc
+            if "track" in rec:
+                j.gpu = str(rec.get("track", "")).startswith("gpu/")
+            j.slow = float(rec.get("slow_factor", 1.0))
+            self._rates(j, +1.0)
+        elif kind == "fault":
+            self._win_faults += 1
+        elif kind == "sample":
+            frag = rec.get("frag")
+            if frag is not None:
+                f = float(frag)
+                if self._win_frag is None or f > self._win_frag:
+                    self._win_frag = f
+            pods = rec.get("pods")
+            if pods:
+                for p in pods:
+                    h = p.get("hazard")
+                    if h is not None:
+                        h = float(h)
+                        if self._win_hazard is None or h > self._win_hazard:
+                            self._win_hazard = h
+        # reject / repair / warn / reroute / netlink / cache / alert:
+        # no rolling state to move
+
+    # ------------------------------------------------------------------ #
+    # window evaluation
+
+    def _blame_run(self) -> Tuple[str, Dict[str, float]]:
+        """Blame for a running-side detection (goodput-collapse): the
+        window's dominant slowdown leg via the PR-5 leg vocabulary —
+        fault rollback first (revocations erase work outright), else the
+        largest integrated stretch leg."""
+        legs = {k: self._leg_int[k] for k in sorted(self._leg_int)}
+        if self._win_revocations:
+            legs["fault-outage"] = self._win_lost
+            return "fault-outage", legs
+        best, best_v = "unknown", 0.0
+        for k in sorted(legs):
+            if legs[k] > best_v:
+                best, best_v = k, legs[k]
+        return best, legs
+
+    def _blame_wait(self) -> Tuple[str, Dict[str, float]]:
+        """Blame for a queue-side detection (surge / slo-burn): the
+        dominant integrated wait cause (job-seconds queued per PR-5
+        blame cause; 'unattributed' on captures without --attrib)."""
+        legs = {k: self._wait_int[k] for k in sorted(self._wait_int)}
+        best, best_v = "unknown", 0.0
+        for k in sorted(legs):
+            if legs[k] > best_v:
+                best, best_v = k, legs[k]
+        return best, legs
+
+    def _fire(
+        self,
+        detector: str,
+        t_end: float,
+        value: float,
+        threshold: float,
+        baseline: Optional[float],
+        cause: str,
+        legs: Dict[str, float],
+        p99_wait_s: Optional[float] = None,
+    ) -> Optional[dict]:
+        if detector in self._active_alerts:
+            return None
+        self._active_alerts.add(detector)
+        self._seq += 1
+        extra = {}
+        if baseline is not None:
+            extra["baseline"] = baseline
+        extra["cause"] = cause
+        extra["legs"] = {k: legs[k] for k in sorted(legs)}
+        if p99_wait_s is not None:
+            extra["p99_wait_s"] = p99_wait_s
+        if self.flight_dir is not None:
+            # flight recorder: dump the last-N raw events verbatim and
+            # pin the watched run's newest engine snapshot (+ sidecar)
+            # so `whatif` restores straight into the pre-incident state
+            self.flight_dir.mkdir(parents=True, exist_ok=True)
+            name = f"alert-{self._seq:04d}.events.jsonl"
+            with open(self.flight_dir / name, "w") as f:
+                for line in self.ring:
+                    f.write(line if line.endswith("\n") else line + "\n")
+            extra["events_file"] = name
+            if self.snapshot is not None and self.snapshot.exists():
+                # copy ORDER matters against a live engine replacing
+                # both files: snapshot first, sidecar second, so the
+                # pinned pair is (snap N, meta >= N) — snapshot_t then
+                # never understates the pinned state's instant and
+                # `whatif --resume <pin> --at <snapshot_t>` always lands
+                # at-or-after the restored clock.  snapshot_t is read
+                # from the COPY, never the (possibly newer) live file.
+                pin = f"alert-{self._seq:04d}.snap"
+                shutil.copyfile(self.snapshot, self.flight_dir / pin)
+                extra["snapshot_file"] = pin
+                meta = Path(str(self.snapshot) + ".meta.json")
+                if meta.exists():
+                    pinned_meta = self.flight_dir / (pin + ".meta.json")
+                    shutil.copyfile(meta, pinned_meta)
+                    try:
+                        extra["snapshot_t"] = float(
+                            json.loads(pinned_meta.read_text()).get("t", 0.0)
+                        )
+                    except (ValueError, TypeError):
+                        pass
+        self._emit_header()
+        severity = _SEVERITY[detector]
+        alert = self.sink.event(
+            "alert", t_end, None,
+            detector=detector, severity=severity, window_s=self.w,
+            value=value, threshold=threshold, seq=self._seq, **extra,
+        )
+        self.alerts.append(alert)
+        self.alert_counts[detector] = self.alert_counts.get(detector, 0) + 1
+        if self._reg_alerts is not None:
+            self._reg_alerts.labels(detector).inc()
+        if self._history is not None:
+            h = self.header
+            self._history.append(
+                "watch",
+                run_id=h.run_id if h else "",
+                config_hash=h.config_hash if h else "",
+                policy=h.policy if h else "",
+                seed=h.seed if h else None,
+                label=detector,
+                metrics={
+                    "t": t_end, "value": value, "threshold": threshold,
+                    "window_s": self.w, "severity": severity,
+                    "cause": cause, "seq": self._seq,
+                },
+            )
+        return alert
+
+    def _close_window(self, wend: float) -> List[dict]:
+        self.windows += 1
+        W = self.w
+        dets = self.rules["detectors"]
+        out: List[dict] = []
+        vel = self._vel_int / W
+        # the window's exact p99 queueing delay (jobs that started in it)
+        p99 = (
+            exact_quantile(self._win_waits, 0.99)
+            if self._win_waits else None
+        )
+
+        def settle(detector: str, condition: bool, *fire_args, **fire_kw) -> None:
+            if condition:
+                alert = self._fire(detector, wend, *fire_args, **fire_kw)
+                if alert is not None:
+                    out.append(alert)
+            else:
+                self._active_alerts.discard(detector)
+
+        cfg = dets.get("queue-depth-surge")
+        if cfg is not None:
+            floor = max(cfg["min_pending"],
+                        cfg["surge_factor"] * max(1.0, self._win_pend_start))
+            cond = self._pending >= floor
+            cause, legs = self._blame_wait()
+            settle("queue-depth-surge", cond, float(self._pending), floor,
+                   float(self._win_pend_start), cause, legs,
+                   p99_wait_s=p99)
+
+        cfg = dets.get("goodput-collapse")
+        if cfg is not None:
+            baseline = (
+                sum(self._vel_hist) / len(self._vel_hist)
+                if self._vel_hist else None
+            )
+            cond = (
+                baseline is not None
+                and baseline >= cfg["min_velocity"]
+                and vel <= cfg["collapse_frac"] * baseline
+                and (self._pending > 0 or self._running > 0)
+            )
+            cause, legs = self._blame_run()
+            settle(
+                "goodput-collapse", cond, vel,
+                (cfg["collapse_frac"] * baseline) if baseline is not None
+                else cfg["collapse_frac"],
+                baseline, cause, legs,
+            )
+            if "goodput-collapse" not in self._active_alerts:
+                # collapse windows stay out of their own baseline
+                self._vel_hist.append(vel)
+        else:
+            self._vel_hist.append(vel)
+
+        # sample-carried signals hold their last observation through
+        # windows the sampler skipped (piecewise-constant, like every
+        # other integrated signal here)
+        if self._win_frag is not None:
+            self._frag_held = self._win_frag
+        if self._win_hazard is not None:
+            self._hazard_held = self._win_hazard
+
+        cfg = dets.get("frag-creep")
+        if cfg is not None:
+            frag = self._frag_held
+            if frag is not None and frag >= cfg["frag_threshold"]:
+                self._frag_streak += 1
+            else:
+                self._frag_streak = 0
+            cond = self._frag_streak >= cfg["windows"]
+            settle("frag-creep", cond,
+                   frag if frag is not None else 0.0,
+                   cfg["frag_threshold"], float(self._frag_streak),
+                   "fragmentation", {})
+
+        cfg = dets.get("hazard-spike")
+        if cfg is not None:
+            hz = self._hazard_held
+            cond = hz is not None and hz >= cfg["hazard_threshold"]
+            settle("hazard-spike", cond, hz if hz is not None else 0.0,
+                   cfg["hazard_threshold"], None, "hazard", {})
+
+        cfg = dets.get("slo-burn")
+        if cfg is not None:
+            # started jobs breach by measured first wait; jobs still
+            # waiting for their FIRST start past the SLO count too —
+            # during a full outage nothing starts, and a burn detector
+            # that only samples starts would read a dead cluster as a
+            # healthy one.  Already-started jobs sitting requeued are
+            # excluded: their submit-relative age is not a queueing
+            # delay (the first-start semantics `_win_waits` uses)
+            overage = 0
+            for job_id in sorted(self._jobs):
+                j = self._jobs[job_id]
+                if not j.started and \
+                        (wend - j.submit_t) > cfg["wait_slo_s"]:
+                    overage += 1
+            total = len(self._win_waits) + overage
+            breached = self._win_breached + overage
+            budget = max(1e-9, 1.0 - cfg["target"])
+            fast = (breached / total / budget) if total else 0.0
+            self._slo_hist.append((total, breached))
+            slow_total = sum(n for n, _ in self._slo_hist)
+            slow_breached = sum(b for _, b in self._slo_hist)
+            slow = (slow_breached / slow_total / budget) if slow_total else 0.0
+            cond = fast >= cfg["fast_burn"] and slow >= cfg["slow_burn"]
+            cause, legs = self._blame_wait()
+            settle("slo-burn", cond, fast, cfg["fast_burn"], slow,
+                   cause, legs, p99_wait_s=p99)
+
+        # reset window accumulators
+        self._occ_int = self._pend_int = self._vel_int = 0.0
+        self._leg_int = {}
+        self._wait_int = {}
+        self._win_waits = []
+        self._win_breached = 0
+        self._win_lost = 0.0
+        self._win_revocations = 0
+        self._win_faults = 0
+        self._win_frag = None
+        self._win_hazard = None
+        self._win_pend_start = self._pending
+        return out
+
+    def _emit_header(self) -> None:
+        """Write the side stream's versioned header once — at the first
+        alert, or (zero-alert watches) at :meth:`finish`, so an
+        all-clear run still leaves the documented audit trail (run
+        identity + ``rules_hash``) instead of an empty headerless file
+        indistinguishable from a watcher that never ran."""
+        if self._header_out:
+            return
+        self._header_out = True
+        h = self.header
+        self.sink.write_header({
+            "run_id": h.run_id if h else "",
+            "policy": h.policy if h else "",
+            "seed": h.seed if h else None,
+            "config_hash": h.config_hash if h else "",
+            "source": self.source,
+            "window_s": self.w,
+            "rules_hash": rules_digest(self.rules),
+        })
+
+    # ------------------------------------------------------------------ #
+
+    def finish(self) -> dict:
+        """End of stream: the summary document.  The final *partial*
+        window is deliberately not evaluated — its statistics cover less
+        than one window of sim time, and every drive mode ends at the
+        same last record, so all three modes agree on the alert tail."""
+        self._emit_header()
+        self.sink.close()
+        h = self.header
+        return {
+            "events": self.n_events,
+            "end_t": self.end_t,
+            "windows": self.windows,
+            "window_s": self.w,
+            "alerts": len(self.alerts),
+            "alerts_by_detector": dict(sorted(self.alert_counts.items())),
+            "active": sorted(self._active_alerts),
+            "anomalies": self.anomalies,
+            "jobs_active": len(self._jobs),
+            "run_id": h.run_id if h else "",
+            "policy": h.policy if h else "",
+            "config_hash": h.config_hash if h else "",
+            "rules_hash": rules_digest(self.rules),
+        }
+
+
+# --------------------------------------------------------------------- #
+# drive modes: batch / replay / follow
+
+
+def iter_stream(path) -> Iterator[Tuple[int, str, dict]]:
+    """One-shot iteration over a finished events.jsonl(.gz) file —
+    the batch drive mode.  Exactly analyze.py's shared drive loop,
+    re-exported under the watch vocabulary."""
+    return iter_jsonl_items(path)
+
+
+def replay_stream(
+    path, *, speed: float = 0.0, sleep=time.sleep
+) -> Iterator[Tuple[int, str, dict]]:
+    """Pace a finished stream as-if-live by sim time: with ``speed`` sim
+    seconds per wall second, delivery sleeps between records so the
+    operator sees the incident unfold; ``speed=0`` (the default) paces
+    nothing.  Pacing only delays *delivery* — alert content is keyed to
+    sim time alone, so any speed produces the batch mode's exact alert
+    sequence (the determinism contract)."""
+    last_t: Optional[float] = None
+    for item in iter_stream(path):
+        rec = item[2]
+        t = rec.get("t")
+        if speed > 0.0 and t is not None:
+            t = float(t)
+            if last_t is not None and t > last_t:
+                sleep((t - last_t) / speed)
+            last_t = t
+        yield item
+
+
+def follow_stream(
+    path,
+    *,
+    poll_s: float = 0.5,
+    idle_timeout_s: Optional[float] = None,
+    max_wall_s: Optional[float] = None,
+) -> Iterator[Tuple[int, str, dict]]:
+    """Tail a growing events.jsonl: poll for appended bytes, parse the
+    complete records, RETAIN a mid-record truncated tail until the
+    writer completes it (the cursor re-reads it whole — never skipped).
+    Stops after ``idle_timeout_s`` seconds without growth, or
+    ``max_wall_s`` seconds total; both None tails forever.  Gzip streams
+    cannot be tailed (no stable append offset) — use ``--replay``."""
+    if str(path).endswith(".gz"):
+        raise StreamError(
+            f"{path}: gzip streams cannot be followed (no stable append "
+            "offset); decompress first or use --replay"
+        )
+    cursor = StreamCursor(name=str(path))
+    fh = None
+    start = time.monotonic()  # lint: allow[GS101] follow-mode polling is wall-clock by design; alert content derives from sim time only
+    last_growth = start
+    try:
+        while True:
+            if fh is None and os.path.exists(path):
+                fh = open(path, "r")
+            grew = False
+            if fh is not None:
+                while True:
+                    chunk = fh.read(1 << 16)
+                    if not chunk:
+                        break
+                    grew = True
+                    for item in cursor.feed(chunk):
+                        yield item
+            now = time.monotonic()  # lint: allow[GS101] same wall-clock poll loop as above
+            if grew:
+                last_growth = now
+                continue
+            if max_wall_s is not None and now - start >= max_wall_s:
+                break
+            if idle_timeout_s is not None and \
+                    now - last_growth >= idle_timeout_s:
+                break
+            time.sleep(poll_s)
+    finally:
+        if fh is not None:
+            fh.close()
+    # a tail fragment the writer completed without a final newline is a
+    # whole record; a fragment it never finished is dropped (strict=False
+    # — the stream simply ends there for this watcher)
+    for item in cursor.finish(strict=False):
+        yield item
+
+
+def run_watch(
+    stream: Iterator[Tuple[int, str, dict]],
+    watcher: Watcher,
+    on_alert=None,
+) -> dict:
+    """Drive one watcher over one record stream; returns the summary.
+    ``on_alert`` (e.g. a print) sees each alert the moment its window
+    closes — the live half of the loop."""
+    for _, raw, rec in stream:
+        for alert in watcher.feed(rec, raw):
+            if on_alert is not None:
+                on_alert(alert)
+    return watcher.finish()

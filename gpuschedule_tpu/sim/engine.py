@@ -2018,7 +2018,31 @@ class Simulator:
         engine between batches — ``self.now`` is still the previous batch
         time, the heap head at ``t`` is untouched — then re-arm at the
         next multiple past ``t``.  Cold path; the run loops pay one bool
-        test per batch when disarmed."""
+        test per batch when disarmed.
+
+        Tailable-sink contract (ISSUE 15): the snapshot itself flushes
+        the event sink (sim/snapshot.py ``snapshot_state``), so the
+        on-disk stream is always consistent AT the snapshot instant —
+        and a tiny ``<snapshot>.meta.json`` sidecar names that instant,
+        so a tailing watchtower (obs/watch.py) can pin "the nearest
+        snapshot before the incident" for ``whatif`` replay without
+        unpickling the full engine state.  The sidecar is replaced
+        BEFORE the snapshot: at every instant the on-disk meta's ``t``
+        is >= the on-disk snapshot's, so a concurrent watcher copying
+        snap-then-meta can never pair a snapshot with an OLDER sidecar
+        (its ``snapshot_t`` may overstate — harmless, ``whatif --at``
+        lands at-or-after the restored clock — but never understate)."""
+        import json as _json
+        import os as _os
+
+        meta = str(self._snap_path) + ".meta.json"
+        tmp = meta + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(_json.dumps(
+                {"t": self.now, "snapshot_writes": self._snap_writes + 1},
+                sort_keys=True,
+            ))
+        _os.replace(tmp, meta)
         self.snapshot(self._snap_path)
         every = self._snap_every
         nxt = self._snap_next

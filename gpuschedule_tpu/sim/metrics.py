@@ -163,6 +163,7 @@ class MetricsLog:
         run_meta: Optional[dict] = None,
         attribution: bool = False,
         cache_telemetry: bool = False,
+        flush_interval_s: Optional[float] = None,
     ) -> None:
         self.job_rows: List[dict] = []
         # Cache telemetry (ISSUE 10): when armed, the engine harvests
@@ -210,6 +211,25 @@ class MetricsLog:
                 self._sink_fh = events_sink
             else:
                 self._sink_path = Path(events_sink)
+        # Tailable-sink flush cadence (ISSUE 15): batching alone lets the
+        # tail of a live stream sit in the buffer for an unbounded sim
+        # span (512 records can be hours of a quiet replay), which a
+        # tailing watcher (obs/watch.py) would read as a stalled cluster.
+        # ``flush_interval_s`` arms a SIM-TIME cadence: whenever an event
+        # lands at or past the next multiple, the buffer AND the file
+        # handle flush, so the on-disk stream is never more than one
+        # interval of sim time behind the replay.  None (the default)
+        # keeps the pure 512-record batching — byte-for-byte the
+        # historical write pattern.  The engine's periodic snapshots
+        # flush independently (sim/snapshot.py snapshot_state), so a
+        # snapshot always lands on a stream consistent AT its instant —
+        # the watchtower's flight-recorder handshake.
+        if flush_interval_s is not None and flush_interval_s <= 0.0:
+            raise ValueError(
+                f"flush_interval_s must be > 0 seconds, got {flush_interval_s}"
+            )
+        self._flush_every = flush_interval_s
+        self._flush_next = flush_interval_s if flush_interval_s else None
         # Optional obs-layer registry (obs/metrics.py): counters mirror into
         # Prometheus counter families, per-job records feed JCT/queueing
         # histograms, and every utilization sample updates the occupancy
@@ -359,6 +379,16 @@ class MetricsLog:
             rec["job"] = job.job_id
         rec.update(extra)
         self._emit_record(rec)
+        if self._flush_every is not None and t >= self._flush_next:
+            # tailable-sink cadence (ISSUE 15): make everything up to and
+            # including this event durable, down to the OS
+            self.flush_events()
+            if self._sink_fh is not None:
+                self._sink_fh.flush()
+            nxt = self._flush_next
+            while nxt <= t:
+                nxt += self._flush_every
+            self._flush_next = nxt
 
     def close_events(self) -> None:
         """Flush (buffer included) and — when this log opened it — close

@@ -100,6 +100,9 @@ UNHASHED = {
                "the replay",
     "snapshot": "periodic snapshot writes are between-batch and "
                 "replay-neutral (resume byte-identity pinned, ISSUE 11)",
+    "flush_events": "sink flush cadence changes when bytes reach disk, "
+                    "never which bytes (tailable-sink contract, "
+                    "ISSUE 15)",
     "snapshot_every": "snapshot cadence, replay-neutral with --snapshot",
     "resume": "a resumed run's world comes from the snapshot, not the "
               "flags; finished outputs are byte-identical under v1",

@@ -296,3 +296,105 @@ def test_analyze_file_streams_jsonl(tmp_path):
     assert isinstance(an, RunAnalysis)
     assert an.goodput() == res.goodput
     assert len(an.jobs) == 25
+
+
+# --------------------------------------------------------------------- #
+# the three-way net-degraded split (ISSUE 15, retiring the PR-5 omission)
+
+
+def test_net_degraded_split_contention_and_toll(tmp_path):
+    """A netted multislice replay splits the folded net-degraded leg into
+    the static multislice toll plus the DCN-contention gap, and the
+    segments telescope back to the attribution leg (same semantics, up to
+    float re-association)."""
+    from gpuschedule_tpu.net import NetModel
+    from gpuschedule_tpu.net.sweep import promote_to_multislice
+
+    cluster = TpuCluster("v5e", dims=(4, 4), num_pods=2)
+    jobs = promote_to_multislice(
+        generate_poisson_trace(40, seed=9, mean_duration=1500.0),
+        0.3, cluster.pod_chips, seed=9,
+    )
+    sink = tmp_path / "ev.jsonl"
+    m = MetricsLog(events_sink=sink, attribution=True, run_meta=dict(META))
+    res = Simulator(
+        cluster, make_policy("fifo", backfill=True), jobs,
+        metrics=m, net=NetModel(),
+    ).run()
+    m.close_events()
+    an = analyze_file(sink)
+    split = an.net_degraded_split()
+    assert "multislice-toll" in split and split["multislice-toll"] > 0.0
+    # every segment is non-negative; contention appears only when gangs
+    # actually shared the fabric
+    assert all(v >= -1e-9 for v in split.values())
+    folded = res.delay_by_cause.get("net-degraded", 0.0)
+    assert sum(split.values()) == pytest.approx(folded, rel=1e-6)
+    # the split rides network() and the per-job JSON
+    assert an.network()["net_degraded_split"] == split
+    has_legs = [r for r in an.jobs if r.net_legs]
+    assert has_legs
+    for r in has_legs:
+        assert set(r.net_legs) <= {
+            "multislice-toll", "dcn-contention", "gpu-locality"}
+
+
+def test_net_degraded_split_gpu_locality(tmp_path):
+    """On a GPU cluster the static locality tier lands in the
+    gpu-locality segment (the track prefix names the cause)."""
+    from gpuschedule_tpu.cluster import GpuCluster
+
+    cluster = GpuCluster(
+        num_switches=2, nodes_per_switch=2, gpus_per_node=4,
+        scheme="random", seed=1,
+    )
+    jobs = generate_poisson_trace(25, seed=4, mean_duration=900.0)
+    sink = tmp_path / "ev.jsonl"
+    m = MetricsLog(events_sink=sink, attribution=True, run_meta=dict(META))
+    res = Simulator(cluster, FifoPolicy(), jobs, metrics=m).run()
+    m.close_events()
+    an = analyze_file(sink)
+    split = an.net_degraded_split()
+    folded = res.delay_by_cause.get("net-degraded", 0.0)
+    if folded > 0.0:
+        assert set(split) == {"gpu-locality"}
+        assert split["gpu-locality"] == pytest.approx(folded, rel=1e-6)
+    else:
+        assert split == {}
+
+
+def test_net_split_empty_without_locality_penalty(tmp_path):
+    """Full-locality runs carry no split — and no new JSON keys, so
+    historical analyzer documents keep their shape."""
+    sink = tmp_path / "ev.jsonl"
+    jobs = generate_poisson_trace(10, seed=5, mean_duration=400.0)
+    m = MetricsLog(events_sink=sink, run_meta=dict(META))
+    Simulator(SimpleCluster(8), FifoPolicy(), jobs, metrics=m).run()
+    m.close_events()
+    an = analyze_file(sink)
+    assert an.net_degraded_split() == {}
+    assert all("net_legs" not in r.to_json() for r in an.jobs)
+
+
+def test_net_split_identical_under_low_mem(tmp_path):
+    """The spill-backed analyzer derives the identical split (net_legs
+    round-trips the JSON spill bit-exactly)."""
+    from gpuschedule_tpu.net import NetModel
+    from gpuschedule_tpu.net.sweep import promote_to_multislice
+
+    cluster = TpuCluster("v5e", dims=(4, 4), num_pods=2)
+    jobs = promote_to_multislice(
+        generate_poisson_trace(30, seed=2, mean_duration=1200.0),
+        0.3, cluster.pod_chips, seed=2,
+    )
+    sink = tmp_path / "ev.jsonl"
+    m = MetricsLog(events_sink=sink, attribution=True, run_meta=dict(META))
+    Simulator(
+        cluster, make_policy("fifo", backfill=True), jobs,
+        metrics=m, net=NetModel(),
+    ).run()
+    m.close_events()
+    a = analyze_file(sink)
+    b = analyze_file(sink, low_memory=True)
+    assert a.net_degraded_split() == b.net_degraded_split()
+    assert [r.net_legs for r in a.jobs] == [r.net_legs for r in b.jobs]

@@ -1,0 +1,877 @@
+"""Watchtower tests (ISSUE 15): detector semantics, the determinism
+contract across drive modes (batch / replay / follow-chunked, including
+mid-record truncated tails), the flight-recorder/snapshot handshake, and
+the end-to-end incident drill the acceptance criteria name.
+"""
+
+import gzip
+import json
+import math
+from pathlib import Path
+
+import pytest
+
+from gpuschedule_tpu.obs.analyze import (
+    StreamCursor,
+    StreamError,
+    analyze_events,
+    analyze_file,
+    iter_jsonl_records,
+)
+from gpuschedule_tpu.obs.history import HistoryStore
+from gpuschedule_tpu.obs.metrics import MetricsRegistry
+from gpuschedule_tpu.obs.watch import (
+    ALERTS_SCHEMA,
+    DEFAULT_RULES,
+    AlertStream,
+    Watcher,
+    follow_stream,
+    iter_stream,
+    load_rules,
+    replay_stream,
+    run_watch,
+    rules_digest,
+)
+
+
+# --------------------------------------------------------------------- #
+# synthetic stream builders
+
+
+def _header(**kw):
+    rec = {"schema": 1, "run_id": "w", "seed": 0, "policy": "fifo",
+           "config_hash": "h", "total_chips": 32}
+    rec.update(kw)
+    return rec
+
+
+def _lines(records):
+    return "".join(json.dumps(r) + "\n" for r in records)
+
+
+def _write(tmp_path, records, name="ev.jsonl"):
+    p = tmp_path / name
+    p.write_text(_lines(records))
+    return p
+
+
+def _surge_stream(n=20, window=100.0):
+    """Arrivals piling up with nothing starting: queue-depth surge."""
+    recs = [_header()]
+    for i in range(n):
+        recs.append({"t": 5.0 * i, "event": "arrival", "job": f"j{i}",
+                     "chips": 8, "duration": 1000.0, "status": "Pass"})
+    recs.append({"t": 4 * window, "event": "arrival", "job": "late",
+                 "chips": 8, "duration": 1000.0, "status": "Pass"})
+    return recs
+
+
+def _collapse_stream(window=100.0):
+    """Steady work velocity, then every gang revokes: goodput collapse
+    blamed fault-outage."""
+    recs = [_header()]
+    prog = {"work": 0.0, "service": 0.0, "lost_service": 0.0,
+            "overhead_service": 0.0, "lost_work": 0.0, "overhead_left": 0.0}
+    for i in range(4):
+        recs.append({"t": 0.0, "event": "arrival", "job": f"j{i}",
+                     "chips": 8, "duration": 1e6, "status": "Pass"})
+        recs.append({"t": 0.0, "event": "start", "job": f"j{i}", "chips": 8,
+                     "speed": 1.0, "overhead": 0.0, "locality": 1.0,
+                     "track": f"pod0/2x4@0,{i}", "prog": dict(prog)})
+    # five healthy windows establish the baseline, then the outage
+    t_fault = 5 * window + 10.0
+    recs.append({"t": t_fault, "event": "fault", "scope": "pod0",
+                 "fault": "maintenance", "fid": 0, "duration": "inf"})
+    for i in range(4):
+        recs.append({"t": t_fault, "event": "revoke", "job": f"j{i}",
+                     "scope": "pod0", "fault": "maintenance",
+                     "lost_work": 100.0, "restore": 60.0,
+                     "track": f"pod0/2x4@0,{i}", "prog": dict(prog)})
+    # quiet tail so later windows close
+    recs.append({"t": 9 * window, "event": "arrival", "job": "tail",
+                 "chips": 8, "duration": 10.0, "status": "Pass"})
+    return recs
+
+
+def _hazard_stream(window=100.0):
+    recs = [_header()]
+    recs.append({"t": 1.0, "event": "arrival", "job": "j0", "chips": 1,
+                 "duration": 1e6, "status": "Pass"})
+    recs.append({"t": 150.0, "event": "sample", "used": 0, "unhealthy": 0,
+                 "running": 0, "pending": 1, "frag": 0.0,
+                 "pods": [{"used": 0, "frag": 0.0, "hazard": 2.5}]})
+    recs.append({"t": 3 * window, "event": "sample", "used": 0,
+                 "unhealthy": 0, "running": 0, "pending": 1, "frag": 0.0,
+                 "pods": [{"used": 0, "frag": 0.0, "hazard": 0.1}]})
+    return recs
+
+
+def _frag_stream(window=100.0, windows=4):
+    """``windows`` consecutive high-frag samples (one per window), then
+    a clean sample ending the streak, then a closing tick."""
+    recs = [_header()]
+    recs.append({"t": 1.0, "event": "arrival", "job": "j0", "chips": 1,
+                 "duration": 1e6, "status": "Pass"})
+    for i in range(windows):
+        recs.append({"t": 50.0 + i * window, "event": "sample", "used": 8,
+                     "unhealthy": 0, "running": 1, "pending": 0,
+                     "frag": 0.9})
+    recs.append({"t": 50.0 + windows * window, "event": "sample", "used": 8,
+                 "unhealthy": 0, "running": 1, "pending": 0, "frag": 0.0})
+    recs.append({"t": (windows + 2) * window, "event": "sample", "used": 8,
+                 "unhealthy": 0, "running": 1, "pending": 0, "frag": 0.0})
+    return recs
+
+
+# --------------------------------------------------------------------- #
+# rules
+
+
+def test_default_rules_complete():
+    rules = load_rules()
+    assert set(rules["detectors"]) == {
+        "queue-depth-surge", "goodput-collapse", "frag-creep",
+        "hazard-spike", "slo-burn",
+    }
+    assert rules["window_s"] > 0
+
+
+def test_rules_overlay_and_validation(tmp_path):
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps({
+        "window_s": 60.0,
+        "detectors": {
+            "frag-creep": False,
+            "slo-burn": {"wait_slo_s": 100.0},
+        },
+    }))
+    rules = load_rules(p)
+    assert rules["window_s"] == 60.0
+    assert "frag-creep" not in rules["detectors"]
+    assert rules["detectors"]["slo-burn"]["wait_slo_s"] == 100.0
+    # untouched knobs keep defaults
+    assert rules["detectors"]["slo-burn"]["target"] == \
+        DEFAULT_RULES["detectors"]["slo-burn"]["target"]
+
+    with pytest.raises(ValueError, match="unknown detectors"):
+        load_rules({"detectors": {"nope": {}}})
+    with pytest.raises(ValueError, match="unknown keys"):
+        load_rules({"detectors": {"slo-burn": {"typo": 1.0}}})
+    with pytest.raises(ValueError, match="unknown rules keys"):
+        load_rules({"windows": 5})
+    with pytest.raises(ValueError, match="must be > 0"):
+        load_rules({"window_s": 0.0})
+    # whole windows/records only: int(0.5) would silently disable the
+    # goodput-collapse baseline / the flight recorder
+    with pytest.raises(ValueError, match="integer >= 1"):
+        load_rules({"baseline_windows": 0.5})
+    with pytest.raises(ValueError, match="integer >= 1"):
+        load_rules({"ring": 0})
+    assert load_rules({"baseline_windows": 3})["baseline_windows"] == 3
+
+
+def test_rules_digest_stable_and_sensitive():
+    a = load_rules()
+    b = load_rules({"window_s": DEFAULT_RULES["window_s"]})
+    assert rules_digest(a) == rules_digest(b)
+    c = load_rules({"window_s": 60.0})
+    assert rules_digest(a) != rules_digest(c)
+
+
+# --------------------------------------------------------------------- #
+# the shared incremental reader
+
+
+def test_stream_cursor_retains_truncated_tail():
+    cur = StreamCursor("t")
+    recs = [r for _, _, r in cur.feed('{"a": 1}\n{"b"')]
+    assert recs == [{"a": 1}]
+    assert cur.pending == '{"b"'
+    # the fragment is re-read WHOLE once completed — not skipped
+    recs = [r for _, _, r in cur.feed(': 2}\n')]
+    assert recs == [{"b": 2}]
+    assert cur.pending == ""
+
+
+def test_stream_cursor_finish_strict_vs_lenient():
+    cur = StreamCursor("t")
+    cur.feed('{"a": 1}\n{"bad')
+    with pytest.raises(StreamError, match="truncated or corrupt"):
+        cur.finish()
+    cur2 = StreamCursor("t")
+    cur2.feed('{"bad')
+    assert cur2.finish(strict=False) == []
+    # a complete record missing only its newline parses at finish
+    cur3 = StreamCursor("t")
+    cur3.feed('{"ok": 1}')
+    assert [r for _, _, r in cur3.finish()] == [{"ok": 1}]
+
+
+def test_stream_cursor_corrupt_mid_stream_raises():
+    cur = StreamCursor("t")
+    with pytest.raises(StreamError, match=":2:"):
+        cur.feed('{"a": 1}\nnot json\n')
+
+
+def test_iter_jsonl_matches_analyze_file(tmp_path):
+    recs = _surge_stream()
+    p = _write(tmp_path, recs)
+    assert list(iter_jsonl_records(p)) == recs
+    # gzip transparently
+    gz = tmp_path / "ev.jsonl.gz"
+    with gzip.open(gz, "wt") as f:
+        f.write(_lines(recs))
+    assert list(iter_jsonl_records(gz)) == recs
+    # analyze_file still refuses truncated tails through the shared path
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(_lines(recs) + '{"trunc')
+    with pytest.raises(StreamError, match="truncated or corrupt"):
+        analyze_file(bad)
+
+
+# --------------------------------------------------------------------- #
+# detectors
+
+
+def _watch(records, rules):
+    w = Watcher(load_rules(rules))
+    for rec in records:
+        w.feed(rec)
+    w.finish()
+    return w
+
+
+_BASE_OFF = {
+    "queue-depth-surge": False, "goodput-collapse": False,
+    "frag-creep": False, "hazard-spike": False, "slo-burn": False,
+}
+
+
+def _only(name, cfg=None):
+    d = dict(_BASE_OFF)
+    del d[name]
+    if cfg is not None:
+        d[name] = cfg
+    return d
+
+
+def test_queue_depth_surge_fires_and_blames_wait_cause():
+    w = _watch(_surge_stream(), {
+        "window_s": 100.0,
+        "detectors": _only("queue-depth-surge",
+                           {"min_pending": 8.0, "surge_factor": 2.0}),
+    })
+    assert [a["detector"] for a in w.alerts] == ["queue-depth-surge"]
+    a = w.alerts[0]
+    assert a["event"] == "alert" and a["severity"] == "ticket"
+    assert a["t"] % 100.0 == 0.0  # fires only at a window boundary
+    assert a["cause"] == "unattributed"  # capture had no --attrib causes
+    assert a["value"] >= a["threshold"]
+
+
+def test_goodput_collapse_fires_within_one_window_blamed_fault():
+    w = _watch(_collapse_stream(), {
+        "window_s": 100.0,
+        "detectors": _only("goodput-collapse",
+                           {"collapse_frac": 0.5, "min_velocity": 0.5}),
+    })
+    assert [a["detector"] for a in w.alerts] == ["goodput-collapse"]
+    a = w.alerts[0]
+    # fault at 510: the [500, 600) window fires at 600 — within one
+    # detector window of the fault
+    assert a["t"] == 600.0
+    assert a["cause"] == "fault-outage"
+    assert a["legs"]["fault-outage"] == pytest.approx(400.0)
+    assert a["severity"] == "page"
+    # latched: the collapse persists for several windows, one alert
+    assert len(w.alerts) == 1
+
+
+def test_hazard_spike_from_sample_hazard():
+    w = _watch(_hazard_stream(), {
+        "window_s": 100.0,
+        "detectors": _only("hazard-spike", {"hazard_threshold": 1.0}),
+    })
+    assert [a["detector"] for a in w.alerts] == ["hazard-spike"]
+    assert w.alerts[0]["value"] == 2.5
+    assert w.alerts[0]["t"] == 200.0
+
+
+def test_frag_creep_needs_consecutive_windows():
+    rules = {
+        "window_s": 100.0,
+        "detectors": _only("frag-creep",
+                           {"frag_threshold": 0.5, "windows": 3}),
+    }
+    w = _watch(_frag_stream(windows=4), rules)
+    assert [a["detector"] for a in w.alerts] == ["frag-creep"]
+    assert w.alerts[0]["t"] == 300.0  # third consecutive bad window
+    # two bad windows then a clean one: never fires
+    w2 = _watch(_frag_stream(windows=2), rules)
+    assert w2.alerts == []
+
+
+def test_frag_creep_holds_through_sample_free_windows():
+    """A capture whose --sample-interval is coarser than window_s must
+    not read sample-free windows as healthy: the last observation holds
+    (piecewise-constant), so sustained fragmentation still fires."""
+    window = 100.0
+    recs = [_header()]
+    recs.append({"t": 1.0, "event": "arrival", "job": "j0", "chips": 1,
+                 "duration": 1e6, "status": "Pass"})
+    # samples every 250 s — most windows carry none
+    for i in range(3):
+        recs.append({"t": 50.0 + i * 250.0, "event": "sample", "used": 8,
+                     "unhealthy": 0, "running": 1, "pending": 0,
+                     "frag": 0.9})
+    recs.append({"t": 900.0, "event": "sample", "used": 8, "unhealthy": 0,
+                 "running": 1, "pending": 0, "frag": 0.9})
+    w = _watch(recs, {
+        "window_s": window,
+        "detectors": _only("frag-creep",
+                           {"frag_threshold": 0.5, "windows": 3}),
+    })
+    assert [a["detector"] for a in w.alerts] == ["frag-creep"]
+    assert w.alerts[0]["t"] == 300.0
+
+
+def test_slo_burn_counts_still_queued_overage():
+    # nothing ever starts: burn must still fire from queued-job overage
+    window = 100.0
+    recs = [_header()]
+    for i in range(10):
+        recs.append({"t": 1.0, "event": "arrival", "job": f"j{i}",
+                     "chips": 8, "duration": 100.0, "status": "Pass"})
+    recs.append({"t": 12 * window, "event": "arrival", "job": "tail",
+                 "chips": 8, "duration": 100.0, "status": "Pass"})
+    w = _watch(recs, {
+        "window_s": window,
+        "detectors": _only("slo-burn", {
+            "wait_slo_s": 300.0, "target": 0.9, "fast_burn": 5.0,
+            "slow_burn": 2.0, "slow_windows": 4,
+        }),
+    })
+    assert [a["detector"] for a in w.alerts] == ["slo-burn"]
+    a = w.alerts[0]
+    assert a["t"] >= 400.0  # after the waits age past the SLO
+    assert a["value"] >= 5.0 and a["baseline"] >= 2.0
+
+
+def test_alerts_latch_and_rearm():
+    """A detector fires on the rising edge, stays silent while the
+    condition persists, and re-fires after a clean window."""
+    window = 100.0
+    recs = [_header()]
+    # surge (windows 0-2), drain (window 3), surge again (windows 4+)
+    for i in range(12):
+        recs.append({"t": 2.0 * i, "event": "arrival", "job": f"a{i}",
+                     "chips": 1, "duration": 1e6, "status": "Pass"})
+    prog = {"work": 0.0, "service": 0.0, "lost_service": 0.0,
+            "overhead_service": 0.0, "lost_work": 0.0, "overhead_left": 0.0}
+    for i in range(12):
+        recs.append({"t": 250.0 + i, "event": "start", "job": f"a{i}",
+                     "chips": 1, "speed": 1.0, "overhead": 0.0,
+                     "locality": 1.0, "track": "pool",
+                     "prog": dict(prog)})
+    for i in range(12):
+        recs.append({"t": 400.0 + i, "event": "arrival", "job": f"b{i}",
+                     "chips": 1, "duration": 1e6, "status": "Pass"})
+    recs.append({"t": 700.0, "event": "arrival", "job": "tail",
+                 "chips": 1, "duration": 1.0, "status": "Pass"})
+    w = _watch(recs, {
+        "window_s": window,
+        "detectors": _only("queue-depth-surge",
+                           {"min_pending": 6.0, "surge_factor": 2.0}),
+    })
+    ts = [a["t"] for a in w.alerts]
+    assert len(ts) == 2 and ts[0] < 400.0 <= ts[1]
+
+
+# --------------------------------------------------------------------- #
+# determinism across drive modes
+
+
+def _drill_world(tmp_path, *, snapshot=False, max_time=2400.0):
+    """A real engine world with an injected pod outage: TPU 2-pod fleet,
+    fifo+backfill, events + attribution (+ optional periodic
+    snapshots)."""
+    from gpuschedule_tpu.cluster.tpu import TpuCluster
+    from gpuschedule_tpu.faults.recovery import FaultPlan, RecoveryModel
+    from gpuschedule_tpu.faults.schedule import FaultRecord
+    from gpuschedule_tpu.policies import make_policy
+    from gpuschedule_tpu.sim import Job, Simulator
+    from gpuschedule_tpu.sim.metrics import MetricsLog
+
+    events = tmp_path / "events.jsonl"
+    snap = tmp_path / "engine.snap"
+    cluster = TpuCluster("v5e", dims=(4, 4), num_pods=2)
+    # a deterministic saturating trace: one 8-chip/1500 s gang every
+    # 60 s keeps all 32 chips busy (4 gangs at a time), so the pod-0
+    # outage at t=1230 halves the work velocity in its own window
+    jobs = [Job(f"j{i}", 60.0 * i, 8, 1500.0) for i in range(40)]
+    plan = FaultPlan(
+        records=[FaultRecord(1230.0, ("pod", 0), 50_000.0, "maintenance")],
+        recovery=RecoveryModel(restore=60.0),
+    )
+    ml = MetricsLog(
+        events_sink=events, attribution=True,
+        run_meta={"run_id": "drill", "seed": 11, "policy": "fifo",
+                  "config_hash": "drillhash"},
+    )
+    with ml:
+        sim = Simulator(
+            cluster, make_policy("fifo", backfill=True), jobs,
+            metrics=ml, faults=plan, max_time=max_time,
+            sample_interval=120.0,
+            snapshot_every=600.0 if snapshot else None,
+            snapshot_path=snap if snapshot else None,
+        )
+        sim.run()
+    return events, snap
+
+
+_DRILL_RULES = {
+    "window_s": 600.0,
+    "detectors": {
+        "queue-depth-surge": {"min_pending": 6.0, "surge_factor": 2.0},
+        "goodput-collapse": {"collapse_frac": 0.6, "min_velocity": 0.5},
+        "frag-creep": False,
+        "hazard-spike": False,
+        "slo-burn": {"wait_slo_s": 900.0, "target": 0.9, "fast_burn": 4.0,
+                     "slow_burn": 1.5, "slow_windows": 4},
+    },
+}
+
+
+def _alert_bytes(alerts):
+    return [json.dumps(a, sort_keys=True) for a in alerts]
+
+
+def test_watch_determinism_across_modes(tmp_path):
+    """Same stream + same rules -> byte-identical alert sequence across
+    one-shot batch, --replay, and --follow-style chunked ingestion —
+    including a chunking that splits records mid-byte (the truncated
+    tail must be re-read, not skipped)."""
+    events, _ = _drill_world(tmp_path, max_time=4000.0)
+
+    def fresh():
+        return Watcher(load_rules(_DRILL_RULES), source=str(events))
+
+    # batch
+    w_batch = fresh()
+    batch_summary = run_watch(iter_stream(events), w_batch)
+    assert w_batch.alerts, "drill world must raise at least one alert"
+
+    # replay (paced by sim time; speed irrelevant to content)
+    sleeps = []
+    w_replay = fresh()
+    replay_summary = run_watch(
+        replay_stream(events, speed=1e9, sleep=sleeps.append), w_replay)
+    assert sleeps, "replay pacing must have requested sleeps"
+
+    # follow-style: the same bytes fed through the cursor in adversarial
+    # chunk sizes (7 bytes: every record is split mid-JSON repeatedly)
+    text = events.read_text()
+    cur = StreamCursor(str(events))
+    w_follow = fresh()
+    for i in range(0, len(text), 7):
+        for _, raw, rec in cur.feed(text[i:i + 7]):
+            w_follow.feed(rec, raw)
+    for _, raw, rec in cur.finish(strict=False):
+        w_follow.feed(rec, raw)
+    follow_summary = w_follow.finish()
+
+    assert _alert_bytes(w_batch.alerts) == _alert_bytes(w_replay.alerts)
+    assert _alert_bytes(w_batch.alerts) == _alert_bytes(w_follow.alerts)
+    assert batch_summary == replay_summary == follow_summary
+
+
+def test_follow_stream_reads_growing_file(tmp_path):
+    """The real --follow driver over a file written in arbitrary chunks
+    (including mid-record) yields the complete record sequence."""
+    recs = _surge_stream()
+    text = _lines(recs)
+    p = tmp_path / "grow.jsonl"
+    p.write_text("")
+
+    chunks = [text[i:i + 13] for i in range(0, len(text), 13)]
+
+    # interleave appends with the generator's polls: append one chunk
+    # per sleep, so the tail is usually mid-record when the poll fires
+    it = iter(chunks)
+
+    def feeder():
+        got = []
+        gen = follow_stream(p, poll_s=0.0, idle_timeout_s=None)
+        # drive manually: append a chunk, then pull everything available
+        import time as _t
+
+        orig_sleep = _t.sleep
+        try:
+            def sleep_and_append(_s):
+                chunk = next(it, None)
+                if chunk is None:
+                    raise StopIteration
+                with open(p, "a") as f:
+                    f.write(chunk)
+
+            _t.sleep = sleep_and_append
+            try:
+                for _, _, rec in gen:
+                    got.append(rec)
+            except (StopIteration, RuntimeError):
+                pass
+        finally:
+            _t.sleep = orig_sleep
+        return got
+
+    got = feeder()
+    # the generator stops when the feeder runs dry mid-iteration; at
+    # minimum every record completed before the last chunk must be seen
+    assert got == recs[:len(got)]
+    assert len(got) >= len(recs) - 1
+
+    # a finished file with an idle timeout reads to the end
+    p2 = tmp_path / "done.jsonl"
+    p2.write_text(text)
+    got2 = [rec for _, _, rec in
+            follow_stream(p2, poll_s=0.01, idle_timeout_s=0.05)]
+    assert got2 == recs
+
+
+def test_follow_refuses_gzip(tmp_path):
+    gz = tmp_path / "ev.jsonl.gz"
+    with gzip.open(gz, "wt") as f:
+        f.write(_lines(_surge_stream()))
+    with pytest.raises(StreamError, match="cannot be followed"):
+        list(follow_stream(gz, idle_timeout_s=0.01))
+
+
+# --------------------------------------------------------------------- #
+# side stream / history / registry / flight recorder
+
+
+def test_alert_side_stream_header_and_records(tmp_path):
+    events, _ = _drill_world(tmp_path, max_time=4000.0)
+    alerts_path = tmp_path / "alerts.jsonl"
+    w = Watcher(load_rules(_DRILL_RULES), alerts=AlertStream(alerts_path),
+                source=str(events))
+    run_watch(iter_stream(events), w)
+    lines = [json.loads(ln) for ln in
+             alerts_path.read_text().strip().splitlines()]
+    header, records = lines[0], lines[1:]
+    assert header["schema"] == ALERTS_SCHEMA
+    assert header["stream"] == "alerts"          # the side-stream marker
+    assert header["run_id"] == "drill"
+    assert header["rules_hash"] == rules_digest(load_rules(_DRILL_RULES))
+    assert records and all(r["event"] == "alert" for r in records)
+    assert [r for r in records] == w.alerts
+    # seq is the 1-based alert ordinal
+    assert [r["seq"] for r in records] == list(range(1, len(records) + 1))
+
+
+def test_zero_alert_watch_still_writes_header(tmp_path):
+    """An all-clear watch leaves the versioned header (run identity +
+    rules_hash audit trail), never an empty headerless file."""
+    quiet = _write(tmp_path, [
+        _header(),
+        {"t": 1.0, "event": "arrival", "job": "j0", "chips": 1,
+         "duration": 5.0, "status": "Pass"},
+    ], name="quiet.jsonl")
+    alerts_path = tmp_path / "alerts.jsonl"
+    w = Watcher(load_rules(), alerts=AlertStream(alerts_path),
+                source=str(quiet))
+    run_watch(iter_stream(quiet), w)
+    assert w.alerts == []
+    lines = [json.loads(ln) for ln in
+             alerts_path.read_text().strip().splitlines()]
+    assert len(lines) == 1
+    assert lines[0]["stream"] == "alerts" and lines[0]["run_id"] == "w"
+    assert lines[0]["rules_hash"] == rules_digest(load_rules())
+
+
+def test_slo_burn_ignores_requeued_started_jobs():
+    """A job that met its first-start SLO and is later preempted must
+    not count as a breach by submit-relative age while requeued (the
+    overage matches the first-start semantics the breach counter uses)."""
+    window = 100.0
+    recs = [_header()]
+    prog = {"work": 0.0, "service": 0.0, "lost_service": 0.0,
+            "overhead_service": 0.0, "lost_work": 0.0, "overhead_left": 0.0}
+    for i in range(6):
+        recs.append({"t": 0.0, "event": "arrival", "job": f"j{i}",
+                     "chips": 1, "duration": 1e6, "status": "Pass"})
+        recs.append({"t": 1.0, "event": "start", "job": f"j{i}", "chips": 1,
+                     "speed": 1.0, "overhead": 0.0, "locality": 1.0,
+                     "track": "pool", "prog": dict(prog)})
+    # all six preempted at t=150, sitting requeued for many windows
+    for i in range(6):
+        recs.append({"t": 150.0, "event": "preempt", "job": f"j{i}",
+                     "suspend": False, "track": "pool",
+                     "prog": dict(prog)})
+    recs.append({"t": 15 * window, "event": "arrival", "job": "tail",
+                 "chips": 1, "duration": 1.0, "status": "Pass"})
+    w = _watch(recs, {
+        "window_s": window,
+        "detectors": _only("slo-burn", {
+            "wait_slo_s": 300.0, "target": 0.9, "fast_burn": 5.0,
+            "slow_burn": 2.0, "slow_windows": 4,
+        }),
+    })
+    assert w.alerts == []  # their first waits (1 s) all met the SLO
+
+
+def test_history_rows_and_counter_agree(tmp_path):
+    events, _ = _drill_world(tmp_path, max_time=4000.0)
+    registry = MetricsRegistry()
+    store = HistoryStore(tmp_path / "h.sqlite")
+    w = Watcher(load_rules(_DRILL_RULES), registry=registry, history=store,
+                source=str(events))
+    run_watch(iter_stream(events), w)
+    counter = registry.counter(
+        "watch_alerts_total", labelnames=("detector",))
+    by_label = {lv[0]: v for lv, v in counter.labeled_values().items()}
+    assert by_label == {k: float(v) for k, v in w.alert_counts.items()}
+    for det, n in w.alert_counts.items():
+        assert store.count(kind="watch", label=det) == n
+    assert store.count(kind="watch") == len(w.alerts)
+    rows = store.rows(kind="watch")
+    assert all(r.run_id == "drill" and r.config_hash == "drillhash"
+               for r in rows)
+    store.close()
+
+
+def test_analyzer_skips_alert_records():
+    """An alert record riding an analyzed file is counted, never a
+    lifecycle transition (combined/concatenated streams)."""
+    recs = [_header(),
+            {"t": 1.0, "event": "arrival", "job": "j0", "chips": 1,
+             "duration": 5.0, "status": "Pass"},
+            {"t": 2.0, "event": "alert", "detector": "slo-burn",
+             "severity": "page", "window_s": 60.0, "value": 9.0,
+             "threshold": 5.0, "seq": 1, "cause": "capacity", "legs": {}}]
+    a = analyze_events(iter(recs))
+    assert a.counts.get("alert") == 1
+    assert len(a.jobs) == 1
+
+
+# --------------------------------------------------------------------- #
+# the incident drill (ISSUE 15 acceptance criterion)
+
+
+def test_incident_drill_end_to_end(tmp_path):
+    """A replayed world with an injected pod outage raises a goodput-
+    collapse alert within one detector window of the fault; the alert's
+    history row and watch_alerts_total counter agree; and the flight-
+    recorder-pinned snapshot restores into a whatif drain query that
+    returns a nonzero attributed delta."""
+    from gpuschedule_tpu.sim import Simulator
+    from gpuschedule_tpu.sim.whatif import WhatIfService
+
+    # the run ends AT the alert window, so the snapshot file on disk is
+    # the newest pre-incident state — what a live `watch --follow` of a
+    # `run --snapshot` engine would pin at detection time
+    events, snap = _drill_world(tmp_path, snapshot=True, max_time=1800.0)
+    assert snap.exists()
+    meta = json.loads(Path(str(snap) + ".meta.json").read_text())
+    assert meta["t"] <= 1800.0
+
+    registry = MetricsRegistry()
+    store = HistoryStore(tmp_path / "h.sqlite")
+    flight = tmp_path / "flight"
+    w = Watcher(
+        load_rules(_DRILL_RULES),
+        alerts=AlertStream(tmp_path / "alerts.jsonl"),
+        flight_dir=flight, snapshot=snap,
+        registry=registry, history=store, source=str(events),
+    )
+    run_watch(iter_stream(events), w)
+
+    collapse = [a for a in w.alerts if a["detector"] == "goodput-collapse"]
+    assert collapse, f"no goodput-collapse among {w.alert_counts}"
+    alert = collapse[0]
+    # the fault lands at t=1230; one 600 s window boundary later is 1800
+    assert 1230.0 <= alert["t"] <= 1800.0
+    assert alert["cause"] == "fault-outage"
+
+    # history row and counter agree for the collapse detector
+    counter = registry.counter(
+        "watch_alerts_total", labelnames=("detector",))
+    assert counter.labeled_values()[("goodput-collapse",)] == \
+        store.count(kind="watch", label="goodput-collapse") == len(collapse)
+
+    # flight recorder: ring dump + pinned snapshot + sim-time sidecar
+    dump = flight / alert["events_file"]
+    assert dump.exists()
+    dumped = [json.loads(ln) for ln in
+              dump.read_text().strip().splitlines()]
+    assert dumped and all("t" in r or "schema" in r for r in dumped)
+    pin = flight / alert["snapshot_file"]
+    assert pin.exists()
+    assert alert["snapshot_t"] == meta["t"] <= alert["t"]
+
+    # the pinned snapshot restores into a whatif drain query with a
+    # nonzero attributed delta (detached from the watched stream: the
+    # restore must never truncate events.jsonl)
+    before = events.read_bytes()
+    sim = Simulator.restore(pin, events_sink=False)
+    sim.metrics.record_events = False
+    sim.metrics.events = []
+    sim.max_time = float("inf")
+    assert sim.now <= alert["t"]
+    sim.run_until(alert["t"])
+    svc = WhatIfService(sim, horizon=8000.0, workers=0)
+    try:
+        results = svc.evaluate(
+            [{"kind": "drain", "scope": ["pod", 1], "duration": 4000.0}])
+    finally:
+        svc.close()
+    delta = results[0]["delta"]
+    assert any(v != 0.0 for v in delta["goodput"].values()) or \
+        delta["avg_jct_s"] != 0.0 or delta["num_finished"] != 0
+    # the attribution split rode along (the run was --attrib-armed)
+    assert results[0]["base"]["delay_by_cause"]
+    assert events.read_bytes() == before
+    store.close()
+
+
+def test_whatif_resume_cli_on_pinned_snapshot(tmp_path, capsys):
+    """`whatif --resume <pin> --at <alert t>`: the CLI half of the
+    flight-recorder handshake."""
+    from gpuschedule_tpu.cli import main
+
+    events, snap = _drill_world(tmp_path, snapshot=True)
+    before = events.read_bytes()
+    rc = main([
+        "whatif", "--resume", str(snap), "--at", "2400",
+        "--drain", "pod=1,duration=4000", "--horizon", "8000",
+    ])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 0
+    doc = json.loads(out[-1])
+    assert doc["config_hash"] == "drillhash"
+    assert doc["run_id"] == "drill"
+    assert doc["queries"][0]["query"]["kind"] == "drain"
+    assert events.read_bytes() == before  # the mirror never writes back
+
+
+# --------------------------------------------------------------------- #
+# engine-side plumbing (flush cadence, snapshot sidecar, sample hazard)
+
+
+def test_flush_interval_makes_stream_tailable(tmp_path):
+    """With --flush-events armed, the on-disk stream is never more than
+    one interval of sim time behind the replay (the 512-record batch
+    would otherwise hold a quiet replay's entire tail)."""
+    from gpuschedule_tpu.cluster.base import SimpleCluster
+    from gpuschedule_tpu.policies import make_policy
+    from gpuschedule_tpu.sim import Simulator
+    from gpuschedule_tpu.sim.metrics import MetricsLog
+    from gpuschedule_tpu.sim.trace import generate_poisson_trace
+
+    sink = tmp_path / "ev.jsonl"
+    ml = MetricsLog(events_sink=sink, flush_interval_s=50.0)
+    jobs = generate_poisson_trace(30, seed=2, mean_duration=500.0)
+    sim = Simulator(SimpleCluster(16), make_policy("fifo"), jobs, metrics=ml)
+    sim.run_until(2000.0)
+    # NOT closed/flushed explicitly: the cadence alone must have pushed
+    # records to disk well past the first flush boundary
+    on_disk = [json.loads(ln) for ln in
+               sink.read_text().strip().splitlines() if ln]
+    assert on_disk, "cadence never flushed"
+    last_t = max(r.get("t", 0.0) for r in on_disk if "t" in r)
+    assert last_t >= 1000.0
+    with pytest.raises(ValueError, match="flush_interval_s"):
+        MetricsLog(events_sink=sink, flush_interval_s=0.0)
+
+
+def test_snapshot_sidecar_names_sim_instant(tmp_path):
+    events, snap = _drill_world(tmp_path, snapshot=True)
+    meta = json.loads(Path(str(snap) + ".meta.json").read_text())
+    assert set(meta) == {"t", "snapshot_writes"}
+    assert 0.0 < meta["t"] <= 2400.0
+    assert meta["snapshot_writes"] >= 1
+
+
+def test_sample_hazard_gated_on_bound_model():
+    """Per-pod hazard rides sample_state() only when a hazard model is
+    bound (hazard-free payloads stay byte-identical, ISSUE 15)."""
+    from gpuschedule_tpu.cluster.tpu import TpuCluster
+    from gpuschedule_tpu.faults.hazard import HazardModel, hazard_config
+    from gpuschedule_tpu.faults.schedule import FaultConfig
+
+    c = TpuCluster("v5e", dims=(4, 4), num_pods=2)
+    state = c.sample_state()
+    assert all("hazard" not in p for p in state["pods"])
+
+    cfg = hazard_config(FaultConfig(mtbf=30_000.0, hazard_shape=1.5))
+    assert cfg is not None
+    c.bind_hazard(HazardModel(cfg, c))
+    state2 = c.sample_state()
+    assert all("hazard" in p for p in state2["pods"])
+    assert all(p["hazard"] >= 0.0 for p in state2["pods"])
+
+
+def test_perfetto_hazard_counter_track():
+    from gpuschedule_tpu.obs.perfetto import trace_events, validate_chrome_trace
+
+    recs = [{"t": 10.0, "event": "sample", "used": 4, "unhealthy": 0,
+             "running": 1, "pending": 0,
+             "pods": [{"used": 4, "frag": 0.0, "hazard": 1.25},
+                      {"used": 0, "frag": 0.0, "hazard": 0.5}]}]
+    evs = trace_events(recs)
+    hz = [e for e in evs if e.get("name") == "pod hazard"]
+    assert len(hz) == 1 and hz[0]["ph"] == "C"
+    assert hz[0]["args"] == {"pod0": 1.25, "pod1": 0.5}
+    assert validate_chrome_trace({"traceEvents": evs}) == []
+    # hazard-free samples emit no hazard track
+    evs2 = trace_events([{
+        "t": 10.0, "event": "sample", "used": 4, "unhealthy": 0,
+        "running": 1, "pending": 0,
+        "pods": [{"used": 4, "frag": 0.0}],
+    }])
+    assert not [e for e in evs2 if e.get("name") == "pod hazard"]
+
+
+# --------------------------------------------------------------------- #
+# report integration
+
+
+def test_report_alerts_panel(tmp_path):
+    from gpuschedule_tpu.cli import main
+
+    events, _ = _drill_world(tmp_path, max_time=4000.0)
+    alerts_path = tmp_path / "alerts.jsonl"
+    rules_path = tmp_path / "rules.json"
+    rules_path.write_text(json.dumps(_DRILL_RULES))
+    rc = main([
+        "watch", "--events", str(events), "--rules", str(rules_path),
+        "--alerts", str(alerts_path),
+    ])
+    assert rc == 0
+    report = tmp_path / "report.html"
+    rc = main([
+        "report", "--events", str(events), "--out", str(report),
+        "--alerts", str(alerts_path),
+    ])
+    assert rc == 0
+    html = report.read_text()
+    assert "Alerts" in html and "goodput-collapse" in html
+    assert 'class="mark"' in html  # timeline ticks on the occupancy chart
+
+
+# --------------------------------------------------------------------- #
+# watch smoke (slow)
+
+
+@pytest.mark.slow
+def test_watch_smoke_tool():
+    import importlib.util
+
+    root = Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "watch_smoke", root / "tools" / "watch_smoke.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    res = mod.run_smoke()
+    assert res["ok"], res

@@ -122,6 +122,20 @@ def _time_selfprof_off(num_jobs: int) -> float:
     return time.perf_counter() - t0
 
 
+def _time_watch_off(num_jobs: int) -> float:
+    # the ISSUE 15 tailable-sink contract at its default (no flush
+    # cadence, no snapshot sidecar): the watch-era plumbing — the
+    # per-event `_flush_every is not None` check in MetricsLog.event and
+    # the snapshot-tick sidecar write — must cost the default-off engine
+    # nothing.  Today this construction is byte-for-byte the `disabled`
+    # one; it exists as the knob-specific tripwire for any future change
+    # that grows per-event or per-batch work behind the watch surfaces.
+    sim = _fresh_sim(num_jobs)
+    t0 = time.perf_counter()
+    sim.run()
+    return time.perf_counter() - t0
+
+
 def _time_accounting_v1(num_jobs: int) -> float:
     # the ISSUE 11 accounting knob at its default: with the v2 ledger
     # code present in the engine, an explicit accounting="v1" must still
@@ -195,30 +209,35 @@ def run_guard(
     result: dict = {}
     for attempt in range(1, max_attempts + 1):
         base_times, dis_times, samp_times = [], [], []
-        prof_times, acct_times = [], []
+        prof_times, acct_times, watch_times = [], [], []
         _time_baseline(num_jobs)  # warm allocator/caches off the record
         _time_disabled(num_jobs)
         _time_sampling(num_jobs)
         _time_selfprof_off(num_jobs)
         _time_accounting_v1(num_jobs)
+        _time_watch_off(num_jobs)
         for _ in range(attempt_repeats):  # interleaved: drift hits all alike
             base_times.append(_time_baseline(num_jobs))
             dis_times.append(_time_disabled(num_jobs))
             samp_times.append(_time_sampling(num_jobs))
             prof_times.append(_time_selfprof_off(num_jobs))
             acct_times.append(_time_accounting_v1(num_jobs))
+            watch_times.append(_time_watch_off(num_jobs))
         t_base, t_dis = min(base_times), min(dis_times)
         t_samp = min(samp_times)
         t_prof_off = min(prof_times)
         t_acct_v1 = min(acct_times)
+        t_watch_off = min(watch_times)
         ratio = t_dis / t_base if t_base > 0 else float("inf")
         samp_ratio = t_samp / t_base if t_base > 0 else float("inf")
         prof_ratio = t_prof_off / t_base if t_base > 0 else float("inf")
         acct_ratio = t_acct_v1 / t_base if t_base > 0 else float("inf")
+        watch_ratio = t_watch_off / t_base if t_base > 0 else float("inf")
         result = {
             "ok": (ratio <= tolerance and samp_ratio <= tolerance
                    and prof_ratio <= tolerance
-                   and acct_ratio <= tolerance),
+                   and acct_ratio <= tolerance
+                   and watch_ratio <= tolerance),
             "attempt": attempt,
             "repeats": attempt_repeats,
             "num_jobs": num_jobs,
@@ -231,6 +250,8 @@ def run_guard(
             "selfprof_off_over_baseline": round(prof_ratio, 4),
             "accounting_v1_s": round(t_acct_v1, 6),
             "accounting_v1_over_baseline": round(acct_ratio, 4),
+            "watch_off_s": round(t_watch_off, 6),
+            "watch_off_over_baseline": round(watch_ratio, 4),
             "sample_interval_s": SAMPLE_INTERVAL_S,
             "tolerance": tolerance,
         }

@@ -1,0 +1,159 @@
+"""Watchtower incident smoke (ISSUE 15 satellite / CI tooling).
+
+One deterministic 200-job faulted+netted replay with an injected pod
+outage, watched end to end: the watcher must raise EXACTLY the expected
+alert sequence — same detectors, same firing windows, same blamed
+causes — or the smoke fails.  This is the regression tripwire for the
+whole detection path: rolling-state bookkeeping, window integrals,
+detector thresholds, latching, and blame.
+
+The world: a 2-pod TPU v5e fleet (4x4 pods), a 200-job Poisson trace
+with 20% of jobs promoted to multislice DCN gangs (so the net model
+prices real flows), the shared-fabric contention model on, and a
+maintenance outage taking pod 0 down at t=12000 for four hours.  The
+story the pinned sequence tells: the fleet is oversubscribed from the
+start (queue-depth surge and SLO burn blame `capacity` early), the
+outage collapses goodput within one detector window (blamed
+`fault-outage` — the acceptance drill's core assertion), and the
+starved tail re-fires the collapse detector once the backlog outgrows
+the surviving pod (blamed `unknown`: no single leg dominates).
+
+Run directly (one JSON line, exit 1 on failure) or through the
+slow-marked pytest wrapper (tests/test_watch.py)::
+
+    python tools/watch_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gpuschedule_tpu.cluster.tpu import TpuCluster
+from gpuschedule_tpu.faults.recovery import FaultPlan, RecoveryModel
+from gpuschedule_tpu.faults.schedule import FaultRecord
+from gpuschedule_tpu.net import NetModel
+from gpuschedule_tpu.net.sweep import promote_to_multislice
+from gpuschedule_tpu.obs.watch import Watcher, load_rules
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.sim import Simulator
+from gpuschedule_tpu.sim.metrics import MetricsLog
+from gpuschedule_tpu.sim.trace import generate_poisson_trace
+
+NUM_JOBS = 200
+SEED = 7
+OUTAGE_T = 12_000.0
+OUTAGE_S = 4 * 3600.0
+MAX_TIME = 30_000.0
+WINDOW_S = 1200.0
+
+# The pinned expectation: detector -> [(firing window boundary, blamed
+# cause), ...].  A detector appearing that is not listed, a missing
+# firing, a drifted window, or a drifted blame all fail the smoke.  The
+# goodput collapse MUST land within one window of the outage, blamed
+# fault-outage (the ISSUE 15 acceptance drill's core property).
+EXPECTED = {
+    "queue-depth-surge": [[4800.0, "capacity"]],
+    "slo-burn": [[8400.0, "capacity"]],
+    "goodput-collapse": [
+        [13_200.0, "fault-outage"],
+        [22_800.0, "unknown"],
+    ],
+}
+
+RULES = {
+    "window_s": WINDOW_S,
+    "detectors": {
+        "queue-depth-surge": {"min_pending": 10.0, "surge_factor": 2.0},
+        "goodput-collapse": {"collapse_frac": 0.6, "min_velocity": 1.0},
+        "frag-creep": False,
+        "hazard-spike": False,
+        "slo-burn": {
+            "wait_slo_s": 3600.0,
+            "target": 0.9,
+            "fast_burn": 5.0,
+            "slow_burn": 2.0,
+            "slow_windows": 6,
+        },
+    },
+}
+
+
+def run_smoke(events_path=None) -> dict:
+    """Replay the incident world, watch it, and verify the alert
+    sequence.  Returns the result document (``ok`` plus the evidence)."""
+    cluster = TpuCluster("v5e", dims=(4, 4), num_pods=2)
+    jobs = promote_to_multislice(
+        generate_poisson_trace(
+            NUM_JOBS, seed=SEED, arrival_rate=1 / 100.0,
+            mean_duration=2000.0,
+        ),
+        0.2, cluster.pod_chips, seed=SEED,
+    )
+    plan = FaultPlan(
+        records=[FaultRecord(OUTAGE_T, ("pod", 0), OUTAGE_S, "maintenance")],
+        recovery=RecoveryModel(restore=120.0),
+    )
+    sink = events_path
+    tmp = None
+    if sink is None:
+        tmp = tempfile.NamedTemporaryFile(
+            "w", suffix=".events.jsonl", delete=False)
+        tmp.close()
+        sink = tmp.name
+    ml = MetricsLog(
+        events_sink=sink,
+        attribution=True,
+        run_meta={"run_id": f"watch-smoke-s{SEED}", "seed": SEED,
+                  "policy": "fifo", "config_hash": "watch-smoke"},
+    )
+    with ml:
+        sim = Simulator(
+            cluster, make_policy("fifo", backfill=True), jobs,
+            metrics=ml, faults=plan, net=NetModel(),
+            max_time=MAX_TIME,
+        )
+        sim.run()
+
+    watcher = Watcher(load_rules(RULES), source=str(sink))
+    with open(sink) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                watcher.feed(json.loads(line), line)
+    summary = watcher.finish()
+    got: dict = {}
+    for a in watcher.alerts:
+        got.setdefault(a["detector"], []).append([a["t"], a["cause"]])
+    first_collapse = next(
+        (a for a in watcher.alerts if a["detector"] == "goodput-collapse"),
+        None,
+    )
+    within_one_window = (
+        first_collapse is not None
+        and OUTAGE_T <= first_collapse["t"] <= OUTAGE_T + 2 * WINDOW_S
+        and first_collapse["cause"] == "fault-outage"
+    )
+    ok = got == EXPECTED and within_one_window
+    if tmp is not None:
+        os.unlink(sink)
+    return {
+        "ok": ok,
+        "expected": EXPECTED,
+        "got": got,
+        "collapse_within_one_window": within_one_window,
+        "outage_t": OUTAGE_T,
+        "window_s": WINDOW_S,
+        "events": summary["events"],
+        "windows": summary["windows"],
+    }
+
+
+if __name__ == "__main__":
+    res = run_smoke()
+    print(json.dumps(res, sort_keys=True))
+    sys.exit(0 if res["ok"] else 1)
