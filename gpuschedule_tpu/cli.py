@@ -904,22 +904,6 @@ def cmd_whatif(args) -> int:
                 f"by --max-time {sim.max_time}); it would never be "
                 "applied — raise --horizon or move it earlier"
             )
-    registry = MetricsRegistry()
-    try:
-        service = WhatIfService(
-            sim, horizon=args.horizon, workers=args.pool, registry=registry,
-        )
-    except ValueError as e:
-        raise SystemExit(str(e)) from None
-    try:
-        results = service.evaluate(queries)
-    except ValueError as e:
-        # belt and braces: any remaining deterministic query error (the
-        # evaluator re-validates against the fork's actual bound) is a
-        # user error, not a traceback
-        raise SystemExit(str(e)) from None
-    finally:
-        service.close()
     if args.resume:
         # the mirror's identity is the snapshotted run's, not the
         # (ignored) world flags'
@@ -936,6 +920,32 @@ def cmd_whatif(args) -> int:
             "run_id": f"{args.policy}-s{args.seed}-{chash}",
             "seed": args.seed, "policy": args.policy, "config_hash": chash,
         }
+    registry = MetricsRegistry()
+    fleet = None
+    if args.trace_out:
+        # ISSUE 16: arm cross-process tracing — the run_id is the trace
+        # id every worker span links back to
+        from gpuschedule_tpu.obs import FleetCollector
+
+        fleet = FleetCollector(run_meta["run_id"], parent="whatif")
+    try:
+        service = WhatIfService(
+            sim, horizon=args.horizon, workers=args.pool, registry=registry,
+            fleet=fleet,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+    pool_stats = None
+    try:
+        results = service.evaluate(queries)
+        pool_stats = service.pool_stats()
+    except ValueError as e:
+        # belt and braces: any remaining deterministic query error (the
+        # evaluator re-validates against the fork's actual bound) is a
+        # user error, not a traceback
+        raise SystemExit(str(e)) from None
+    finally:
+        service.close()
     doc = jsonable({
         "at_s": sim.now,
         "requested_at_s": args.at,
@@ -954,13 +964,28 @@ def cmd_whatif(args) -> int:
     })
     print(json.dumps(doc, sort_keys=True))
     if args.history:
-        n = append_history(args.history, results, run_meta=run_meta)
+        n = append_history(args.history, results, run_meta=run_meta,
+                           pool_stats=pool_stats)
         print(f"{n} whatif history rows -> {args.history}", file=sys.stderr)
     if args.out:
         out = Path(args.out)
         if out.parent and not out.parent.exists():
             out.parent.mkdir(parents=True, exist_ok=True)
         out.write_text(json.dumps(doc, indent=2, sort_keys=True))
+    if fleet is not None:
+        # parent-side families (query latency, pool lifecycle) join the
+        # merged document FIRST; the federated worker families then fold
+        # into the --prom registry (this order, or worker counters would
+        # double-count in the document)
+        fleet.registry.merge(registry)
+        tdoc = fleet.write(args.trace_out)
+        print(
+            f"fleet trace ({tdoc['federation']['tasks']} tasks, "
+            f"{len(tdoc['federation']['workers'])} workers) -> "
+            f"{args.trace_out}",
+            file=sys.stderr,
+        )
+        fleet.merge_into(registry)
     if args.prom:
         registry.write(prom_path=args.prom)
     return 0
@@ -1818,6 +1843,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="write the query-latency histogram "
                          "(whatif_query_latency_ms{kind}) in Prometheus "
                          "text format")
+    wi.add_argument("--trace-out", metavar="PATH", dest="trace_out",
+                    help="write ONE merged Perfetto/Chrome trace of the "
+                         "whole fleet: parent enqueue/dispatch/reassemble "
+                         "spans plus a named track per worker, every "
+                         "worker span carrying the propagated trace id; "
+                         "also federates worker counters into --prom "
+                         "(ISSUE 16).  Off by default — disarmed runs "
+                         "are byte-identical")
     wi.set_defaults(fn=cmd_whatif)
 
     lint = sub.add_parser(

@@ -33,6 +33,10 @@ from gpuschedule_tpu.faults.schedule import (
 # Fault kinds that take capacity out of the pool (availability accounting);
 # link and straggler records only degrade, they never remove chips.
 _CAPACITY_KINDS = ("mtbf", "maintenance", "spot", "domain")
+from gpuschedule_tpu.obs.fleet import (
+    task_profiler as _task_profiler,
+    task_span as _task_span,
+)
 from gpuschedule_tpu.policies import make_policy
 from gpuschedule_tpu.sim import Simulator
 from gpuschedule_tpu.sim.metrics import MetricsLog
@@ -169,31 +173,36 @@ def run_cell(
     from gpuschedule_tpu.faults.hazard import hazard_config
 
     name, kwargs = POLICY_CONFIGS[policy_key]
-    cluster = TpuCluster("v5e", dims=tuple(dims), num_pods=num_pods)
-    jobs = generate_philly_like_trace(num_jobs, seed=seed)
-    horizon = max_time if max_time is not None else fault_horizon(jobs)
-    fconfig = FaultConfig(
-        mtbf=mtbf, repair=repair,
-        domain_mtbf=domain_mtbf, domain_repair=domain_repair,
-        domain_weights=domain_weights,
-        hazard_shape=hazard_shape,
-        hazard_util_weight=hazard_util_weight,
-        migrate_threshold=migrate_threshold,
-        straggler_mtbf=straggler_mtbf,
-        straggler_repair=straggler_repair,
-        straggler_degrade=straggler_degrade,
-        spot_fraction=spot_fraction, spot_mtbf=spot_mtbf,
-        spot_outage=spot_outage, spot_warning=spot_warning,
-    )
-    plan = FaultPlan(
-        records=generate_fault_schedule(
-            cluster, fconfig, horizon=horizon, seed=seed,
-        ),
-        recovery=RecoveryModel(
-            ckpt_interval=ckpt, restore=restore, ckpt_write=ckpt_write,
-        ),
-        hazard=hazard_config(fconfig),
-    )
+    # ISSUE 16: under a fleet task harness (pooled or serial sweep with
+    # tracing armed) the cell's build/replay phases land as worker-side
+    # spans and the engine runs a per-cell PhaseProfiler; all three hooks
+    # are one-global-read no-ops disarmed, so bare cells stay identical
+    with _task_span("build", cat="sweep", policy=policy_key):
+        cluster = TpuCluster("v5e", dims=tuple(dims), num_pods=num_pods)
+        jobs = generate_philly_like_trace(num_jobs, seed=seed)
+        horizon = max_time if max_time is not None else fault_horizon(jobs)
+        fconfig = FaultConfig(
+            mtbf=mtbf, repair=repair,
+            domain_mtbf=domain_mtbf, domain_repair=domain_repair,
+            domain_weights=domain_weights,
+            hazard_shape=hazard_shape,
+            hazard_util_weight=hazard_util_weight,
+            migrate_threshold=migrate_threshold,
+            straggler_mtbf=straggler_mtbf,
+            straggler_repair=straggler_repair,
+            straggler_degrade=straggler_degrade,
+            spot_fraction=spot_fraction, spot_mtbf=spot_mtbf,
+            spot_outage=spot_outage, spot_warning=spot_warning,
+        )
+        plan = FaultPlan(
+            records=generate_fault_schedule(
+                cluster, fconfig, horizon=horizon, seed=seed,
+            ),
+            recovery=RecoveryModel(
+                ckpt_interval=ckpt, restore=restore, ckpt_write=ckpt_write,
+            ),
+            hazard=hazard_config(fconfig),
+        )
     metrics = MetricsLog(attribution=attribution)
     if events_path is not None:
         from gpuschedule_tpu.obs import config_hash
@@ -237,13 +246,16 @@ def run_cell(
             "seed": seed, "policy": policy_key, "config_hash": chash,
         }, attribution=attribution)
     with metrics:  # engine exceptions still flush the stream
-        res = Simulator(
-            cluster, make_policy(name, **kwargs), jobs,
-            metrics=metrics,
-            faults=plan,
-            max_time=max_time if max_time is not None else math.inf,
-            sample_interval=sample_interval,
-        ).run()
+        with _task_span("replay", cat="sweep", policy=policy_key,
+                        mtbf=mtbf, seed=seed):
+            res = Simulator(
+                cluster, make_policy(name, **kwargs), jobs,
+                metrics=metrics,
+                faults=plan,
+                max_time=max_time if max_time is not None else math.inf,
+                sample_interval=sample_interval,
+                profiler=_task_profiler(),
+            ).run()
     cell = {
         "policy": policy_key,
         "mtbf_s": mtbf,
@@ -275,6 +287,7 @@ def grid_cells(
     max_retries: int = 2,
     backoff_s: float = 1.0,
     retry_log: Optional[List[dict]] = None,
+    fleet=None,
 ) -> Dict[str, List[dict]]:
     """Run a (policy x grid-point) matrix of independent seeded cells,
     serially or process-parallel, reassembling results in deterministic
@@ -299,7 +312,17 @@ def grid_cells(
     of warm workers for the whole grid, a crash respawning exactly the
     dead worker instead of a fresh pool per retry round.  Cells are
     independent seeded replays either way, so the artifact stays
-    byte-identical to the serial one."""
+    byte-identical to the serial one.
+
+    ``fleet`` (a :class:`gpuschedule_tpu.obs.fleet.FleetCollector`,
+    ISSUE 16) arms cross-process tracing: pooled cells ship a
+    trace-context envelope and return spans / counters / engine-phase
+    profiles alongside their results; serial cells run the identical
+    harness in-process, so the federated telemetry is comparable across
+    modes.  The pool's lifecycle counters land on ``fleet.registry``.
+    Cell *results* are bytewise unaffected either way — telemetry
+    travels out of band, and a failed attempt's partial telemetry never
+    reaches the collector (it only rides a successful return)."""
     import time
 
     def note_retries(cells, rnd: int) -> None:
@@ -309,12 +332,19 @@ def grid_cells(
 
     if workers <= 1:
         out: Dict[str, List[dict]] = {}
-        for key in keys:
+        for k, key in enumerate(keys):
             row = []
             for i, pt in enumerate(points):
                 for attempt in range(max_retries + 1):
                     try:
-                        row.append(run_one(key, pt))
+                        if fleet is None:
+                            row.append(run_one(key, pt))
+                        else:
+                            # the serial half of the fleet contract: same
+                            # harness, task-index key = grid-flat index
+                            row.append(fleet.run_local(
+                                run_one, k * len(points) + i, (key, pt),
+                            ))
                         break
                     except Exception:
                         if attempt == max_retries:
@@ -334,8 +364,13 @@ def grid_cells(
     with WorkerPool(
         workers, max_retries=max_retries, backoff_s=backoff_s,
         on_retry=on_retry,
+        registry=fleet.registry if fleet is not None else None,
     ) as pool:
-        flat = pool.map(run_one, tasks)
+        if fleet is None:
+            flat = pool.map(run_one, tasks)
+        else:
+            with fleet.span("dispatch", tasks=len(tasks)):
+                flat = pool.map(run_one, tasks, fleet=fleet)
     results = dict(zip(cells, flat))
     return {
         key: [results[(key, i)] for i in range(len(points))] for key in keys
@@ -352,6 +387,7 @@ def sweep(
     policies: Optional[Iterable[str]] = None,
     *,
     workers: int = 1,
+    fleet=None,
     **cell_kwargs,
 ) -> dict:
     """The full grid as one JSON-ready artifact:
@@ -360,7 +396,9 @@ def sweep(
 
     ``workers`` > 1 runs the cells across a process pool (each cell is an
     isolated seeded replay); results come back in grid order, so the
-    artifact is byte-identical to the serial one."""
+    artifact is byte-identical to the serial one.  ``fleet`` arms
+    ISSUE 16 cross-process tracing (see :func:`grid_cells`) — the
+    artifact itself is unchanged; the telemetry rides the collector."""
     mtbfs = list(mtbfs)
     keys = list(policies) if policies is not None else list(POLICY_CONFIGS)
     unknown = [k for k in keys if k not in POLICY_CONFIGS]
@@ -377,6 +415,6 @@ def sweep(
 
     out = grid_cells(
         keys, mtbfs, partial(_mtbf_cell, cell_kwargs=cell_kwargs),
-        workers=workers,
+        workers=workers, fleet=fleet,
     )
     return {"mtbf_s": mtbfs, "policies": out}

@@ -84,7 +84,14 @@ class LintConfig:
     # sequence is a determinism contract (ISSUE 15), so its wall-clock
     # reads (follow-mode polling) carry reasoned pragmas like the
     # engine's own measurement sites
-    determinism_files: Tuple[str, ...] = (f"{PACKAGE}/obs/watch.py",)
+    # ... and the cross-process fleet layer (ISSUE 16): its federated
+    # document must be a pure function of the worker payloads, so its
+    # wall anchors / process-local harness globals carry reasoned
+    # pragmas like the engine's own measurement sites
+    determinism_files: Tuple[str, ...] = (
+        f"{PACKAGE}/obs/watch.py",
+        f"{PACKAGE}/obs/fleet.py",
+    )
     # rule GS3xx: the event emitters and their schema document.  Every
     # path in emitter_paths is scanned for ``.event(...)`` calls — the
     # engine is joined by the what-if / snapshot layers and the

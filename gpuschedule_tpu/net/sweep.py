@@ -21,6 +21,10 @@ from typing import Iterable, Optional, Sequence
 from gpuschedule_tpu.cluster.tpu import TpuCluster
 from gpuschedule_tpu.faults.sweep import POLICY_CONFIGS, jsonable  # noqa: F401
 from gpuschedule_tpu.net.model import NetConfig, NetModel
+from gpuschedule_tpu.obs.fleet import (
+    task_profiler as _task_profiler,
+    task_span as _task_span,
+)
 from gpuschedule_tpu.policies import make_policy
 from gpuschedule_tpu.sim import Simulator
 from gpuschedule_tpu.sim.metrics import MetricsLog
@@ -67,21 +71,27 @@ def run_cell(
     if num_pods < 2:
         raise ValueError("the contention sweep needs num_pods >= 2")
     name, kwargs = POLICY_CONFIGS[policy_key]
-    cluster = TpuCluster("v5e", dims=tuple(dims), num_pods=num_pods)
-    jobs = promote_to_multislice(
-        generate_philly_like_trace(num_jobs, seed=seed),
-        multislice_share, cluster.pod_chips, seed=seed,
-    )
-    net = NetModel(NetConfig(
-        oversubscription=oversubscription, ingest_gbps_per_chip=ingest,
-    ))
+    # ISSUE 16: same worker-side build/replay spans + per-cell engine
+    # profiler as the MTBF grid; no-ops when no fleet harness is armed
+    with _task_span("build", cat="sweep", policy=policy_key):
+        cluster = TpuCluster("v5e", dims=tuple(dims), num_pods=num_pods)
+        jobs = promote_to_multislice(
+            generate_philly_like_trace(num_jobs, seed=seed),
+            multislice_share, cluster.pod_chips, seed=seed,
+        )
+        net = NetModel(NetConfig(
+            oversubscription=oversubscription, ingest_gbps_per_chip=ingest,
+        ))
     metrics = MetricsLog(attribution=attribution) if attribution else None
-    res = Simulator(
-        cluster, make_policy(name, **kwargs), jobs,
-        metrics=metrics,
-        net=net,
-        max_time=max_time if max_time is not None else math.inf,
-    ).run()
+    with _task_span("replay", cat="sweep", policy=policy_key,
+                    share=multislice_share, seed=seed):
+        res = Simulator(
+            cluster, make_policy(name, **kwargs), jobs,
+            metrics=metrics,
+            net=net,
+            max_time=max_time if max_time is not None else math.inf,
+            profiler=_task_profiler(),
+        ).run()
     cell_extra = (
         {"delay_by_cause": dict(res.delay_by_cause)}
         if res.delay_by_cause else {}
@@ -111,6 +121,7 @@ def sweep(
     policies: Optional[Iterable[str]] = None,
     *,
     workers: int = 1,
+    fleet=None,
     **cell_kwargs,
 ) -> dict:
     """The full grid: ``{"multislice_share": [...], "policies": {name:
@@ -118,7 +129,9 @@ def sweep(
 
     ``workers`` > 1 fans the cells across a process pool (each cell is an
     isolated seeded replay — the faults/sweep.py grid_cells machinery);
-    the reassembled artifact is byte-identical to the serial one."""
+    the reassembled artifact is byte-identical to the serial one.
+    ``fleet`` arms ISSUE 16 cross-process tracing (see
+    :func:`gpuschedule_tpu.faults.sweep.grid_cells`)."""
     shares = list(shares)
     keys = list(policies) if policies is not None else list(POLICY_CONFIGS)
     unknown = [k for k in keys if k not in POLICY_CONFIGS]
@@ -132,6 +145,6 @@ def sweep(
 
     out = grid_cells(
         keys, shares, partial(_share_cell, cell_kwargs=cell_kwargs),
-        workers=workers,
+        workers=workers, fleet=fleet,
     )
     return {"multislice_share": shares, "policies": out}

@@ -31,7 +31,12 @@ ROADMAP's production-scale north star):
 - :mod:`gpuschedule_tpu.obs.history` — append-only sqlite store of run /
   compare / bench summaries keyed by run_id/config_hash, with the
   ``history trend`` CLI rendering per-metric trajectories across
-  invocations (ISSUE 10).
+  invocations (ISSUE 10);
+- :mod:`gpuschedule_tpu.obs.fleet` — cross-process observability
+  (ISSUE 16): trace-context envelopes propagated through the worker
+  pool, per-task child tracer/registry/profiler harnesses, deterministic
+  registry/selfprof federation, and one merged Perfetto document with a
+  named track per worker.
 
 Like the sim core, this package must stay jax-free: replay observability
 cannot pull an accelerator stack into the loop (tests/test_overhead.py
@@ -80,7 +85,19 @@ from gpuschedule_tpu.obs.compare import (
     write_matrix_json,
 )
 from gpuschedule_tpu.obs.report import render_report, write_report
-from gpuschedule_tpu.obs.selfprof import PHASES, PhaseProfiler, load_profile
+from gpuschedule_tpu.obs.selfprof import (
+    PHASES,
+    PhaseProfiler,
+    load_profile,
+    merge_profiles,
+)
+from gpuschedule_tpu.obs.fleet import (
+    FleetCollector,
+    TaskContext,
+    WorkerTelemetry,
+    task_profiler,
+    task_span,
+)
 from gpuschedule_tpu.obs.history import (
     HistoryRow,
     HistoryStore,
@@ -89,6 +106,7 @@ from gpuschedule_tpu.obs.history import (
 )
 from gpuschedule_tpu.obs.perfetto import (
     export_chrome_trace,
+    fleet_trace_events,
     load_events_jsonl,
     trace_events,
     track_label,
@@ -137,11 +155,18 @@ __all__ = [
     "PHASES",
     "PhaseProfiler",
     "load_profile",
+    "merge_profiles",
+    "FleetCollector",
+    "TaskContext",
+    "WorkerTelemetry",
+    "task_profiler",
+    "task_span",
     "HistoryRow",
     "HistoryStore",
     "render_trend",
     "trend_delta",
     "export_chrome_trace",
+    "fleet_trace_events",
     "load_events_jsonl",
     "trace_events",
     "track_label",

@@ -172,6 +172,16 @@ class _Metric:
     def to_json(self):
         raise NotImplementedError
 
+    # federation hooks (ISSUE 16) ----------------------------------------
+    def _payload(self):
+        """This child's state as a picklable value (see
+        :meth:`MetricsRegistry.snapshot`)."""
+        raise NotImplementedError
+
+    def _merge_payload(self, payload) -> None:
+        """Fold one snapshot payload into this child."""
+        raise NotImplementedError
+
 
 class Counter(_Metric):
     kind = "counter"
@@ -214,6 +224,12 @@ class Counter(_Metric):
             for lv, c in self._children.items()
         }
 
+    def _payload(self):
+        return self._value
+
+    def _merge_payload(self, payload) -> None:
+        self.inc(float(payload))
+
 
 class Gauge(_Metric):
     kind = "gauge"
@@ -251,6 +267,14 @@ class Gauge(_Metric):
             _fmt_labels(self.labelnames, lv) or "": c._value
             for lv, c in self._children.items()
         }
+
+    def _payload(self):
+        return self._value
+
+    def _merge_payload(self, payload) -> None:
+        # gauges are point-in-time readings: a merge keeps the incoming
+        # value (last writer wins, in the caller's deterministic order)
+        self.set(float(payload))
 
 
 class Histogram(_Metric):
@@ -346,6 +370,27 @@ class Histogram(_Metric):
             for lv, c in self._children.items()
         }
 
+    def _payload(self):
+        with self._lock:
+            return {
+                "counts": list(self._counts),
+                "sum": self._sum,
+                "count": self._count,
+            }
+
+    def _merge_payload(self, payload) -> None:
+        counts = payload["counts"]
+        if len(counts) != len(self._counts):
+            raise ValueError(
+                f"{self.name}: cannot merge histogram with "
+                f"{len(counts)} buckets into {len(self._counts)}"
+            )
+        with self._lock:
+            for i, n in enumerate(counts):
+                self._counts[i] += n
+            self._sum += payload["sum"]
+            self._count += payload["count"]
+
 
 class MetricsRegistry:
     """A named collection of metric families with idempotent constructors:
@@ -393,6 +438,65 @@ class MetricsRegistry:
         buckets: Sequence[float] = DEFAULT_BUCKETS,
     ) -> Histogram:
         return self._get_or_make(Histogram, name, help, labelnames, buckets=buckets)
+
+    # ------------------------------------------------------------------ #
+    # federation (ISSUE 16): registries cross process boundaries as plain
+    # picklable snapshots; merge() folds a snapshot (or another registry)
+    # into this one with counter sums, bucket-wise histogram addition, and
+    # label-family union — the deterministic half of cross-process
+    # telemetry (the caller supplies a deterministic merge order).
+
+    def snapshot(self) -> Dict[str, dict]:
+        """This registry's full state as a plain picklable dict:
+        ``{name: {kind, help, labelnames, [buckets,] children}}`` where
+        ``children`` is a sorted list of ``[labelvalues, payload]`` pairs
+        (the unlabeled family is one child keyed by ``()``)."""
+        with self._lock:
+            families = sorted(self._metrics.values(), key=lambda m: m.name)
+        out: Dict[str, dict] = {}
+        for m in families:
+            if m.labelnames:
+                with m._lock:
+                    pairs = sorted(m._children.items())
+                children = [(lv, c._payload()) for lv, c in pairs]
+            else:
+                children = [((), m._payload())]
+            entry: dict = {
+                "kind": m.kind,
+                "help": m.help,
+                "labelnames": list(m.labelnames),
+                "children": children,
+            }
+            if isinstance(m, Histogram):
+                entry["buckets"] = list(m.buckets)
+            out[m.name] = entry
+        return out
+
+    def merge(self, other) -> "MetricsRegistry":
+        """Fold ``other`` — a :class:`MetricsRegistry` or a
+        :meth:`snapshot` dict — into this registry: counters add,
+        histograms add bucket-wise (bucket schemas must match), gauges
+        take the incoming value, and labeled families union their
+        children.  Re-declaring a name as a different kind or label
+        schema raises, exactly like the constructors.  Returns ``self``
+        so merges chain."""
+        snap = other.snapshot() if isinstance(other, MetricsRegistry) else other
+        kinds = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+        for name in sorted(snap):
+            entry = snap[name]
+            cls = kinds[entry["kind"]]
+            kw = {}
+            if entry["kind"] == "histogram":
+                kw["buckets"] = entry["buckets"]
+            fam = self._get_or_make(
+                cls, name, entry["help"], tuple(entry["labelnames"]), **kw
+            )
+            for labelvalues, payload in entry["children"]:
+                child = (
+                    fam.labels(*labelvalues) if fam.labelnames else fam
+                )
+                child._merge_payload(payload)
+        return self
 
     # ------------------------------------------------------------------ #
     # exposition

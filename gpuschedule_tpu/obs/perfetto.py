@@ -297,6 +297,46 @@ def trace_events(events: Iterable[dict]) -> List[dict]:
     return ids.meta + timed
 
 
+def fleet_trace_events(
+    parent_events: List[dict],
+    workers: Dict[str, List[dict]],
+    *,
+    parent_name: str = "parent",
+) -> List[dict]:
+    """Merge parent-side and per-worker span events into one Chrome trace
+    event list with process/thread metadata records (ISSUE 16): the parent
+    is pid 1 (named ``parent_name``), each worker key gets its own named
+    pid in sorted-key order, and every timed event is stamped with its
+    process ids and globally re-sorted by ts — the shape ui.perfetto.dev
+    renders as one fleet timeline with a named track per worker.
+
+    Each process's ``ts`` values are on its own wall anchor (the standard
+    multi-process Chrome-trace situation); within a process the layout is
+    real.  The output is a pure function of the inputs: worker keys sort,
+    ties break on (ts, pid, tid, name), so adversarial completion order
+    upstream cannot change a byte here.
+    """
+    meta: List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+         "args": {"name": parent_name}},
+        {"name": "thread_name", "ph": "M", "pid": 1, "tid": 1,
+         "args": {"name": "spans"}},
+    ]
+    timed: List[dict] = []
+    for e in parent_events:
+        timed.append({**e, "pid": 1, "tid": 1})
+    for i, key in enumerate(sorted(workers)):
+        pid = i + 2
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "tid": 0, "args": {"name": key}})
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": 1, "args": {"name": "spans"}})
+        for e in workers[key]:
+            timed.append({**e, "pid": pid, "tid": 1})
+    timed.sort(key=lambda e: (e["ts"], e["pid"], e["tid"], e["name"]))
+    return meta + timed
+
+
 def export_chrome_trace(events: Iterable[dict], out_path) -> dict:
     """Write ``events`` as a Chrome trace-event JSON document; returns the
     document (handy for tests)."""

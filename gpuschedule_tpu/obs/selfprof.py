@@ -235,3 +235,51 @@ def load_profile(path) -> dict:
             "with phase totals — was it written by run --self-profile?)"
         )
     return prof
+
+
+def merge_profiles(per_worker) -> dict:
+    """Deterministic federation of self-profile blocks keyed by worker
+    (ISSUE 16): ``per_worker`` maps a worker key (e.g. ``"worker-0"``) to
+    the sequence of :meth:`PhaseProfiler.profile` blocks its tasks
+    produced, in task order.  Per worker, phase totals / wall totals /
+    batch counts are exact sums over its blocks; the ``fleet`` block sums
+    across workers (iterated in sorted key order, so the merge is a pure
+    function of the inputs — arrival order never matters).  Workers with
+    no profile blocks are dropped."""
+
+    def _merged(blocks) -> dict:
+        names = list(PHASES)
+        for b in blocks:
+            for name in b.get("phases", {}):
+                if name not in names:
+                    names.append(name)
+        totals = {
+            name: sum(
+                b.get("phases", {}).get(name, {}).get("total_s", 0.0)
+                for b in blocks
+            )
+            for name in names
+        }
+        total = sum(b.get("total_wall_s", 0.0) for b in blocks)
+        batches = sum(b.get("batches", 0) for b in blocks)
+        return {
+            "total_wall_s": total,
+            "batches": batches,
+            "batches_per_s": (batches / total) if total > 0 else None,
+            "tasks": len(blocks),
+            "phases": {
+                name: {
+                    "total_s": totals[name],
+                    "share": (totals[name] / total) if total > 0 else 0.0,
+                }
+                for name in names
+            },
+        }
+
+    workers = {
+        key: _merged(list(per_worker[key]))
+        for key in sorted(per_worker)
+        if per_worker[key]
+    }
+    flat = [b for key in sorted(per_worker) for b in per_worker[key]]
+    return {"workers": workers, "fleet": _merged(flat)}

@@ -119,9 +119,15 @@ class Tracer:
     ``enabled`` is the single switch every instrumented call site keys on.
     """
 
-    def __init__(self, *, enabled: bool = False):
+    def __init__(self, *, enabled: bool = False,
+                 origin: Optional[float] = None):
         self.enabled = bool(enabled)
-        self._origin = time.perf_counter()   # wall_start=0 is tracer creation
+        # ``origin`` lets several tracers in one process share a wall
+        # anchor (the fleet layer gives every per-task child tracer the
+        # worker process's first-task origin, so a worker's tasks lay
+        # out sequentially on its Perfetto track instead of stacking at
+        # ts=0); default: wall_start=0 is tracer creation.
+        self._origin = time.perf_counter() if origin is None else origin
         self._spans: List[Span] = []
         self._lock = threading.Lock()
         self._tl = threading.local()

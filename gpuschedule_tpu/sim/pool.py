@@ -97,6 +97,15 @@ class WorkerPool:
     ``on_retry(task_index, attempt)`` (when given) is invoked once per
     retry — the hook :func:`gpuschedule_tpu.faults.sweep.grid_cells`
     adapts onto its ``retry_log`` contract.
+
+    ``registry`` (any object with the ``MetricsRegistry.counter``
+    surface; the pool stays import-free of the obs layer) surfaces pool
+    lifecycle in the metrics plane (ISSUE 16):
+    ``pool_worker_respawns_total`` counts dead workers respawned and
+    ``pool_task_retries_total`` counts task attempts retried — the same
+    events the ``retry_log`` records, now exportable via ``--prom`` and
+    the history store.  ``self.respawns`` / ``self.retries`` mirror them
+    as plain ints regardless.
     """
 
     def __init__(
@@ -107,6 +116,7 @@ class WorkerPool:
         backoff_s: float = 1.0,
         on_retry: Optional[Callable[[int, int], None]] = None,
         mp_context=None,
+        registry=None,
     ):
         if workers < 1:
             raise ValueError(f"workers must be >= 1, got {workers}")
@@ -122,6 +132,17 @@ class WorkerPool:
         self._loads: List[Tuple[Callable, tuple]] = []
         self._closed = False
         self.respawns = 0
+        self.retries = 0
+        self._respawns_c = self._retries_c = None
+        if registry is not None:
+            self._respawns_c = registry.counter(
+                "pool_worker_respawns_total",
+                "dead pool workers respawned (and re-warmed)",
+            )
+            self._retries_c = registry.counter(
+                "pool_task_retries_total",
+                "pool task attempts retried after a crash or exception",
+            )
         for wid in range(int(workers)):
             self._spawn(wid)
 
@@ -181,10 +202,17 @@ class WorkerPool:
             w.proc.join(timeout=0.1)
         self._spawn(wid)
         self.respawns += 1
+        if self._respawns_c is not None:
+            self._respawns_c.inc()
         for fn, args in self._loads:
             # fire-and-forget: a failing replayed load surfaces when the
             # worker's next task crashes or errors, which retries it
             self._workers[wid].req_q.put((next(self._task_ids), fn, args))
+
+    def _note_retry(self) -> None:
+        self.retries += 1
+        if self._retries_c is not None:
+            self._retries_c.inc()
 
     def broadcast(self, fn: Callable, *args) -> None:
         """Run ``fn(*args)`` on EVERY worker (warm-state load), blocking
@@ -212,6 +240,7 @@ class WorkerPool:
                                 f"worker {wid} died {attempts[wid]}x "
                                 "during warm-state load"
                             )
+                        self._note_retry()
                         time.sleep(
                             self.backoff_s * (2 ** (attempts[wid] - 1))
                         )
@@ -225,6 +254,7 @@ class WorkerPool:
                 attempts[wid] += 1
                 if attempts[wid] > self.max_retries:
                     raise payload
+                self._note_retry()
                 time.sleep(self.backoff_s * (2 ** (attempts[wid] - 1)))
                 pending[self._send(wid, fn, args)] = wid
         self._loads.append((fn, args))
@@ -235,10 +265,23 @@ class WorkerPool:
         items: Sequence[tuple],
         *,
         on_retry: Optional[Callable[[int, int], None]] = None,
+        fleet=None,
     ) -> list:
         """``[fn(*item) for item in items]`` across the pool, results in
         item order.  Retries follow the pool's crash/retry semantics; a
-        task exhausting its budget re-raises and abandons the rest."""
+        task exhausting its budget re-raises and abandons the rest.
+
+        ``fleet`` (a :class:`gpuschedule_tpu.obs.fleet.FleetCollector`,
+        duck-typed so the pool stays obs-import-free) arms cross-process
+        tracing (ISSUE 16): each task ships wrapped with its trace-context
+        envelope via ``fleet.task(fn, idx, args)``, and each *successful*
+        result is unwrapped through ``fleet.absorb(idx, wid, payload)``,
+        which records the worker's telemetry keyed by task index.  The
+        retry discipline is structural: a crashed attempt's telemetry
+        died with its process, a raised attempt's is never returned, and
+        a retired incarnation's late success is dropped right here (the
+        ``running.get(task_id) is None`` guard) before it could reach the
+        collector — merged telemetry never double-counts an attempt."""
         if self._closed:
             raise RuntimeError("map on a closed pool")
         on_retry = on_retry or self.on_retry
@@ -267,7 +310,11 @@ class WorkerPool:
                     next_item += 1
                 else:
                     return
-                task_id = self._send(wid, fn, tuple(items[idx]))
+                if fleet is None:
+                    task_id = self._send(wid, fn, tuple(items[idx]))
+                else:
+                    wfn, wargs = fleet.task(fn, idx, tuple(items[idx]))
+                    task_id = self._send(wid, wfn, wargs)
                 running[task_id] = (idx, wid)
                 busy[wid] = task_id
 
@@ -278,6 +325,7 @@ class WorkerPool:
             attempts[idx] += 1
             if attempts[idx] > self.max_retries:
                 raise error
+            self._note_retry()
             if on_retry is not None:
                 on_retry(idx, attempts[idx])
             delay = self.backoff_s * (2 ** (attempts[idx] - 1))
@@ -305,7 +353,10 @@ class WorkerPool:
                 del running[task_id]
                 if busy.get(twid) == task_id:
                     del busy[twid]
-                results[idx] = payload
+                if fleet is None:
+                    results[idx] = payload
+                else:
+                    results[idx] = fleet.absorb(idx, twid, payload)
                 done += 1
             else:
                 fail(task_id, idx, twid, payload)

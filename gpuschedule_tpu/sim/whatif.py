@@ -44,6 +44,11 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 from gpuschedule_tpu.sim.job import Job
+from gpuschedule_tpu.obs.fleet import (
+    active as _fleet_active,
+    task_span as _task_span,
+)
+from gpuschedule_tpu.obs.tracer import NULL_SPAN as _NULL_SPAN
 
 QUERY_KINDS = ("admit", "drain", "policy-swap")
 
@@ -146,8 +151,26 @@ def evaluate_query(fork_fn, q: dict, horizon: float, base: dict) -> dict:
     clone of the mirror (``sim.fork`` for one-shot use; the service
     clones from cached mirror bytes — unpickle-only, half the fork
     cost); mutate it, run the bounded horizon, diff against the
-    (already computed) baseline doc."""
-    fork = fork_fn()
+    (already computed) baseline doc.
+
+    When a fleet task harness is armed (ISSUE 16) the phases land as
+    child spans carrying the propagated trace context — fork / mutate /
+    replay / diff, with ``restore`` nested under fork when the fork
+    clones from mirror bytes — and the evaluation bumps the harness's
+    ``whatif_queries_total{kind}`` counter.  Both hooks are no-ops when
+    disarmed (one module-global read), and the counter lives on the
+    harness registry precisely so that the serial and pooled merged
+    registries come out identical: one increment per query, wherever
+    the query ran."""
+    harness = _fleet_active()
+    if harness is not None:
+        harness.registry.counter(
+            "whatif_queries_total",
+            "what-if queries evaluated",
+            labelnames=("kind",),
+        ).labels(q["kind"]).inc()
+    with _task_span("fork", kind=q["kind"]):
+        fork = fork_fn()
     at = fork.now
     _bound(fork, horizon)
     q_at = q.get("at")
@@ -160,9 +183,12 @@ def evaluate_query(fork_fn, q: dict, horizon: float, base: dict) -> dict:
             f"(ends at t={fork.max_time}); raise the horizon or move "
             "the query earlier"
         )
-    injected = apply_query(fork, q)
-    res = fork.run()
-    var = _result_doc(res)
+    with _task_span("mutate", kind=q["kind"]):
+        injected = apply_query(fork, q)
+    with _task_span("replay"):
+        res = fork.run()
+    with _task_span("diff"):
+        var = _result_doc(res)
     doc = {
         "query": dict(q),
         "at_s": at,
@@ -208,7 +234,8 @@ _BASELINES: Dict[float, dict] = {}
 def _worker_fork():
     from gpuschedule_tpu.sim.snapshot import clone_from_state_bytes
 
-    return clone_from_state_bytes(_MIRROR_BYTES)
+    with _task_span("restore"):
+        return clone_from_state_bytes(_MIRROR_BYTES)
 
 
 def _load_mirror(data: bytes, horizon: float) -> bool:
@@ -250,7 +277,17 @@ class WhatIfService:
     :class:`~gpuschedule_tpu.sim.pool.WorkerPool` (restore once per
     worker, fork per query, crash/retry per the pool contract);
     ``workers=0`` evaluates in-process off ``sim`` itself.  ``registry``
-    (an obs MetricsRegistry) arms the per-query latency histogram.
+    (an obs MetricsRegistry) arms the per-query latency histogram, and
+    hands the pool its lifecycle counters
+    (``pool_worker_respawns_total`` / ``pool_task_retries_total``).
+
+    ``fleet`` (a :class:`gpuschedule_tpu.obs.fleet.FleetCollector`,
+    ISSUE 16) arms cross-process tracing: each task ships a trace-context
+    envelope, every worker (or the in-process evaluator) runs a child
+    telemetry harness whose spans/counters ride back with the result,
+    and :meth:`evaluate` wraps its own phases in parent spans
+    (enqueue / dispatch / reassemble).  Result documents are bytewise
+    unaffected — telemetry travels out of band.
     """
 
     def __init__(
@@ -260,6 +297,7 @@ class WhatIfService:
         horizon: float,
         workers: int = 0,
         registry=None,
+        fleet=None,
         max_retries: int = 2,
         backoff_s: float = 1.0,
     ):
@@ -268,6 +306,8 @@ class WhatIfService:
         self.sim = sim
         self.horizon = float(horizon)
         self.queries_served = 0
+        self.workers = int(workers) if workers and workers >= 1 else 0
+        self._fleet = fleet
         self._latency = None
         if registry is not None:
             from gpuschedule_tpu.obs.metrics import LATENCY_BUCKETS_MS
@@ -290,6 +330,7 @@ class WhatIfService:
             self._bytes = state_to_bytes(sim)
             self._pool = WorkerPool(
                 workers, max_retries=max_retries, backoff_s=backoff_s,
+                registry=registry,
             )
             self._pool.broadcast(_load_mirror, self._bytes, self.horizon)
 
@@ -306,7 +347,8 @@ class WhatIfService:
 
         if self._bytes is None:
             self._bytes = state_to_bytes(self.sim)
-        return clone_from_state_bytes(self._bytes)
+        with _task_span("restore"):
+            return clone_from_state_bytes(self._bytes)
 
     def warm(self, horizon: Optional[float] = None) -> dict:
         """Ensure the in-process baseline for ``horizon`` exists (pool
@@ -331,19 +373,58 @@ class WhatIfService:
 
     def evaluate(self, queries: Sequence[dict]) -> List[dict]:
         """Evaluate ``queries`` (result order = query order, whatever the
-        pool interleaving), observing each latency into the histogram."""
-        tasks = [(validate_query(dict(q)), self.horizon) for q in queries]
-        if self._pool is not None:
-            out = self._pool.map(_eval_task, tasks)
+        pool interleaving), observing each latency into the histogram.
+
+        With a fleet collector armed, the three parent phases land as
+        spans on the collector's tracer — enqueue (validation / task
+        building), dispatch (the pool map or in-process loop, with each
+        task wrapped in a trace-context envelope), reassemble (latency
+        observation over the ordered results) — and every evaluator-side
+        span/counter rides back through the collector.  The result list
+        itself is byte-identical either way."""
+        fleet = self._fleet
+        if fleet is None:
+            tasks = [
+                (validate_query(dict(q)), self.horizon) for q in queries
+            ]
+            if self._pool is not None:
+                out = self._pool.map(_eval_task, tasks)
+            else:
+                out = [self._eval_local(q, h) for q, h in tasks]
         else:
-            out = [self._eval_local(q, h) for q, h in tasks]
-        self.queries_served += len(out)
-        if self._latency is not None:
-            for doc in out:
-                self._latency.labels(kind=doc["query"]["kind"]).observe(
-                    1000.0 * doc["latency_s"]
-                )
+            with fleet.span("enqueue", tasks=len(queries)):
+                tasks = [
+                    (validate_query(dict(q)), self.horizon) for q in queries
+                ]
+            with fleet.span("dispatch", tasks=len(tasks)):
+                if self._pool is not None:
+                    out = self._pool.map(_eval_task, tasks, fleet=fleet)
+                else:
+                    out = [
+                        fleet.run_local(self._eval_local, i, (q, h))
+                        for i, (q, h) in enumerate(tasks)
+                    ]
+        with (fleet.span("reassemble", tasks=len(out))
+              if fleet is not None else _NULL_SPAN):
+            self.queries_served += len(out)
+            if self._latency is not None:
+                for doc in out:
+                    self._latency.labels(kind=doc["query"]["kind"]).observe(
+                        1000.0 * doc["latency_s"]
+                    )
         return out
+
+    def pool_stats(self) -> Optional[dict]:
+        """Pool-lifecycle summary for the history "pool" row (``None``
+        when evaluating in-process): worker count plus the respawn /
+        retry totals the pool counted across this service's queries."""
+        if self._pool is None:
+            return None
+        return {
+            "workers": self.workers,
+            "respawns": self._pool.respawns,
+            "retries": self._pool.retries,
+        }
 
     def close(self) -> None:
         if self._pool is not None:
@@ -447,10 +528,15 @@ def latency_summary(results: Sequence[dict]) -> dict:
 
 
 def append_history(store_path, results: Sequence[dict], *,
-                   run_meta: Optional[dict] = None) -> int:
+                   run_meta: Optional[dict] = None,
+                   pool_stats: Optional[dict] = None) -> int:
     """One PR-10 history row per query (kind ``whatif``, label = query
     kind), so the twin's own serving latency and the deltas it reported
-    trend across invocations like any other result."""
+    trend across invocations like any other result.  ``pool_stats``
+    (:meth:`WhatIfService.pool_stats`, ISSUE 16) appends one extra row
+    labeled ``pool`` carrying the pool-lifecycle counters — worker
+    count, respawns, retries — so fleet health trends beside query
+    latency; ``None`` (the in-process path) adds nothing."""
     from gpuschedule_tpu.obs.history import HistoryStore
 
     meta = run_meta or {}
@@ -476,6 +562,22 @@ def append_history(store_path, results: Sequence[dict], *,
                 seed=meta.get("seed"),
                 label=q["kind"],
                 metrics=metrics,
+            )
+            n += 1
+        if pool_stats is not None:
+            store.append(
+                "whatif",
+                run_id=meta.get("run_id", ""),
+                config_hash=meta.get("config_hash", ""),
+                policy=meta.get("policy", ""),
+                seed=meta.get("seed"),
+                label="pool",
+                metrics={
+                    "workers": pool_stats["workers"],
+                    "respawns": pool_stats["respawns"],
+                    "retries": pool_stats["retries"],
+                    "queries": len(results),
+                },
             )
             n += 1
     return n

@@ -117,6 +117,9 @@ UNHASHED = {
     "drain": "drain queries evaluate on forks of the mirrored world",
     "swap_policy": "policy-swap queries evaluate on forks; policy is "
                    "outside the hash by design",
+    "trace_out": "merged fleet-trace export is derived telemetry; "
+                 "disarmed and armed runs are byte-identical "
+                 "(ISSUE 16 pinned)",
 }
 
 

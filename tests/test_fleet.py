@@ -1,0 +1,412 @@
+"""Cross-process observability (ISSUE 16): trace-context propagation,
+deterministic telemetry federation, and the one merged fleet trace.
+
+The contracts under test:
+
+- :meth:`MetricsRegistry.merge` federates registries deterministically —
+  counter sums, bucket-wise histogram addition, labeled-family union —
+  and rejects schema conflicts instead of silently corrupting;
+- :func:`merge_profiles` sums selfprof phase blocks per worker and
+  fleet-wide, as a pure function of the inputs;
+- :class:`FleetCollector` keys telemetry by task index, so adversarial
+  (out-of-order) completion cannot change a byte of the merged document;
+- the retry discipline is exact: a pooled run that crashes a worker
+  mid-task respawns/retries, and the federated counters equal a serial
+  run's EXACTLY — the crashed attempt's partial telemetry never lands;
+- serial vs pooled ``WhatIfService`` evaluation stays result-identical
+  with tracing ARMED (the ISSUE-12 identity re-pinned under ISSUE 16),
+  and the two modes federate identical worker-side counter totals;
+- armed sweep cells return engine-phase profiles that land in the
+  merged document's ``selfprof`` block;
+- the ``whatif --pool 2 --trace-out`` CLI produces ONE valid
+  Perfetto/Chrome trace: a named process per worker, and worker-side
+  restore/fork/replay spans carrying the propagated parent trace id.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+import pytest
+
+from gpuschedule_tpu.cli import main
+from gpuschedule_tpu.cluster.tpu import TpuCluster
+from gpuschedule_tpu.obs import MetricsRegistry
+from gpuschedule_tpu.obs.fleet import (
+    FleetCollector,
+    TaskContext,
+    active,
+    run_task,
+)
+from gpuschedule_tpu.obs.perfetto import validate_chrome_trace
+from gpuschedule_tpu.obs.selfprof import merge_profiles
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.sim import Simulator
+from gpuschedule_tpu.sim.philly import generate_philly_like_trace
+from gpuschedule_tpu.sim.pool import WorkerPool
+from gpuschedule_tpu.sim.whatif import WhatIfService
+
+# --------------------------------------------------------------------- #
+# registry federation: merge() semantics
+
+
+def test_registry_merge_sums_counters_and_unions_families():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("jobs_total", "jobs").inc(3)
+    b.counter("jobs_total", "jobs").inc(4)
+    # labeled family union: disjoint AND overlapping label values
+    a.counter("cells_total", "cells", labelnames=("policy",)).labels(
+        "fifo").inc(2)
+    b.counter("cells_total", "cells", labelnames=("policy",)).labels(
+        "fifo").inc(5)
+    b.counter("cells_total", "cells", labelnames=("policy",)).labels(
+        "srtf").inc(1)
+    b.counter("pool_only_total", "only in b").inc(7)
+    a.gauge("depth", "queue depth").set(2.0)
+    b.gauge("depth", "queue depth").set(9.0)
+
+    a.merge(b)
+    assert a.counter("jobs_total").value == 7.0
+    fam = a.counter("cells_total", labelnames=("policy",))
+    assert fam.labeled_values() == {("fifo",): 7.0, ("srtf",): 1.0}
+    assert a.counter("pool_only_total").value == 7.0
+    # gauges are last-writer-wins (a point-in-time reading, not a sum)
+    assert a.gauge("depth").value == 9.0
+
+
+def test_registry_merge_histograms_bucket_wise():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    edges = (1.0, 10.0, 100.0)
+    ha = a.histogram("lat_ms", "latency", buckets=edges)
+    hb = b.histogram("lat_ms", "latency", buckets=edges)
+    for v in (0.5, 5.0, 50.0):
+        ha.observe(v)
+    for v in (5.0, 500.0):
+        hb.observe(v)
+    # merging a snapshot is equivalent to merging the registry itself
+    a.merge(b.snapshot())
+    assert ha.count == 5
+    assert ha.sum == pytest.approx(560.5)
+    counts = dict(zip(("1", "10", "100", "+Inf"),
+                      (1, 2, 1, 1)))  # bucket-wise addition
+    got = a.histogram("lat_ms", buckets=edges).to_json()["buckets"]
+    assert got == counts
+
+
+def test_registry_merge_rejects_schema_conflicts():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("x_total", "as counter").inc()
+    b.gauge("x_total", "as gauge").set(1.0)
+    with pytest.raises(ValueError, match="already registered"):
+        a.merge(b)
+    c, d = MetricsRegistry(), MetricsRegistry()
+    c.histogram("h", "3 buckets", buckets=(1.0, 2.0, 3.0)).observe(1.0)
+    d.histogram("h", "2 buckets", buckets=(1.0, 2.0)).observe(1.0)
+    with pytest.raises(ValueError, match="buckets"):
+        c.merge(d)
+
+
+# --------------------------------------------------------------------- #
+# selfprof federation
+
+
+def _prof_block(phases: dict, batches: int) -> dict:
+    total = sum(phases.values())
+    return {
+        "total_wall_s": total,
+        "batches": batches,
+        "batches_per_s": batches / total,
+        "phases": {
+            name: {"total_s": s, "share": s / total}
+            for name, s in phases.items()
+        },
+    }
+
+
+def test_merge_profiles_sums_per_worker_and_fleet():
+    per = {
+        "worker-0": [
+            _prof_block({"policy": 1.0, "events": 1.0}, 10),
+            _prof_block({"policy": 3.0, "net": 1.0}, 30),
+        ],
+        "worker-1": [_prof_block({"policy": 2.0}, 5)],
+        "worker-2": [],  # a worker that returned no profiles is dropped
+    }
+    merged = merge_profiles(per)
+    w0 = merged["workers"]["worker-0"]
+    assert w0["tasks"] == 2 and w0["batches"] == 40
+    assert w0["total_wall_s"] == pytest.approx(6.0)
+    assert w0["phases"]["policy"]["total_s"] == pytest.approx(4.0)
+    assert w0["phases"]["policy"]["share"] == pytest.approx(4.0 / 6.0)
+    assert "worker-2" not in merged["workers"]
+    fleet = merged["fleet"]
+    assert fleet["tasks"] == 3 and fleet["batches"] == 45
+    assert fleet["total_wall_s"] == pytest.approx(8.0)
+    assert fleet["phases"]["policy"]["total_s"] == pytest.approx(6.0)
+
+
+# --------------------------------------------------------------------- #
+# the collector: context propagation + order-independence
+
+_CRASH_DIR: str = ""
+
+
+def _traced_square(i: int) -> int:
+    """A fleet task that emits a span, counters, and (for task 1, on its
+    first pooled attempt) hard-kills its worker AFTER incrementing — the
+    double-count trap the retry discipline must survive."""
+    h = active()
+    assert h is not None, "fleet task ran without a harness"
+    h.registry.counter("cells_total", "cells run").inc()
+    h.registry.counter(
+        "cell_runs_total", "per-cell runs", labelnames=("idx",)
+    ).labels(str(i)).inc()
+    with h.tracer.span("square", cat="cell", idx=i):
+        out = i * i
+    if _CRASH_DIR:
+        marker = Path(_CRASH_DIR) / f"cell-{i}.attempted"
+        if i == 1 and not marker.exists():
+            marker.write_text("1")
+            os._exit(1)  # counters above die with the process
+    return out
+
+
+def test_task_context_propagates_into_worker_payloads():
+    ctx = TaskContext("trace-xyz", "dispatch", 3)
+    out = run_task(_traced_square, ctx, (4,))
+    assert out["result"] == 16
+    telem = out["telemetry"]
+    assert telem["trace_id"] == "trace-xyz"
+    assert telem["task"] == 3
+    names = [e["name"] for e in telem["spans"]]
+    assert names == ["task", "square"]  # root span wraps the task body
+    for e in telem["spans"]:
+        assert e["args"]["trace_id"] == "trace-xyz"
+        assert e["args"]["parent_span_id"] == "dispatch"
+    assert active() is None  # harness disarmed after the task
+
+
+def test_absorb_out_of_order_is_byte_deterministic():
+    """Adversarial completion order: absorbing identical payloads in a
+    different order yields the identical merged document."""
+    payloads = {
+        i: run_task(_traced_square, TaskContext("t", "dispatch", i), (i,))
+        for i in range(4)
+    }
+    worker_of = {0: 0, 1: 1, 2: 0, 3: 1}
+
+    def collect(order):
+        fc = FleetCollector("t", parent="test")
+        for i in order:
+            assert fc.absorb(i, worker_of[i], payloads[i]) == i * i
+        return fc.document()
+
+    in_order = collect([0, 1, 2, 3])
+    scrambled = collect([3, 1, 0, 2])
+    assert json.dumps(in_order, sort_keys=True) == json.dumps(
+        scrambled, sort_keys=True
+    )
+    assert in_order["federation"] == {
+        "tasks": 4, "workers": ["worker-0", "worker-1"],
+    }
+    assert in_order["registry"]["cells_total"]["value"] == 4.0
+
+
+def test_pooled_crash_respawn_federates_exactly_like_serial(tmp_path):
+    """The acceptance pin: a pooled run whose worker hard-crashes
+    mid-task (AFTER incrementing its counters) respawns + retries, and
+    the merged counters equal the serial run's EXACTLY — the crashed
+    attempt's partial telemetry died with its process."""
+    global _CRASH_DIR
+    # serial arm: same tasks through the identical in-process harness
+    _CRASH_DIR = ""
+    serial = FleetCollector("crash-pin", parent="test")
+    assert [
+        serial.run_local(_traced_square, i, (i,)) for i in range(4)
+    ] == [0, 1, 4, 9]
+
+    # pooled arm: task 1's first attempt kills its worker
+    _CRASH_DIR = str(tmp_path)
+    pooled = FleetCollector("crash-pin", parent="test")
+    with WorkerPool(2, backoff_s=0.01, registry=pooled.registry) as pool:
+        with pooled.span("dispatch", tasks=4):
+            out = pool.map(
+                _traced_square, [(i,) for i in range(4)], fleet=pooled,
+            )
+    assert out == [0, 1, 4, 9]
+    assert pool.respawns == 1 and pool.retries == 1
+
+    # worker-side federation is EXACTLY the serial one: 4 cell runs, one
+    # per index — not 5 (the crashed attempt never landed), not 3
+    want = serial.merge_into(MetricsRegistry()).to_json()
+    got = pooled.merge_into(MetricsRegistry()).to_json()
+    assert got == want
+    assert want["cells_total"]["value"] == 4.0
+    assert want["cell_runs_total"]["value"] == {
+        '{idx="0"}': 1.0, '{idx="1"}': 1.0,
+        '{idx="2"}': 1.0, '{idx="3"}': 1.0,
+    }
+    # ...and the pool's lifecycle counters recorded the incident on the
+    # collector's parent-side registry (the --prom / history surface)
+    doc = pooled.document()
+    assert doc["registry"]["pool_worker_respawns_total"]["value"] == 1.0
+    assert doc["registry"]["pool_task_retries_total"]["value"] == 1.0
+    assert validate_chrome_trace(doc) == []
+
+
+# --------------------------------------------------------------------- #
+# whatif armed: serial vs pooled identity + federated counters
+
+
+def _paused_world():
+    c = TpuCluster("v5e", dims=(4, 4), num_pods=2)
+    trace = generate_philly_like_trace(16, seed=7)
+    sim = Simulator(c, make_policy("fifo"), trace, max_time=200_000.0)
+    sim.run_until(sim.jobs[len(sim.jobs) // 2].submit_time)
+    return sim
+
+
+def _strip(doc: dict) -> dict:
+    return {k: v for k, v in doc.items() if k != "latency_s"}
+
+
+def test_whatif_armed_serial_vs_pooled_identity():
+    """ISSUE 12's serial-vs-pool result identity, re-pinned with tracing
+    ARMED — and the federated worker-side counters agree exactly."""
+    sim = _paused_world()
+    queries = [
+        {"kind": "admit", "chips": 8, "duration": 3600.0},
+        {"kind": "drain", "scope": ("pod", 1), "duration": 1800.0},
+        {"kind": "policy-swap", "policy": "srtf"},
+    ]
+    serial_fleet = FleetCollector("wi", parent="whatif")
+    with WhatIfService(
+        sim, horizon=40_000.0, fleet=serial_fleet,
+    ) as serial:
+        docs_serial = serial.evaluate(queries)
+    pooled_fleet = FleetCollector("wi", parent="whatif")
+    with WhatIfService(
+        sim, horizon=40_000.0, workers=2, fleet=pooled_fleet,
+        registry=pooled_fleet.registry,
+    ) as pooled:
+        docs_pool = pooled.evaluate(queries)
+
+    assert [_strip(d) for d in docs_serial] == [_strip(d) for d in docs_pool]
+
+    # federated worker-side families identical: one whatif_queries_total
+    # per kind, whether the harness ran in-process or in a child
+    want = serial_fleet.merge_into(MetricsRegistry()).to_json()
+    got = pooled_fleet.merge_into(MetricsRegistry()).to_json()
+    assert got == want
+    assert want["whatif_queries_total"]["value"] == {
+        '{kind="admit"}': 1.0, '{kind="drain"}': 1.0,
+        '{kind="policy-swap"}': 1.0,
+    }
+
+    # both span trees carry the full phase vocabulary with the trace id
+    for fleet in (serial_fleet, pooled_fleet):
+        spans = [
+            e for evs in fleet.worker_events().values() for e in evs
+        ]
+        names = {e["name"] for e in spans}
+        assert {"task", "restore", "fork", "mutate", "replay",
+                "diff"} <= names
+        assert all(e["args"]["trace_id"] == "wi" for e in spans)
+        assert all(
+            e["args"]["parent_span_id"] == "dispatch" for e in spans
+        )
+    assert sorted(pooled_fleet.worker_events()) == ["worker-0", "worker-1"]
+    assert sorted(serial_fleet.worker_events()) == ["worker-local"]
+
+
+# --------------------------------------------------------------------- #
+# armed sweep cells return engine-phase profiles
+
+
+def test_armed_sweep_cells_carry_engine_phase_profiles():
+    from gpuschedule_tpu.faults.sweep import sweep
+
+    fleet = FleetCollector("sweep-t", parent="sweep")
+    plain = sweep((20_000.0,), ["fifo"], num_jobs=12, seed=3,
+                  max_time=60_000.0)
+    armed = sweep((20_000.0,), ["fifo"], num_jobs=12, seed=3,
+                  max_time=60_000.0, fleet=fleet)
+    # the artifact itself is unchanged by arming (telemetry out of band)
+    assert json.dumps(armed, sort_keys=True, default=str) == json.dumps(
+        plain, sort_keys=True, default=str
+    )
+    doc = fleet.document()
+    assert validate_chrome_trace(doc) == []
+    prof = doc["selfprof"]["workers"]["worker-local"]
+    assert prof["tasks"] == 1 and prof["batches"] > 0
+    assert prof["phases"]["policy_schedule"]["total_s"] >= 0.0
+    # phases cover the measured wall total exactly (the PR-9 invariant,
+    # preserved through federation)
+    assert sum(
+        p["total_s"] for p in prof["phases"].values()
+    ) == pytest.approx(prof["total_wall_s"])
+    names = {e["name"] for e in fleet.worker_events()["worker-local"]}
+    assert {"task", "build", "replay"} <= names
+
+
+# --------------------------------------------------------------------- #
+# the CLI acceptance: one merged Perfetto document
+
+WORLD = [
+    "--synthetic", "12", "--seed", "5", "--cluster", "tpu-v5e",
+    "--dims", "4x4", "--pods", "2", "--policy", "dlas",
+    "--faults", "mtbf=5000,repair=600",
+    "--net", "os=2",
+]
+
+
+def test_cli_whatif_pool_trace_out_acceptance(tmp_path, capsys):
+    """`whatif --pool 2 --trace-out` on the 12-job feature-loaded world:
+    ONE valid Perfetto/Chrome document, a named process per worker, and
+    worker-side restore/fork/replay spans carrying the parent trace id."""
+    trace = tmp_path / "fleet.json"
+    rc = main([
+        "whatif", *WORLD, "--at", "20000", "--horizon", "40000",
+        "--pool", "2",
+        "--admit", "chips=8,duration=3600,pods=0:1",
+        "--drain", "pod=1,duration=3600",
+        "--trace-out", str(trace),
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    doc = json.loads(trace.read_text())
+    assert validate_chrome_trace(doc) == []
+
+    # one named process per worker, plus the parent
+    procs = {
+        e["pid"]: e["args"]["name"] for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert sorted(procs.values()) == ["whatif", "worker-0", "worker-1"]
+    assert doc["federation"] == {
+        "tasks": 3, "workers": ["worker-0", "worker-1"],
+    }
+    assert doc["otherData"]["trace_id"] == out["run_id"]
+
+    # the parent span tree and the propagated worker phases
+    timed = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    parent = {e["name"] for e in timed if e["pid"] == 1}
+    assert {"enqueue", "dispatch", "reassemble"} <= parent
+    worker = [e for e in timed if e["pid"] != 1]
+    names = {e["name"] for e in worker}
+    assert {"task", "restore", "fork", "mutate", "replay", "diff"} <= names
+    for e in worker:
+        assert e["args"]["trace_id"] == out["run_id"]
+        assert e["args"]["parent_span_id"] == "dispatch"
+
+    # federated registry rode along: per-kind query counters + the
+    # parent-side latency histogram + pool lifecycle counters
+    reg = doc["registry"]
+    assert reg["whatif_queries_total"]["value"] == {
+        '{kind="admit"}': 2.0, '{kind="drain"}': 1.0,
+    }
+    lat = reg["whatif_query_latency_ms"]["value"]
+    assert lat['{kind="admit"}']["count"] == 2
+    assert reg["pool_worker_respawns_total"]["value"] == 0.0

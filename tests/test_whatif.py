@@ -405,14 +405,23 @@ def test_cli_whatif_smoke(tmp_path, capsys):
     text = prom.read_text()
     assert 'whatif_query_latency_ms_count{kind="admit"} 2' in text
     assert 'whatif_query_latency_ms_count{kind="drain"} 1' in text
-    # one history row per query under the run's config-hash identity
+    # pool lifecycle counters ride --prom whenever the pool has a
+    # registry — tracing armed or not (ISSUE 16 satellite)
+    assert "pool_worker_respawns_total 0" in text
+    assert "pool_task_retries_total 0" in text
+    # one history row per query under the run's config-hash identity,
+    # plus the pooled run's one "pool" lifecycle row (ISSUE 16)
     with HistoryStore(store) as hs:
         rows = hs.rows(kind="whatif")
-    assert len(rows) == 3
-    assert [r.label for r in rows] == ["admit", "admit", "drain"]
+    assert len(rows) == 4
+    assert [r.label for r in rows] == ["admit", "admit", "drain", "pool"]
     assert all(r.config_hash == doc["config_hash"] for r in rows)
-    assert all(r.metrics["latency_ms"] > 0.0 for r in rows)
-    assert all("delta_avg_jct_s" in r.metrics for r in rows)
+    qrows, prow = rows[:3], rows[3]
+    assert all(r.metrics["latency_ms"] > 0.0 for r in qrows)
+    assert all("delta_avg_jct_s" in r.metrics for r in qrows)
+    assert prow.metrics == {
+        "workers": 2, "respawns": 0, "retries": 0, "queries": 3,
+    }
 
 
 def test_cli_whatif_rejects_bad_usage(tmp_path, capsys):

@@ -182,6 +182,105 @@ def _time_selfprof_on(num_jobs: int) -> float:
     return time.perf_counter() - t0
 
 
+# --------------------------------------------------------------------- #
+# pooltrace_off rung (ISSUE 16): the disarmed cross-process-tracing path.
+# The fleet layer touched the what-if evaluator itself (harness lookup +
+# NULL_SPAN context managers around fork/mutate/replay/diff), so the knob
+# gets its own gate: a disarmed serial WhatIfService query burst vs the
+# same fork/mutate/replay/diff loop hand-rolled with no instrumentation
+# at all.  workers=0 keeps process-spawn noise out of the measurement
+# while exercising the identical disarmed plumbing — the pooled path runs
+# the same evaluate_query body in the workers, so a pass here covers it.
+# The mirror world is deliberately small: the rung measures per-query
+# plumbing overhead, and a small bounded replay maximizes the plumbing's
+# share of the timed burst (a large world would hide a regression).
+
+WHATIF_JOBS = 120
+WHATIF_AT_S = 4000.0
+WHATIF_HORIZON_S = 3000.0
+WHATIF_BURST = 6  # repetitions of the two-query set per timed burst
+
+_WHATIF_QUERIES = (
+    {"kind": "admit", "chips": 8, "duration": 1800.0},
+    {"kind": "policy-swap", "policy": "srtf"},
+)
+
+_WHATIF_STATE: bytes | None = None
+
+
+def _whatif_state() -> bytes:
+    """The paused-mirror state bytes, built once (setup, never timed)."""
+    global _WHATIF_STATE
+    if _WHATIF_STATE is None:
+        from gpuschedule_tpu.sim.snapshot import state_to_bytes
+
+        jobs = generate_poisson_trace(
+            WHATIF_JOBS, seed=77, mean_duration=900.0
+        )
+        sim = Simulator(
+            SimpleCluster(CHIPS),
+            make_policy("dlas", thresholds=(600.0,)),
+            jobs,
+        )
+        sim.run_until(WHATIF_AT_S)
+        _WHATIF_STATE = state_to_bytes(sim)
+    return _WHATIF_STATE
+
+
+def _time_pooltrace_off(num_jobs: int) -> float:
+    # the public disarmed path: service construction + baseline warm are
+    # setup (untimed, the same rule the evaluator itself follows); the
+    # timed burst is pure query evaluation through the instrumented body
+    from gpuschedule_tpu.sim.snapshot import clone_from_state_bytes
+    from gpuschedule_tpu.sim.whatif import WhatIfService
+
+    sim = clone_from_state_bytes(_whatif_state())
+    svc = WhatIfService(sim, horizon=WHATIF_HORIZON_S)
+    svc.warm()
+    queries = [dict(q) for q in _WHATIF_QUERIES] * WHATIF_BURST
+    t0 = time.perf_counter()
+    svc.evaluate(queries)
+    return time.perf_counter() - t0
+
+
+def _time_pooltrace_base(num_jobs: int) -> float:
+    # the uninstrumented equivalent of the same burst: fork, bound,
+    # mutate, replay, diff — no harness lookup, no span context managers,
+    # no per-query latency bookkeeping
+    from gpuschedule_tpu.sim.snapshot import clone_from_state_bytes
+    from gpuschedule_tpu.sim.whatif import (
+        _bound,
+        _delta_doc,
+        _result_doc,
+        apply_query,
+        baseline_doc,
+        validate_query,
+    )
+
+    blob = _whatif_state()
+
+    def fork_fn():
+        return clone_from_state_bytes(blob)
+
+    base = baseline_doc(fork_fn, WHATIF_HORIZON_S)
+    queries = [dict(q) for q in _WHATIF_QUERIES] * WHATIF_BURST
+    t0 = time.perf_counter()
+    for q in queries:
+        q = validate_query(q)
+        fork = fork_fn()
+        at = fork.now
+        _bound(fork, WHATIF_HORIZON_S)
+        injected = apply_query(fork, q)
+        var = _result_doc(fork.run())
+        doc = {
+            "query": dict(q), "at_s": at,
+            "horizon_s": WHATIF_HORIZON_S, "base": base, "variant": var,
+            "delta": _delta_doc(base, var),
+        }
+        assert doc and (injected is None or injected.job_id)
+    return time.perf_counter() - t0
+
+
 def _time_enabled(num_jobs: int) -> float:
     tracer = get_tracer()
     sim = _fresh_sim(
@@ -210,12 +309,15 @@ def run_guard(
     for attempt in range(1, max_attempts + 1):
         base_times, dis_times, samp_times = [], [], []
         prof_times, acct_times, watch_times = [], [], []
+        pt_base_times, pt_off_times = [], []
         _time_baseline(num_jobs)  # warm allocator/caches off the record
         _time_disabled(num_jobs)
         _time_sampling(num_jobs)
         _time_selfprof_off(num_jobs)
         _time_accounting_v1(num_jobs)
         _time_watch_off(num_jobs)
+        _time_pooltrace_base(num_jobs)
+        _time_pooltrace_off(num_jobs)
         for _ in range(attempt_repeats):  # interleaved: drift hits all alike
             base_times.append(_time_baseline(num_jobs))
             dis_times.append(_time_disabled(num_jobs))
@@ -223,21 +325,29 @@ def run_guard(
             prof_times.append(_time_selfprof_off(num_jobs))
             acct_times.append(_time_accounting_v1(num_jobs))
             watch_times.append(_time_watch_off(num_jobs))
+            pt_base_times.append(_time_pooltrace_base(num_jobs))
+            pt_off_times.append(_time_pooltrace_off(num_jobs))
         t_base, t_dis = min(base_times), min(dis_times)
         t_samp = min(samp_times)
         t_prof_off = min(prof_times)
         t_acct_v1 = min(acct_times)
         t_watch_off = min(watch_times)
+        t_pt_base, t_pt_off = min(pt_base_times), min(pt_off_times)
         ratio = t_dis / t_base if t_base > 0 else float("inf")
         samp_ratio = t_samp / t_base if t_base > 0 else float("inf")
         prof_ratio = t_prof_off / t_base if t_base > 0 else float("inf")
         acct_ratio = t_acct_v1 / t_base if t_base > 0 else float("inf")
         watch_ratio = t_watch_off / t_base if t_base > 0 else float("inf")
+        # the pooltrace rung gates against ITS OWN uninstrumented loop,
+        # not the engine baseline: the knob's surface is the what-if
+        # evaluator, and that is the pair the <=2% contract binds
+        pt_ratio = t_pt_off / t_pt_base if t_pt_base > 0 else float("inf")
         result = {
             "ok": (ratio <= tolerance and samp_ratio <= tolerance
                    and prof_ratio <= tolerance
                    and acct_ratio <= tolerance
-                   and watch_ratio <= tolerance),
+                   and watch_ratio <= tolerance
+                   and pt_ratio <= tolerance),
             "attempt": attempt,
             "repeats": attempt_repeats,
             "num_jobs": num_jobs,
@@ -252,6 +362,9 @@ def run_guard(
             "accounting_v1_over_baseline": round(acct_ratio, 4),
             "watch_off_s": round(t_watch_off, 6),
             "watch_off_over_baseline": round(watch_ratio, 4),
+            "pooltrace_base_s": round(t_pt_base, 6),
+            "pooltrace_off_s": round(t_pt_off, 6),
+            "pooltrace_off_over_baseline": round(pt_ratio, 4),
             "sample_interval_s": SAMPLE_INTERVAL_S,
             "tolerance": tolerance,
         }

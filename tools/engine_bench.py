@@ -283,6 +283,11 @@ def run_ladder(
                     rung = pool.apply(_rung_task, ((config, n, seed, repeats),))
                 else:
                     rung = _rung_task((config, n, seed, repeats))
+                # ISSUE 16: record where the rung ran — 1 = its own forked
+                # worker, 0 = in-process (--no-isolate).  An int, so the
+                # --history metrics filter carries it and a cross-box
+                # trend can split the two populations.
+                rung["workers"] = 1 if pool is not None else 0
                 print(json.dumps(rung, sort_keys=True), file=sys.stderr)
                 rungs.append(rung)
     finally:

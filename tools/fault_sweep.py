@@ -91,6 +91,14 @@ def main(argv=None) -> int:
                         "grid order, so the artifact is byte-identical "
                         "to --workers 1, the serial default)")
     p.add_argument("--out", required=True, help="JSON artifact path")
+    p.add_argument("--trace",
+                   help="write ONE merged Perfetto/Chrome trace of the "
+                        "sweep fleet here (ISSUE 16): a named track per "
+                        "worker with each cell's build/replay spans and "
+                        "engine-phase profile, linked to the parent "
+                        "dispatch span by the propagated trace id.  The "
+                        "sweep artifact itself is byte-identical with or "
+                        "without this flag")
     args = p.parse_args(argv)
 
     mtbfs = (
@@ -114,10 +122,16 @@ def main(argv=None) -> int:
             p.error(
                 f"--ckpt-write wants seconds or 'auto', got {args.ckpt_write!r}"
             )
+    fleet = None
+    if args.trace:
+        from gpuschedule_tpu.obs import FleetCollector
+
+        fleet = FleetCollector(f"fault-sweep-s{args.seed}", parent="sweep")
     grid = sweep(
         mtbfs,
         policies,
         workers=args.workers,
+        fleet=fleet,
         repair=args.repair,
         ckpt=args.ckpt,
         restore=restore,
@@ -168,9 +182,17 @@ def main(argv=None) -> int:
         out.parent.mkdir(parents=True, exist_ok=True)
     out.write_text(json.dumps(doc, indent=2, sort_keys=True))
     cells = sum(len(v) for v in grid["policies"].values())
-    print(json.dumps(jsonable({"out": str(out), "cells": cells,
-                               "mtbf_s": grid["mtbf_s"],
-                               "policies": sorted(grid["policies"])})))
+    summary = {"out": str(out), "cells": cells,
+               "mtbf_s": grid["mtbf_s"],
+               "policies": sorted(grid["policies"])}
+    if fleet is not None:
+        tdoc = fleet.write(args.trace)
+        summary["trace"] = {
+            "out": args.trace,
+            "tasks": tdoc["federation"]["tasks"],
+            "workers": tdoc["federation"]["workers"],
+        }
+    print(json.dumps(jsonable(summary)))
     return 0
 
 

@@ -68,6 +68,12 @@ def main(argv=None) -> int:
                         "replays reassembled in grid order: byte-identical "
                         "to --workers 1, the serial default)")
     p.add_argument("--out", required=True, help="JSON artifact path")
+    p.add_argument("--trace",
+                   help="write ONE merged Perfetto/Chrome trace of the "
+                        "sweep fleet here (ISSUE 16): a named track per "
+                        "worker with each cell's build/replay spans and "
+                        "engine-phase profile.  The sweep artifact itself "
+                        "is byte-identical with or without this flag")
     args = p.parse_args(argv)
 
     shares = (
@@ -75,10 +81,16 @@ def main(argv=None) -> int:
         if args.shares else DEFAULT_SHARES
     )
     policies = args.policies.split(",") if args.policies else None
+    fleet = None
+    if args.trace:
+        from gpuschedule_tpu.obs import FleetCollector
+
+        fleet = FleetCollector(f"net-sweep-s{args.seed}", parent="sweep")
     grid = sweep(
         shares,
         policies,
         workers=args.workers,
+        fleet=fleet,
         num_jobs=args.num_jobs,
         seed=args.seed,
         dims=_parse_dims(args.dims),
@@ -104,9 +116,17 @@ def main(argv=None) -> int:
         out.parent.mkdir(parents=True, exist_ok=True)
     out.write_text(json.dumps(doc, indent=2, sort_keys=True))
     cells = sum(len(v) for v in grid["policies"].values())
-    print(json.dumps(jsonable({"out": str(out), "cells": cells,
-                               "multislice_share": list(shares),
-                               "policies": sorted(grid["policies"])})))
+    summary = {"out": str(out), "cells": cells,
+               "multislice_share": list(shares),
+               "policies": sorted(grid["policies"])}
+    if fleet is not None:
+        tdoc = fleet.write(args.trace)
+        summary["trace"] = {
+            "out": args.trace,
+            "tasks": tdoc["federation"]["tasks"],
+            "workers": tdoc["federation"]["workers"],
+        }
+    print(json.dumps(jsonable(summary)))
     return 0
 
 
