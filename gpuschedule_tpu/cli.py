@@ -802,15 +802,14 @@ def cmd_whatif(args) -> int:
     horizon."""
     from pathlib import Path
 
-    from gpuschedule_tpu.faults.sweep import jsonable
     from gpuschedule_tpu.obs import MetricsRegistry
     from gpuschedule_tpu.sim.metrics import MetricsLog
     from gpuschedule_tpu.sim.whatif import (
         WhatIfService,
         append_history,
-        latency_summary,
         parse_admit_spec,
         parse_drain_spec,
+        result_document,
     )
 
     queries = []
@@ -946,26 +945,16 @@ def cmd_whatif(args) -> int:
         raise SystemExit(str(e)) from None
     finally:
         service.close()
-    doc = jsonable({
-        "at_s": sim.now,
-        "requested_at_s": args.at,
-        "horizon_s": args.horizon,
-        "pool": args.pool,
-        "policy": run_meta["policy"],
-        "run_id": run_meta["run_id"],
-        "config_hash": chash,
-        "mirror": {
-            "running": len(sim.running),
-            "pending": len(sim.pending),
-            "finished": len(sim.finished),
-        },
-        "latency_ms": latency_summary(results),
-        "queries": results,
-    })
+    doc = result_document(
+        sim, results, requested_at=args.at, horizon=args.horizon,
+        pool=args.pool, run_meta=run_meta,
+    )
     print(json.dumps(doc, sort_keys=True))
     if args.history:
+        # pool_stats() now answers in serial mode too (ISSUE 18, for
+        # /status) — the extra history "pool" row stays pool-only
         n = append_history(args.history, results, run_meta=run_meta,
-                           pool_stats=pool_stats)
+                           pool_stats=pool_stats if args.pool else None)
         print(f"{n} whatif history rows -> {args.history}", file=sys.stderr)
     if args.out:
         out = Path(args.out)
@@ -986,6 +975,140 @@ def cmd_whatif(args) -> int:
             file=sys.stderr,
         )
         fleet.merge_into(registry)
+    if args.prom:
+        registry.write(prom_path=args.prom)
+    return 0
+
+
+def cmd_serve(args) -> int:
+    """Serve the twin (ISSUE 18): build the world exactly like
+    ``whatif``, pause it at ``--at``, warm a :class:`WhatIfService`
+    pool, and run the long-lived control plane — ``GET /metrics``,
+    ``GET /alerts`` (SSE), ``POST /whatif`` (admission-controlled),
+    ``GET /status`` / ``/healthz`` / ``/readyz``, and the ``GET /``
+    dashboard — until SIGTERM/SIGINT (or ``--max-wall``), then drain
+    gracefully.  One ``{"serve": ...}`` line announces the bound port
+    the moment the daemon is ready; one ``{"serve_summary": ...}`` line
+    closes the session."""
+    from gpuschedule_tpu.obs import MetricsRegistry
+    from gpuschedule_tpu.obs.server import (
+        TwinServer,
+        install_signal_handlers,
+    )
+    from gpuschedule_tpu.obs.watch import load_rules
+    from gpuschedule_tpu.sim.metrics import MetricsLog
+    from gpuschedule_tpu.sim.whatif import WhatIfService
+
+    if args.follow and args.replay:
+        raise SystemExit("--follow and --replay are mutually exclusive")
+    if args.at < 0.0:
+        raise SystemExit(f"--at must be >= 0, got {args.at}")
+    if args.poll <= 0.0:
+        raise SystemExit(f"--poll must be > 0 seconds, got {args.poll}")
+    if args.speed < 0.0:
+        raise SystemExit(f"--speed must be >= 0, got {args.speed}")
+    mode = "follow" if args.follow else ("replay" if args.replay else "batch")
+    rules = None
+    slo_cfg = None
+    try:
+        if args.events is not None:
+            rules = load_rules(args.rules)
+            if args.window is not None:
+                if args.window <= 0.0:
+                    raise ValueError(
+                        f"--window must be > 0, got {args.window}"
+                    )
+                rules["window_s"] = float(args.window)
+        if args.self_slo is not None:
+            slo_cfg = json.loads(args.self_slo)
+            if not isinstance(slo_cfg, dict):
+                raise ValueError(
+                    "--self-slo wants a JSON object of SELF_SLO_DEFAULTS "
+                    "overrides"
+                )
+    except (ValueError, json.JSONDecodeError) as e:
+        raise SystemExit(str(e)) from None
+    net_model = build_net(args)
+    if args.placement == "contention" and net_model is None:
+        raise SystemExit(
+            "--placement contention scores pods by residual DCN "
+            "bandwidth and needs the fabric model: add --net"
+        )
+    cluster = build_cluster(args, net=net_model)
+    jobs = load_jobs(args)
+    fault_plan = build_fault_plan(args, cluster, jobs)
+    # the mirror runs with attribution armed, exactly like `whatif` —
+    # same builders, same config hash, byte-identical served documents
+    metrics = MetricsLog(attribution=True)
+    try:
+        sim = Simulator(
+            cluster, build_policy(args), jobs,
+            metrics=metrics,
+            max_time=args.max_time or float("inf"),
+            faults=fault_plan,
+            net=net_model,
+            accounting=args.accounting,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+    sim.run_until(args.at)
+    chash = _run_config_hash(args)
+    run_meta = {
+        "run_id": f"{args.policy}-s{args.seed}-{chash}",
+        "seed": args.seed, "policy": args.policy, "config_hash": chash,
+    }
+    registry = MetricsRegistry()
+    try:
+        service = WhatIfService(
+            sim, horizon=args.horizon, workers=args.pool,
+            registry=registry, max_inflight=args.max_inflight,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+    try:
+        service.warm()
+        server = TwinServer(
+            service,
+            registry=registry,
+            requested_at=args.at,
+            run_meta=run_meta,
+            events=args.events,
+            mode=mode,
+            rules=rules,
+            self_slo=slo_cfg,
+            alerts_path=args.alerts,
+            history=args.history,
+            host=args.host,
+            port=args.port,
+            speed=args.speed,
+            poll_s=args.poll,
+            idle_timeout_s=args.idle_timeout,
+            max_wall_s=args.max_wall,
+            drain_s=args.drain_s,
+        )
+    except ValueError as e:
+        service.close()
+        raise SystemExit(str(e)) from None
+    try:
+        stop = install_signal_handlers(server)
+    except ValueError:
+        # signal handlers need the main thread; tests drive main() from
+        # a worker thread and stop via --max-wall instead
+        import threading
+
+        stop = threading.Event()
+    server.start()
+    print(json.dumps({"serve": {
+        "host": server.host, "port": server.port, "mode": mode,
+        "pool": args.pool, "run_id": run_meta["run_id"],
+        "config_hash": chash,
+    }}, sort_keys=True), flush=True)
+    try:
+        stop.wait(timeout=args.max_wall)
+    except KeyboardInterrupt:
+        pass
+    summary = server.shutdown()
+    print(json.dumps({"serve_summary": summary}, sort_keys=True))
     if args.prom:
         registry.write(prom_path=args.prom)
     return 0
@@ -1852,6 +1975,87 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "(ISSUE 16).  Off by default — disarmed runs "
                          "are byte-identical")
     wi.set_defaults(fn=cmd_whatif)
+
+    sv = sub.add_parser(
+        "serve",
+        help="serve the twin (ISSUE 18): a long-lived observability "
+             "control plane over the mirrored world — /metrics scrape, "
+             "SSE alert feed (/alerts), admission-controlled POST "
+             "/whatif, /status + /healthz + /readyz, a live dashboard "
+             "at /, and a self-SLO watchdog that pages about the "
+             "daemon itself",
+    )
+    _add_world_args(sv)
+    sv.add_argument("--at", type=float, required=True, metavar="SECONDS",
+                    help="sim time to mirror the world at (exactly like "
+                         "`whatif --at`): the daemon serves queries "
+                         "against the engine paused there")
+    sv.add_argument("--horizon", type=float, default=86_400.0,
+                    metavar="SECONDS",
+                    help="bounded speculative-replay horizon per served "
+                         "query (default: one day of sim time)")
+    sv.add_argument("--pool", type=int, default=0, metavar="N",
+                    help="persistent worker processes serving queries "
+                         "(0 = in-process; served documents are pinned "
+                         "identical either way)")
+    sv.add_argument("--host", default="127.0.0.1",
+                    help="listen address (default 127.0.0.1)")
+    sv.add_argument("--port", type=int, default=0, metavar="PORT",
+                    help="listen port; 0 (default) binds an ephemeral "
+                         "port, announced on the {\"serve\": ...} line")
+    sv.add_argument("--max-inflight", type=int, default=None, metavar="N",
+                    dest="max_inflight",
+                    help="admission bound on concurrently admitted "
+                         "queries (default: 2 x max(1, --pool)); a full "
+                         "queue answers 429 + whatif_rejected_total")
+    sv.add_argument("--events", metavar="EVENTS_JSONL",
+                    help="also watch this event stream through the "
+                         "PR-15 detector set; alerts fan out to the SSE "
+                         "feed, --alerts, --history, and "
+                         "watch_alerts_total")
+    sv.add_argument("--follow", action="store_true",
+                    help="tail --events as a GROWING file (live run)")
+    sv.add_argument("--replay", action="store_true",
+                    help="pace --events as-if-live by sim time")
+    sv.add_argument("--speed", type=float, default=0.0, metavar="X",
+                    help="--replay pacing: X sim seconds per wall "
+                         "second (0 = no pacing)")
+    sv.add_argument("--poll", type=float, default=0.5, metavar="SECONDS",
+                    help="--follow poll interval (wall)")
+    sv.add_argument("--idle-timeout", type=float, default=None,
+                    metavar="SECONDS",
+                    help="--follow: stop watching after this long "
+                         "without stream growth")
+    sv.add_argument("--max-wall", type=float, default=None,
+                    metavar="SECONDS",
+                    help="hard wall-clock serving budget: shut down "
+                         "gracefully after SECONDS (default: serve "
+                         "until SIGTERM/SIGINT)")
+    sv.add_argument("--rules", metavar="RULES_JSON",
+                    help="detector config overlaying DEFAULT_RULES "
+                         "(like `watch --rules`)")
+    sv.add_argument("--window", type=float, metavar="SECONDS",
+                    help="detector window length (overrides rules)")
+    sv.add_argument("--self-slo", metavar="JSON", dest="self_slo",
+                    help="self-SLO watchdog overrides as a JSON object "
+                         "(SELF_SLO_DEFAULTS keys: latency_slo_ms, "
+                         "target, fast_burn, slow_burn, window_queries, "
+                         "slow_windows)")
+    sv.add_argument("--alerts", metavar="PATH",
+                    help="write the alert side stream (cluster AND "
+                         "self-SLO alerts) here")
+    sv.add_argument("--history", metavar="STORE",
+                    help="append alert rows (kind 'watch') live and one "
+                         "kind 'serve' session row at shutdown")
+    sv.add_argument("--prom", metavar="PATH",
+                    help="also write the final registry in Prometheus "
+                         "text format at shutdown (the live surface is "
+                         "GET /metrics)")
+    sv.add_argument("--drain-s", type=float, default=10.0, dest="drain_s",
+                    metavar="SECONDS",
+                    help="graceful-shutdown budget for draining "
+                         "in-flight queries (default 10)")
+    sv.set_defaults(fn=cmd_serve)
 
     lint = sub.add_parser(
         "lint",

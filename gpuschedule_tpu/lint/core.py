@@ -88,9 +88,14 @@ class LintConfig:
     # document must be a pure function of the worker payloads, so its
     # wall anchors / process-local harness globals carry reasoned
     # pragmas like the engine's own measurement sites
+    # ... and the serving daemon (ISSUE 18): everything it serves must
+    # be a pure function of (world, mirror instant, stream, queries) —
+    # wall clock lives only at the HTTP edge (uptime, drain deadlines,
+    # SSE keepalives), each read behind a reasoned pragma
     determinism_files: Tuple[str, ...] = (
         f"{PACKAGE}/obs/watch.py",
         f"{PACKAGE}/obs/fleet.py",
+        f"{PACKAGE}/obs/server.py",
     )
     # rule GS3xx: the event emitters and their schema document.  Every
     # path in emitter_paths is scanned for ``.event(...)`` calls — the
@@ -109,7 +114,7 @@ class LintConfig:
     # every subparser variable that builds a hashed world is audited
     cli_path: str = f"{PACKAGE}/cli.py"
     worldspec_path: str = f"{PACKAGE}/worldspec.py"
-    world_parser_receivers: Tuple[str, ...] = ("run", "wi")
+    world_parser_receivers: Tuple[str, ...] = ("run", "wi", "sv")
     # rule GS41x: per-key spec-table audit (ISSUE 14) — each row is
     # (spec module, table name, ((target label, config module, config
     # class), ...)).  A table whose values are plain attribute strings

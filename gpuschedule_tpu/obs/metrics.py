@@ -26,8 +26,14 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import threading
+import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# The Prometheus text exposition content type (format version 0.0.4) —
+# what a conforming /metrics endpoint declares.
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 # Prometheus' default histogram buckets, trimmed to the second-to-minutes
 # range scheduling telemetry actually spans.
@@ -541,6 +547,57 @@ class MetricsRegistry:
         if json_path is not None:
             with open(json_path, "w") as f:
                 json.dump(self.to_json(), f, indent=2, sort_keys=True)
+
+
+def exposition(registry: MetricsRegistry) -> Tuple[bytes, str]:
+    """The registry rendered for a scrape endpoint (ISSUE 18): the text
+    exposition encoded to bytes plus the content type a conforming
+    ``GET /metrics`` response declares."""
+    return registry.prometheus_text().encode("utf-8"), PROM_CONTENT_TYPE
+
+
+def process_rss_bytes() -> float:
+    """This process's resident set size, in bytes — /proc when the
+    platform has one, ``ru_maxrss`` (a high-water mark, the closest
+    portable stand-in) otherwise, 0.0 when neither is readable."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return float(pages * os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+
+        return float(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024)
+    except Exception:
+        return 0.0
+
+
+def process_gauges(registry: MetricsRegistry, *, clock=time.monotonic):
+    """Arm the serving daemon's process self-gauges (ISSUE 18 satellite):
+    ``process_uptime_seconds`` (seconds since this call, on ``clock``)
+    and ``process_rss_bytes``.  Returns an ``update()`` closure that
+    refreshes both (called once here, then by the daemon before every
+    scrape).  Nothing registers until this is called — a registry that
+    never serves stays byte-identical to before this function existed
+    (pinned by tests/test_serve.py)."""
+    uptime = registry.gauge(
+        "process_uptime_seconds",
+        "seconds this process has been serving",
+    )
+    rss = registry.gauge(
+        "process_rss_bytes",
+        "resident set size of this process (bytes)",
+    )
+    t0 = clock()
+
+    def update() -> None:
+        uptime.set(clock() - t0)
+        rss.set(process_rss_bytes())
+
+    update()
+    return update
 
 
 _REGISTRY = MetricsRegistry()

@@ -1,0 +1,792 @@
+"""Serve the twin (ISSUE 18 tentpole): a live observability control
+plane over the paused engine — ``/metrics`` scrape, SSE alert feed,
+HTTP what-if API, and a self-SLO watchdog.
+
+Every earlier surface is a one-shot CLI invocation; this module is the
+long-lived daemon production observability expects: it tails a live (or
+replayed-as-live) event stream through the PR-15 :class:`Watcher`,
+fronts a warm :class:`WhatIfService` pool, and exposes
+
+- ``GET /metrics`` — Prometheus text exposition of the live registry
+  (query-latency histogram, rejection counter, federated pool
+  lifecycle counters, process self-gauges);
+- ``GET /alerts`` — an SSE feed of latched watchtower alerts the
+  instant they fire (backlog replay on connect, keepalive comments);
+- ``POST /whatif`` — JSON queries against the warm mirror, admission-
+  controlled: a bounded in-flight queue keyed to pool depth answers
+  saturation with HTTP 429 + ``whatif_rejected_total``;
+- ``GET /status`` / ``/healthz`` / ``/readyz`` — pool depth, respawn /
+  retry counters, watcher window position, query-latency summary;
+- ``GET /`` — a self-contained live dashboard reusing the report
+  palette.
+
+Observability all the way down: a :class:`~.watch.SelfSLO` watchdog —
+the PR-15 multi-window burn-rate machinery pointed at the daemon's own
+latency / rejection / error series — raises alerts about *itself* into
+the same alert stream, history rows, and ``watch_alerts_total`` family
+as cluster incidents.
+
+**Determinism boundary** (lint: this file sits in
+``LintConfig.determinism_files``): the HTTP layer is strictly a veneer
+over the deterministic cores.  The served what-if document is byte-
+identical to the offline ``whatif`` CLI on the same mirror (modulo the
+wall-clock latency readings — :func:`~.whatif.canonical_document`), the
+SSE alert sequence is identical to batch ``watch`` on the same stream,
+and wall clock lives only at the edge (uptime, drain deadlines), each
+read behind a reasoned pragma.  Pinned by tests/test_serve.py.
+
+Graceful shutdown (SIGTERM/SIGINT via
+:func:`install_signal_handlers`): stop admitting, drain in-flight
+queries up to ``drain_s``, stop the HTTP server, finish the watcher
+(header + summary + alert-file flush), close the pool, and append one
+``kind="serve"`` history row so service health trends across sessions.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import signal
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+
+from gpuschedule_tpu.obs.metrics import (
+    MetricsRegistry,
+    exact_quantile,
+    exposition,
+    process_gauges,
+)
+from gpuschedule_tpu.obs.watch import (
+    AlertStream,
+    SelfSLO,
+    Watcher,
+    follow_stream,
+    iter_stream,
+    replay_stream,
+)
+from gpuschedule_tpu.sim.whatif import (
+    AdmissionError,
+    WhatIfService,
+    normalize_query,
+    result_document,
+    validate_query,
+)
+
+SERVER_NAME = "gpuschedule-twin"
+
+
+# --------------------------------------------------------------------- #
+# alert fan-out
+
+
+class AlertHub:
+    """Fan one alert-record sequence out to any number of SSE clients:
+    each client gets its own bounded queue; late joiners replay the
+    retained backlog first, so the SSE sequence every client sees is a
+    prefix-complete copy of the write order (the batch ``watch``
+    identity contract).  A slow client's full queue drops for THAT
+    client only (counted) — delivery never blocks the detector path."""
+
+    def __init__(self, max_backlog: int = 256, max_queue: int = 1024):
+        self._lock = threading.Lock()
+        self._clients: List[queue.Queue] = []
+        self._backlog: deque = deque(maxlen=max_backlog)
+        self._max_queue = max_queue
+        self.published = 0
+        self.dropped = 0
+
+    def publish(self, rec: dict) -> None:
+        with self._lock:
+            self.published += 1
+            self._backlog.append(rec)
+            for q in self._clients:
+                try:
+                    q.put_nowait(rec)
+                except queue.Full:
+                    self.dropped += 1
+
+    def attach(self) -> Tuple[List[dict], queue.Queue]:
+        """Join: returns (backlog so far, this client's live queue)."""
+        q: queue.Queue = queue.Queue(maxsize=self._max_queue)
+        with self._lock:
+            backlog = list(self._backlog)
+            self._clients.append(q)
+        return backlog, q
+
+    def detach(self, q: queue.Queue) -> None:
+        with self._lock:
+            if q in self._clients:
+                self._clients.remove(q)
+
+    @property
+    def clients(self) -> int:
+        with self._lock:
+            return len(self._clients)
+
+
+class _HistoryTee:
+    """History writes from daemon threads: sqlite connections are
+    thread-affine, so each append opens (and closes) its own
+    :class:`HistoryStore` — alerts are rare, a per-row open is noise."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def append(self, kind: str, **kw) -> None:
+        from gpuschedule_tpu.obs.history import HistoryStore
+
+        with HistoryStore(self.path) as store:
+            store.append(kind, **kw)
+
+
+def _normalize_queries(payload) -> List[dict]:
+    """The POST /whatif body grammar: ``{"queries": [...]}``, one bare
+    query object, or a bare list.  Raises ValueError on anything else —
+    the edge turns that into HTTP 400."""
+    if isinstance(payload, dict):
+        if "queries" in payload:
+            payload = payload["queries"]
+        elif "kind" in payload:
+            payload = [payload]
+        else:
+            raise ValueError(
+                'POST /whatif wants {"queries": [...]}, one query '
+                "object, or a list of query objects"
+            )
+    if not isinstance(payload, list) or not payload:
+        raise ValueError("POST /whatif needs at least one query")
+    for q in payload:
+        if not isinstance(q, dict):
+            raise ValueError(f"query must be an object, got {type(q).__name__}")
+    # wire-format numeric coercion: the echoed query is part of the
+    # served document's byte-identity surface
+    return [normalize_query(q) for q in payload]
+
+
+# --------------------------------------------------------------------- #
+# the daemon
+
+
+class TwinServer:
+    """The serving daemon: one warm :class:`WhatIfService`, one
+    :class:`Watcher` over an event stream (optional), one
+    :class:`SelfSLO` watchdog over its own serving series, one HTTP
+    front end.  Construct, :meth:`start`, wait, :meth:`shutdown`."""
+
+    def __init__(
+        self,
+        service: WhatIfService,
+        *,
+        registry: MetricsRegistry,
+        requested_at: float,
+        run_meta: dict,
+        events=None,
+        mode: str = "batch",
+        rules: Optional[dict] = None,
+        self_slo: Optional[dict] = None,
+        alerts_path=None,
+        history=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        speed: float = 0.0,
+        poll_s: float = 0.5,
+        idle_timeout_s: Optional[float] = None,
+        max_wall_s: Optional[float] = None,
+        sse_keepalive_s: float = 15.0,
+        drain_s: float = 10.0,
+    ):
+        if mode not in ("batch", "replay", "follow"):
+            raise ValueError(
+                f"serve mode must be batch|replay|follow, got {mode!r}"
+            )
+        self.service = service
+        self.registry = registry
+        self.requested_at = float(requested_at)
+        self.run_meta = dict(run_meta)
+        self.host = host
+        self.port = int(port)
+        self.mode = mode
+        self.sse_keepalive_s = float(sse_keepalive_s)
+        self.drain_s = float(drain_s)
+        self._events = events
+        self._speed = float(speed)
+        self._poll_s = float(poll_s)
+        self._idle_timeout_s = idle_timeout_s
+        self._max_wall_s = max_wall_s
+
+        self.hub = AlertHub()
+        tee = _HistoryTee(history) if history is not None else None
+        self._history = tee
+        # ONE alert side stream for cluster and self alerts alike — the
+        # hub subscribes as a pluggable sink, so SSE clients see exactly
+        # the sequence the file tee records
+        self.sink = AlertStream(alerts_path)
+        self.sink.subscribe(self._on_alert_rec)
+        self.watcher: Optional[Watcher] = None
+        if events is not None:
+            self.watcher = Watcher(
+                rules, alerts=self.sink, registry=registry,
+                history=tee, source=str(events),
+            )
+        self.self_slo = SelfSLO(
+            self_slo, sink=self.sink, registry=registry,
+            history=tee, run_meta=self.run_meta,
+        )
+
+        # the serving registry's families exist from the first scrape,
+        # not the first incident: pre-register the rejection counter and
+        # the pool lifecycle counters (idempotent with the pool's own
+        # registration), and arm the process self-gauges
+        registry.counter(
+            "whatif_rejected_total",
+            "what-if queries refused by admission control "
+            "(in-flight queue full)",
+        )
+        registry.counter(
+            "pool_worker_respawns_total",
+            "dead pool workers respawned (and re-warmed)",
+        )
+        registry.counter(
+            "pool_task_retries_total",
+            "pool task attempts retried after a crash or exception",
+        )
+        self._inflight_gauge = registry.gauge(
+            "pool_inflight", "admitted what-if queries in flight right now"
+        )
+        self._update_process_gauges = process_gauges(registry)
+
+        self.errors = 0
+        self._latencies: List[float] = []
+        self._lat_lock = threading.Lock()
+        self._slo_lock = threading.Lock()
+        self._watch_lock = threading.Lock()
+        self._stopping = threading.Event()
+        self._ready = threading.Event()
+        self._stream_done = threading.Event()
+        self.stream_error: Optional[str] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._watch_thread: Optional[threading.Thread] = None
+        self._finished = False
+        self._summary: Optional[dict] = None
+        # uptime anchor for /status and the serve history row
+        self._t0 = time.monotonic()  # lint: allow[GS101] daemon uptime is wall-clock by design; nothing served derives from it
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+
+    def start(self) -> None:
+        """Bind, start the HTTP and watch threads, mark ready."""
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name="twin-http", daemon=True,
+        )
+        self._http_thread.start()
+        if self.watcher is not None:
+            self._watch_thread = threading.Thread(
+                target=self._run_watch, name="twin-watch", daemon=True,
+            )
+            self._watch_thread.start()
+        else:
+            # no stream watcher to emit the side stream's versioned
+            # header lazily — write it now, before any self-SLO alert,
+            # so the alert file keeps the PR-15 audit-trail shape
+            self.sink.write_header({
+                "run_id": self.run_meta.get("run_id", ""),
+                "policy": self.run_meta.get("policy", ""),
+                "seed": self.run_meta.get("seed"),
+                "config_hash": self.run_meta.get("config_hash", ""),
+                "source": "serve",
+            })
+            self._stream_done.set()
+        self._ready.set()
+
+    def _stream(self):
+        if self.mode == "follow":
+            return follow_stream(
+                self._events, poll_s=self._poll_s,
+                idle_timeout_s=self._idle_timeout_s,
+                max_wall_s=self._max_wall_s,
+            )
+        if self.mode == "replay":
+            return replay_stream(self._events, speed=self._speed)
+        return iter_stream(self._events)
+
+    def _run_watch(self) -> None:
+        """The watch thread: drive the watcher over the stream.  The
+        watcher is deliberately NOT finished here — finish() closes the
+        alert file, and the self-SLO watchdog keeps writing into it for
+        as long as the daemon serves; shutdown finishes it."""
+        from gpuschedule_tpu.obs import StreamError
+
+        try:
+            for _, raw, rec in self._stream():
+                if self._stopping.is_set():
+                    break
+                with self._watch_lock:
+                    self.watcher.feed(rec, raw)
+        except StreamError as e:
+            self.stream_error = str(e)
+        finally:
+            self._stream_done.set()
+
+    def _on_alert_rec(self, rec: dict) -> None:
+        # the side stream also carries its header record at finish();
+        # SSE clients (and the batch-identity contract) see alerts only
+        if rec.get("event") == "alert":
+            self.hub.publish(rec)
+
+    # ------------------------------------------------------------------ #
+    # the query path
+
+    @property
+    def ready(self) -> bool:
+        return self._ready.is_set() and not self._stopping.is_set()
+
+    def serve_whatif(self, payload) -> Tuple[int, dict]:
+        """One POST /whatif: normalize, pre-validate (deterministic user
+        errors must 400 BEFORE evaluation — a pooled worker would retry
+        them with backoff), admit, evaluate, self-observe.  Returns
+        (HTTP status, response document)."""
+        if not self.ready:
+            return 503, {"error": "service is warming up or draining"}
+        sim = self.service.sim
+        try:
+            queries = _normalize_queries(payload)
+            for q in queries:
+                validate_query(dict(q))
+                at = q.get("at")
+                if at is None:
+                    continue
+                if at < sim.now:
+                    raise ValueError(
+                        f"query at={at} is before the mirror instant "
+                        f"(t={sim.now})"
+                    )
+                if at > min(sim.now + self.service.horizon, sim.max_time):
+                    raise ValueError(
+                        f"query at={at} is beyond the bounded replay "
+                        f"window (mirror t={sim.now} + horizon "
+                        f"{self.service.horizon})"
+                    )
+        except ValueError as e:
+            self.errors += 1
+            with self._slo_lock:
+                self.self_slo.observe(error=True)
+            return 400, {"error": str(e)}
+        try:
+            with self.service.admitted():
+                results = self.service.evaluate_admitted(queries)
+        except AdmissionError as e:
+            with self._slo_lock:
+                self.self_slo.observe(rejected=True)
+            return 429, {"error": str(e)}
+        except ValueError as e:
+            self.errors += 1
+            with self._slo_lock:
+                self.self_slo.observe(error=True)
+            return 400, {"error": str(e)}
+        doc = result_document(
+            sim, results,
+            requested_at=self.requested_at,
+            horizon=self.service.horizon,
+            pool=self.service.workers,
+            run_meta=self.run_meta,
+        )
+        lats = [1000.0 * r["latency_s"] for r in results]
+        with self._lat_lock:
+            self._latencies.extend(lats)
+        with self._slo_lock:
+            for ms in lats:
+                self.self_slo.observe(ms)
+        return 200, doc
+
+    # ------------------------------------------------------------------ #
+    # status / metrics
+
+    def refresh_gauges(self) -> None:
+        self._inflight_gauge.set(float(self.service.inflight))
+        self._update_process_gauges()
+
+    def _latency_block(self) -> dict:
+        with self._lat_lock:
+            lats = sorted(self._latencies)
+        if not lats:
+            return {"count": 0}
+        return {
+            "count": len(lats),
+            "p50_ms": exact_quantile(lats, 0.50),
+            "p99_ms": exact_quantile(lats, 0.99),
+            "max_ms": lats[-1],
+        }
+
+    def status(self) -> dict:
+        svc = self.service
+        pool = dict(svc.pool_stats())
+        pool["max_inflight"] = svc.max_inflight
+        pool["inflight"] = svc.inflight
+        watch = None
+        if self.watcher is not None:
+            with self._watch_lock:
+                w = self.watcher
+                watch = {
+                    "source": w.source,
+                    "events": w.n_events,
+                    "end_t": w.end_t,
+                    "windows": w.windows,
+                    "alerts": len(w.alerts),
+                    "active": sorted(w._active_alerts),
+                    "stream_done": self._stream_done.is_set(),
+                }
+                if self.stream_error:
+                    watch["stream_error"] = self.stream_error
+        with self._slo_lock:
+            self_slo = {
+                "observations": self.self_slo.observations,
+                "windows": self.self_slo.windows,
+                "alerts": len(self.self_slo.alerts),
+                "active": self.self_slo.active,
+            }
+        return {
+            "server": SERVER_NAME,
+            "ready": self.ready,
+            "stopping": self._stopping.is_set(),
+            "mode": self.mode,
+            "uptime_s": time.monotonic() - self._t0,  # lint: allow[GS101] same daemon-uptime surface as the anchor above
+            "run": {
+                "run_id": self.run_meta.get("run_id", ""),
+                "policy": self.run_meta.get("policy", ""),
+                "config_hash": self.run_meta.get("config_hash", ""),
+                "seed": self.run_meta.get("seed"),
+            },
+            "mirror": {
+                "at_s": svc.sim.now,
+                "requested_at_s": self.requested_at,
+                "horizon_s": svc.horizon,
+                "running": len(svc.sim.running),
+                "pending": len(svc.sim.pending),
+                "finished": len(svc.sim.finished),
+            },
+            "pool": pool,
+            "queries": {
+                "served": svc.queries_served,
+                "rejections": svc.rejections,
+                "errors": self.errors,
+                "latency_ms": self._latency_block(),
+            },
+            "watch": watch,
+            "self_slo": self_slo,
+            "alerts": {
+                "total": self.hub.published,
+                "dropped": self.hub.dropped,
+                "sse_clients": self.hub.clients,
+            },
+        }
+
+    # ------------------------------------------------------------------ #
+    # shutdown
+
+    def shutdown(self) -> dict:
+        """Graceful stop: refuse new work, drain in-flight queries up to
+        ``drain_s``, stop HTTP, finish the watcher (header + alert-file
+        flush), close the pool, append the ``serve`` history row.
+        Idempotent; returns the session summary."""
+        if self._summary is not None:
+            return self._summary
+        self._stopping.set()
+        deadline = time.monotonic() + self.drain_s  # lint: allow[GS101] drain deadline is a wall-clock budget at the edge; served bytes never depend on it
+        while self.service.inflight > 0 and \
+                time.monotonic() < deadline:  # lint: allow[GS101] same drain-deadline surface as above
+            time.sleep(0.02)
+        drained = self.service.inflight == 0
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=5.0)
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=max(1.0, 2 * self._poll_s))
+        pool_stats = self.service.pool_stats()
+        with self._watch_lock:
+            watch_summary = None
+            if self.watcher is not None and not self._finished:
+                watch_summary = self.watcher.finish()
+            elif self.watcher is None and not self._finished:
+                self.sink.close()
+            self._finished = True
+        self.service.close()
+        lat = self._latency_block()
+        uptime = time.monotonic() - self._t0  # lint: allow[GS101] same daemon-uptime surface as the anchor above
+        metrics = {
+            "queries": self.service.queries_served,
+            "rejections": self.service.rejections,
+            "errors": self.errors,
+            "alerts": self.hub.published,
+            "self_slo_alerts": len(self.self_slo.alerts),
+            "p50_ms": lat.get("p50_ms", 0.0),
+            "p99_ms": lat.get("p99_ms", 0.0),
+            "uptime_s": uptime,
+            "drained": int(drained),
+        }
+        if self._history is not None:
+            self._history.append(
+                "serve",
+                run_id=self.run_meta.get("run_id", ""),
+                config_hash=self.run_meta.get("config_hash", ""),
+                policy=self.run_meta.get("policy", ""),
+                seed=self.run_meta.get("seed"),
+                label="session",
+                metrics=metrics,
+            )
+        self._summary = {
+            "host": self.host, "port": self.port, "mode": self.mode,
+            **metrics,
+        }
+        if watch_summary is not None:
+            self._summary["watch"] = watch_summary
+        return self._summary
+
+
+def install_signal_handlers(server: TwinServer) -> threading.Event:
+    """SIGTERM/SIGINT → one stop event (main thread waits on it, then
+    runs :meth:`TwinServer.shutdown`).  A second signal during the drain
+    still only sets the event — shutdown itself is idempotent."""
+    stop = threading.Event()
+
+    def _handler(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _handler)
+    signal.signal(signal.SIGINT, _handler)
+    return stop
+
+
+# --------------------------------------------------------------------- #
+# the HTTP edge
+
+
+def _make_handler(server: TwinServer):
+    """One handler class bound to one :class:`TwinServer` (closure, not
+    globals — tests run several daemons in one process)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        server_version = SERVER_NAME
+        protocol_version = "HTTP/1.1"
+
+        # ------------------------------------------------------------- #
+
+        def _send(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_json(self, code: int, doc: dict) -> None:
+            body = (json.dumps(doc, sort_keys=True) + "\n").encode("utf-8")
+            self._send(code, body, "application/json; charset=utf-8")
+
+        def log_message(self, fmt, *args):  # quiet: the daemon's own
+            pass                            # telemetry is the log
+
+        # ------------------------------------------------------------- #
+
+        def do_GET(self) -> None:
+            path = self.path.split("?", 1)[0]
+            if path == "/metrics":
+                server.refresh_gauges()
+                body, ctype = exposition(server.registry)
+                self._send(200, body, ctype)
+            elif path == "/status":
+                self._send_json(200, server.status())
+            elif path == "/healthz":
+                self._send(200, b"ok\n", "text/plain; charset=utf-8")
+            elif path == "/readyz":
+                if server.ready:
+                    self._send(200, b"ready\n", "text/plain; charset=utf-8")
+                else:
+                    self._send_json(503, {"error": "not ready"})
+            elif path == "/alerts":
+                self._serve_sse()
+            elif path == "/":
+                self._send(
+                    200, dashboard_html().encode("utf-8"),
+                    "text/html; charset=utf-8",
+                )
+            else:
+                self._send_json(404, {"error": f"no route {path}"})
+
+        def do_POST(self) -> None:
+            path = self.path.split("?", 1)[0]
+            if path != "/whatif":
+                self._send_json(404, {"error": f"no route {path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+                payload = json.loads(self.rfile.read(n) or b"null")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send_json(400, {"error": f"bad JSON body: {e}"})
+                return
+            code, doc = server.serve_whatif(payload)
+            self._send_json(code, doc)
+
+        # ------------------------------------------------------------- #
+
+        def _serve_sse(self) -> None:
+            """The alert feed: backlog replay, then live records as the
+            hub delivers them, keepalive comments in the gaps.  Frame
+            payloads are ``json.dumps(rec, sort_keys=True)`` — the exact
+            bytes batch ``watch`` prints per alert (the identity
+            contract, tests/test_serve.py)."""
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            backlog, q = server.hub.attach()
+            try:
+                for rec in backlog:
+                    self._sse_frame(rec)
+                while not server._stopping.is_set():
+                    try:
+                        rec = q.get(timeout=server.sse_keepalive_s)
+                    except queue.Empty:
+                        self.wfile.write(b": keepalive\n\n")
+                        self.wfile.flush()
+                        continue
+                    self._sse_frame(rec)
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
+            finally:
+                server.hub.detach(q)
+
+        def _sse_frame(self, rec: dict) -> None:
+            data = json.dumps(rec, sort_keys=True)
+            self.wfile.write(
+                f"event: alert\ndata: {data}\n\n".encode("utf-8")
+            )
+            self.wfile.flush()
+
+    return Handler
+
+
+# --------------------------------------------------------------------- #
+# the dashboard
+
+
+def dashboard_html() -> str:
+    """GET /: a self-contained live page — status tiles polled from
+    ``/status``, the alert feed via ``EventSource('/alerts')`` — in the
+    report surface's palette (obs/report.py), light and dark."""
+    return _DASHBOARD
+
+
+_DASHBOARD = """<!doctype html>
+<html><head><meta charset="utf-8">
+<title>gpuschedule twin</title>
+<style>
+:root { color-scheme: light dark; }
+body {
+  margin: 0; padding: 24px;
+  font-family: system-ui, -apple-system, "Segoe UI", sans-serif;
+  background: var(--page); color: var(--text-primary);
+}
+.viz-root {
+  --surface-1: #fcfcfb; --page: #f9f9f7;
+  --text-primary: #0b0b0b; --text-secondary: #52514e; --muted: #898781;
+  --grid: #e1e0d9; --baseline: #c3c2b7;
+  --series-1: #2a78d6; --series-2: #eb6834; --series-3: #1baf7a;
+  --series-4: #9556c7; --series-5: #c23f87;
+  --border: rgba(11,11,11,0.10);
+}
+@media (prefers-color-scheme: dark) {
+  :root:where(:not([data-theme="light"])) .viz-root {
+    --surface-1: #1a1a19; --page: #0d0d0d;
+    --text-primary: #ffffff; --text-secondary: #c3c2b7; --muted: #898781;
+    --grid: #2c2c2a; --baseline: #383835;
+    --series-1: #3987e5; --series-2: #d95926; --series-3: #199e70;
+    --series-4: #a365d6; --series-5: #d052a0;
+    --border: rgba(255,255,255,0.10);
+  }
+}
+body { background: var(--page); }
+h1 { font-size: 20px; margin: 0 0 4px; }
+h2 { font-size: 15px; margin: 24px 0 8px; }
+.meta { color: var(--text-secondary); font-size: 13px; margin-bottom: 16px; }
+.kpis { display: flex; flex-wrap: wrap; gap: 12px; }
+.tile {
+  background: var(--surface-1); border: 1px solid var(--border);
+  border-radius: 8px; padding: 12px 16px; min-width: 130px; flex: 1;
+}
+.tile .label { font-size: 12px; color: var(--text-secondary); }
+.tile .value { font-size: 26px; font-weight: 600; margin-top: 2px;
+  font-variant-numeric: tabular-nums; }
+.panel {
+  background: var(--surface-1); border: 1px solid var(--border);
+  border-radius: 8px; padding: 16px; margin-bottom: 16px;
+}
+#alerts { font-size: 13px; font-family: ui-monospace, monospace;
+  white-space: pre-wrap; max-height: 320px; overflow-y: auto; }
+#alerts .page { color: var(--series-2); }
+.empty { color: var(--muted); font-size: 13px; }
+</style></head>
+<body><div class="viz-root">
+<h1>gpuschedule twin</h1>
+<div class="meta" id="meta">connecting&hellip;</div>
+<div class="kpis">
+  <div class="tile"><div class="label">queries served</div>
+    <div class="value" id="k-served">&ndash;</div></div>
+  <div class="tile"><div class="label">rejections (429)</div>
+    <div class="value" id="k-rej">&ndash;</div></div>
+  <div class="tile"><div class="label">p50 / p99 latency (ms)</div>
+    <div class="value" id="k-lat">&ndash;</div></div>
+  <div class="tile"><div class="label">pool (workers / in flight)</div>
+    <div class="value" id="k-pool">&ndash;</div></div>
+  <div class="tile"><div class="label">alerts</div>
+    <div class="value" id="k-alerts">&ndash;</div></div>
+</div>
+<h2>alert feed</h2>
+<div class="panel"><div id="alerts" class="empty">no alerts yet</div></div>
+<script>
+function fmt(v, d) { return v == null ? "\\u2013" : Number(v).toFixed(d); }
+async function poll() {
+  try {
+    const s = await (await fetch("/status")).json();
+    document.getElementById("meta").textContent =
+      s.run.run_id + " \\u00b7 " + s.mode + " \\u00b7 mirror t=" +
+      fmt(s.mirror.at_s, 0) + "s \\u00b7 up " + fmt(s.uptime_s, 0) + "s" +
+      (s.ready ? "" : " \\u00b7 NOT READY");
+    document.getElementById("k-served").textContent = s.queries.served;
+    document.getElementById("k-rej").textContent = s.queries.rejections;
+    const l = s.queries.latency_ms;
+    document.getElementById("k-lat").textContent =
+      l.count ? fmt(l.p50_ms, 1) + " / " + fmt(l.p99_ms, 1) : "\\u2013";
+    document.getElementById("k-pool").textContent =
+      s.pool.workers + " / " + s.pool.inflight;
+    document.getElementById("k-alerts").textContent = s.alerts.total;
+  } catch (e) { /* daemon draining */ }
+}
+poll(); setInterval(poll, 2000);
+const box = document.getElementById("alerts");
+new EventSource("/alerts").addEventListener("alert", (ev) => {
+  const a = JSON.parse(ev.data);
+  if (box.classList.contains("empty")) {
+    box.textContent = ""; box.classList.remove("empty");
+  }
+  const line = document.createElement("div");
+  line.className = a.severity || "";
+  line.textContent =
+    "t=" + a.t + " " + a.detector + " [" + a.severity + "] value=" +
+    fmt(a.value, 3) + " threshold=" + fmt(a.threshold, 3);
+  box.prepend(line);
+});
+</script>
+</div></body></html>
+"""

@@ -209,16 +209,28 @@ class AlertStream:
     (``{"t", "event", ...}``) behind their OWN versioned header
     (``{"schema": ALERTS_SCHEMA, "stream": "alerts", ...}``), flushed
     per record (alerts are rare and a tailing pager must see them now).
-    With no path, records are only collected in memory."""
+    With no path, records are only collected in memory.
 
-    def __init__(self, path=None):
+    **Pluggable sinks** (ISSUE 18): :meth:`subscribe` registers an
+    in-memory callback invoked with every record the instant it is
+    written — the serving daemon's SSE fan-out attaches here and sees
+    exactly the record sequence the file tee would, without a file tee.
+    Sinks are delivery only: they must not mutate the record, and the
+    written sequence never depends on who is subscribed."""
+
+    def __init__(self, path=None, *, sinks=()):
         self.records: List[dict] = []
+        self._sinks = list(sinks)
         self._fh = None
         if path is not None:
             p = Path(path)
             if p.parent and not p.parent.exists():
                 p.parent.mkdir(parents=True, exist_ok=True)
             self._fh = open(p, "w")
+
+    def subscribe(self, sink) -> None:
+        """Attach one callback (``sink(rec)``) to every future write."""
+        self._sinks.append(sink)
 
     def write_header(self, meta: dict) -> None:
         self._write({"schema": ALERTS_SCHEMA, "stream": "alerts", **meta})
@@ -239,6 +251,8 @@ class AlertStream:
         if self._fh is not None:
             self._fh.write(json.dumps(rec) + "\n")
             self._fh.flush()
+        for sink in self._sinks:
+            sink(rec)
 
     def close(self) -> None:
         if self._fh is not None:
@@ -866,6 +880,191 @@ class Watcher:
             "config_hash": h.config_hash if h else "",
             "rules_hash": rules_digest(self.rules),
         }
+
+
+# --------------------------------------------------------------------- #
+# the self-SLO watchdog (ISSUE 18): the burn-rate machinery pointed at
+# the twin's own serving telemetry
+
+
+# The serving daemon's own SLO (the "observer observes itself" half of
+# ISSUE 18).  Windows are counted in *observations* (served queries,
+# rejections, errors), not wall or sim time: the alert sequence is then
+# a pure function of the observation sequence — the same determinism
+# contract the stream detectors keep, with the observation index as the
+# clock.
+SELF_SLO_DEFAULTS: dict = {
+    # a query slower than this breaches the latency SLO
+    "latency_slo_ms": 500.0,
+    # the availability target the error budget derives from
+    "target": 0.95,
+    # fast/slow burn multiples, à la SRE multi-window alerting (the
+    # same knobs the stream slo-burn detector uses)
+    "fast_burn": 10.0,
+    "slow_burn": 2.0,
+    # observations per window / trailing windows in the slow horizon
+    "window_queries": 20,
+    "slow_windows": 12,
+}
+
+
+class SelfSLO:
+    """Multi-window burn-rate watchdog over the serving daemon's OWN
+    latency / rejection / error series (ISSUE 18): the PR-15 slo-burn
+    arithmetic — error-budget burn over the last window AND over a
+    trailing slow horizon, latched on the rising edge — pointed at the
+    twin itself, so the daemon pages about its own degradation through
+    the exact same surfaces cluster incidents use: the alert side
+    stream (``sink``), the ``watch_alerts_total{detector}`` family, and
+    one history row (kind ``watch``, label ``self-slo-burn``).
+
+    An observation breaches when it was a rejection (admission queue
+    full) or an error, or when its latency exceeds ``latency_slo_ms``.
+    Every ``window_queries`` observations the window closes:
+    ``fast = breached/total/budget`` over the window, ``slow`` over the
+    trailing ``slow_windows`` windows, and the alert fires when both
+    exceed their burn thresholds — a blip neither pages nor hides a
+    slow leak, exactly like the stream detector.  ``t`` on a self alert
+    is the observation index (this watchdog's clock); the window length
+    rides the schema-additive ``window_queries`` key."""
+
+    detector = "self-slo-burn"
+
+    def __init__(
+        self,
+        cfg: Optional[dict] = None,
+        *,
+        sink: AlertStream,
+        registry=None,
+        history=None,
+        run_meta: Optional[dict] = None,
+    ):
+        self.cfg = dict(SELF_SLO_DEFAULTS)
+        unknown = sorted(set(cfg or ()) - set(SELF_SLO_DEFAULTS))
+        if unknown:
+            raise ValueError(
+                f"unknown self-SLO keys {unknown}; "
+                f"known: {sorted(SELF_SLO_DEFAULTS)}"
+            )
+        for k, v in (cfg or {}).items():
+            self.cfg[k] = (
+                int(v) if k in ("window_queries", "slow_windows")
+                else float(v)
+            )
+        if self.cfg["window_queries"] < 1:
+            raise ValueError(
+                f"self-SLO window_queries must be >= 1, "
+                f"got {self.cfg['window_queries']}"
+            )
+        if self.cfg["slow_windows"] < 1:
+            raise ValueError(
+                f"self-SLO slow_windows must be >= 1, "
+                f"got {self.cfg['slow_windows']}"
+            )
+        if not 0.0 <= self.cfg["target"] < 1.0:
+            raise ValueError(
+                f"self-SLO target must be in [0, 1), got {self.cfg['target']}"
+            )
+        self.sink = sink
+        self._reg_alerts = None
+        if registry is not None:
+            self._reg_alerts = registry.counter(
+                "watch_alerts_total",
+                "watchtower detections by detector (ISSUE 15)",
+                labelnames=("detector",),
+            )
+        self._history = history
+        self._meta = dict(run_meta or {})
+        self.observations = 0
+        self.windows = 0
+        self.alerts: List[dict] = []
+        self.active = False
+        self._seq = 0
+        self._n = 0            # observations in the open window
+        self._breached = 0
+        self._rej = 0          # rejection/error breaches (window)
+        self._lat = 0          # latency breaches (window)
+        self._hist: deque = deque(maxlen=int(self.cfg["slow_windows"]))
+
+    def observe(
+        self,
+        latency_ms: Optional[float] = None,
+        *,
+        rejected: bool = False,
+        error: bool = False,
+    ) -> List[dict]:
+        """Absorb one serving observation; returns the alerts fired by
+        any window it closed (possibly empty)."""
+        self.observations += 1
+        self._n += 1
+        if rejected or error:
+            self._breached += 1
+            self._rej += 1
+        elif latency_ms is not None and \
+                latency_ms > self.cfg["latency_slo_ms"]:
+            self._breached += 1
+            self._lat += 1
+        if self._n >= int(self.cfg["window_queries"]):
+            return self._close_window()
+        return []
+
+    def _close_window(self) -> List[dict]:
+        self.windows += 1
+        budget = max(1e-9, 1.0 - self.cfg["target"])
+        fast = self._breached / self._n / budget
+        self._hist.append((self._n, self._breached))
+        slow_total = sum(n for n, _ in self._hist)
+        slow_breached = sum(b for _, b in self._hist)
+        slow = (slow_breached / slow_total / budget) if slow_total else 0.0
+        cond = fast >= self.cfg["fast_burn"] and slow >= self.cfg["slow_burn"]
+        out: List[dict] = []
+        if cond and not self.active:
+            self.active = True
+            self._seq += 1
+            # blame the dominant breach mode: saturation (rejections /
+            # errors) vs slow serving — the serving twin's two legs
+            legs: Dict[str, float] = {}
+            if self._rej:
+                legs["serve-rejection"] = float(self._rej)
+            if self._lat:
+                legs["serve-latency"] = float(self._lat)
+            cause = (
+                "serve-rejection" if self._rej >= self._lat and self._rej
+                else "serve-latency"
+            )
+            alert = self.sink.event(
+                "alert", float(self.observations), None,
+                detector=self.detector, severity="page",
+                window_queries=int(self.cfg["window_queries"]),
+                value=fast, threshold=self.cfg["fast_burn"],
+                baseline=slow, cause=cause,
+                legs={k: legs[k] for k in sorted(legs)},
+                seq=self._seq,
+            )
+            self.alerts.append(alert)
+            if self._reg_alerts is not None:
+                self._reg_alerts.labels(self.detector).inc()
+            if self._history is not None:
+                self._history.append(
+                    "watch",
+                    run_id=self._meta.get("run_id", ""),
+                    config_hash=self._meta.get("config_hash", ""),
+                    policy=self._meta.get("policy", ""),
+                    seed=self._meta.get("seed"),
+                    label=self.detector,
+                    metrics={
+                        "t": float(self.observations), "value": fast,
+                        "threshold": self.cfg["fast_burn"],
+                        "window_queries": int(self.cfg["window_queries"]),
+                        "severity": "page", "cause": cause,
+                        "seq": self._seq,
+                    },
+                )
+            out.append(alert)
+        elif not cond:
+            self.active = False  # re-arm only after a clean window
+        self._n = self._breached = self._rej = self._lat = 0
+        return out
 
 
 # --------------------------------------------------------------------- #

@@ -39,7 +39,10 @@ itself are one ``history trend`` away.
 
 from __future__ import annotations
 
+import contextlib
+import copy
 import math
+import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -51,6 +54,12 @@ from gpuschedule_tpu.obs.fleet import (
 from gpuschedule_tpu.obs.tracer import NULL_SPAN as _NULL_SPAN
 
 QUERY_KINDS = ("admit", "drain", "policy-swap")
+
+
+class AdmissionError(RuntimeError):
+    """Raised by :meth:`WhatIfService.admitted` when the bounded
+    in-flight queue is full — the serving layer's backpressure signal
+    (HTTP 429 at the edge, ISSUE 18)."""
 
 
 # --------------------------------------------------------------------- #
@@ -110,6 +119,34 @@ def validate_query(q: dict) -> dict:
     elif kind == "policy-swap":
         if not q.get("policy"):
             raise ValueError("policy-swap query needs a policy name")
+    return q
+
+
+def normalize_query(q: dict) -> dict:
+    """Coerce a wire-format query's numeric fields to the exact types
+    the CLI spec parsers produce (chips int, duration/at float, scope
+    members int), so a served result document — which echoes the query —
+    never depends on whether the asker sent ``3600`` or ``3600.0``
+    (ISSUE 18: the echo is part of the byte-identity surface)."""
+    q = dict(q)
+    kind = q.get("kind")
+    if kind == "admit":
+        if "chips" in q:
+            q["chips"] = int(q["chips"])
+        if "duration" in q:
+            q["duration"] = float(q["duration"])
+        if q.get("pod") is not None:
+            q["pod"] = int(q["pod"])
+        if q.get("at") is not None:
+            q["at"] = float(q["at"])
+    elif kind == "drain":
+        scope = q.get("scope")
+        if isinstance(scope, (list, tuple)) and scope:
+            q["scope"] = [scope[0], *(int(s) for s in scope[1:])]
+        if q.get("at") is not None:
+            q["at"] = float(q["at"])
+        if q.get("duration") is not None:
+            q["duration"] = float(q["duration"])
     return q
 
 
@@ -300,6 +337,7 @@ class WhatIfService:
         fleet=None,
         max_retries: int = 2,
         backoff_s: float = 1.0,
+        max_inflight: Optional[int] = None,
     ):
         if not horizon > 0.0:
             raise ValueError(f"horizon must be > 0, got {horizon}")
@@ -307,6 +345,22 @@ class WhatIfService:
         self.horizon = float(horizon)
         self.queries_served = 0
         self.workers = int(workers) if workers and workers >= 1 else 0
+        # admission control (ISSUE 18): the serving daemon bounds
+        # concurrent askers to the pool's real capacity — default twice
+        # the evaluator count (one in flight, one queued behind it)
+        if max_inflight is None:
+            max_inflight = 2 * max(1, self.workers)
+        self.max_inflight = int(max_inflight)
+        if self.max_inflight < 1:
+            raise ValueError(
+                f"max_inflight must be >= 1, got {self.max_inflight}"
+            )
+        self.rejections = 0
+        self._inflight = 0
+        self._adm_lock = threading.Lock()
+        self._dispatch_lock = threading.Lock()
+        self._registry = registry
+        self._rejected_counter = None
         self._fleet = fleet
         self._latency = None
         if registry is not None:
@@ -414,12 +468,61 @@ class WhatIfService:
                     )
         return out
 
-    def pool_stats(self) -> Optional[dict]:
-        """Pool-lifecycle summary for the history "pool" row (``None``
-        when evaluating in-process): worker count plus the respawn /
-        retry totals the pool counted across this service's queries."""
+    # ------------------------------------------------------------------ #
+    # bounded admission (ISSUE 18): backpressure for concurrent askers
+
+    @property
+    def inflight(self) -> int:
+        """Admitted-but-unfinished queries right now."""
+        return self._inflight
+
+    @contextlib.contextmanager
+    def admitted(self):
+        """Hold one slot in the bounded in-flight queue for the duration
+        of the block; raises :class:`AdmissionError` (and counts the
+        rejection into ``whatif_rejected_total``) when all
+        ``max_inflight`` slots are taken.  Non-blocking by design — the
+        serving edge turns the refusal into HTTP 429 rather than letting
+        askers pile up behind a saturated pool."""
+        with self._adm_lock:
+            if self._inflight >= self.max_inflight:
+                self.rejections += 1
+                if self._registry is not None:
+                    if self._rejected_counter is None:
+                        self._rejected_counter = self._registry.counter(
+                            "whatif_rejected_total",
+                            "what-if queries refused by admission "
+                            "control (in-flight queue full)",
+                        )
+                    self._rejected_counter.inc()
+                raise AdmissionError(
+                    f"what-if admission queue full "
+                    f"({self.max_inflight} in flight); retry later"
+                )
+            self._inflight += 1
+        try:
+            yield self
+        finally:
+            with self._adm_lock:
+                self._inflight -= 1
+
+    def evaluate_admitted(self, queries: Sequence[dict]) -> List[dict]:
+        """:meth:`evaluate` made safe for concurrent callers: dispatch is
+        serialized under one lock (the pool map and the engine's
+        in-process forks are not reentrant), and the bounded admission
+        gate upstream keeps the wait behind it short by construction."""
+        with self._dispatch_lock:
+            return self.evaluate(queries)
+
+    def pool_stats(self) -> dict:
+        """Pool-lifecycle summary for the history "pool" row and the
+        serving ``/status`` page: worker count plus the respawn / retry
+        totals the pool counted across this service's queries.  In
+        serial mode (``workers=0``) there is no pool to crash, so the
+        counters read an honest zero rather than a blank (ISSUE 18
+        satellite — ``/status`` never blanks for workers=0)."""
         if self._pool is None:
-            return None
+            return {"workers": self.workers, "respawns": 0, "retries": 0}
         return {
             "workers": self.workers,
             "respawns": self._pool.respawns,
@@ -510,6 +613,49 @@ def parse_drain_spec(spec: str) -> dict:
 
 # --------------------------------------------------------------------- #
 # observability plumbing
+
+
+def result_document(sim, results: Sequence[dict], *,
+                    requested_at: float, horizon: float, pool: int,
+                    run_meta: dict) -> dict:
+    """The what-if answer document — factored out of the ``whatif`` CLI
+    so the serving daemon (ISSUE 18) and the offline command build the
+    SAME structure from the same parts: mirror identity + position, the
+    latency summary, and the ordered per-query delta docs.  Byte
+    identity between the two paths (modulo the wall-clock latency
+    readings — see :func:`canonical_document`) is pinned by
+    tests/test_serve.py."""
+    from gpuschedule_tpu.faults.sweep import jsonable
+
+    return jsonable({
+        "at_s": sim.now,
+        "requested_at_s": requested_at,
+        "horizon_s": horizon,
+        "pool": pool,
+        "policy": run_meta["policy"],
+        "run_id": run_meta["run_id"],
+        "config_hash": run_meta["config_hash"],
+        "mirror": {
+            "running": len(sim.running),
+            "pending": len(sim.pending),
+            "finished": len(sim.finished),
+        },
+        "latency_ms": latency_summary(results),
+        "queries": results,
+    })
+
+
+def canonical_document(doc: dict) -> dict:
+    """The wall-clock-free projection of a result document: every field
+    is a pure function of (world, mirror instant, queries) EXCEPT the
+    latency readings, which are measurements of this host right now.
+    Dropping them (the summary keeps its ``count``) leaves the byte
+    surface the served-vs-offline identity contract compares."""
+    out = copy.deepcopy(doc)
+    out["latency_ms"] = {"count": out["latency_ms"]["count"]}
+    for q in out["queries"]:
+        q.pop("latency_s", None)
+    return out
 
 
 def latency_summary(results: Sequence[dict]) -> dict:

@@ -120,6 +120,33 @@ UNHASHED = {
     "trace_out": "merged fleet-trace export is derived telemetry; "
                  "disarmed and armed runs are byte-identical "
                  "(ISSUE 16 pinned)",
+    # -- serve-only daemon flags (ISSUE 18): the HTTP edge over the
+    #    mirrored world — where it listens and how it drains never
+    #    touch which world it serves (served-vs-offline byte identity
+    #    pinned by tests/test_serve.py) --
+    "host": "listen address is deployment plumbing, not world config",
+    "port": "listen port is deployment plumbing, not world config",
+    "follow": "stream drive mode; the alert sequence is pinned "
+              "identical across batch/replay/follow (ISSUE 15)",
+    "replay": "stream drive mode; alert sequence pinned identical "
+              "across modes (ISSUE 15)",
+    "speed": "replay pacing delays delivery only; alert content is "
+             "keyed to sim time alone",
+    "poll": "follow-mode poll cadence is wall-clock delivery, never "
+            "alert content",
+    "idle_timeout": "follow-mode stop condition, delivery-side only",
+    "max_wall": "wall-clock serving budget, delivery-side only",
+    "rules": "detector thresholds select what to alert on, not which "
+             "world runs; the rules hash rides the alert header",
+    "window": "detector window length, alert-side only (rides the "
+              "alert header's rules hash)",
+    "alerts": "alert side-stream output path only",
+    "max_inflight": "admission-queue depth backpressures askers; "
+                    "served documents are pinned identical to offline",
+    "self_slo": "the daemon's own SLO thresholds watch the server, "
+                "not the world",
+    "drain_s": "shutdown drain budget is wall-clock edge behavior "
+               "only",
 }
 
 

@@ -1,0 +1,536 @@
+"""Serving the twin (ISSUE 18): the HTTP layer must be a veneer.
+
+The contracts under test:
+
+- the served ``POST /whatif`` document is BYTE-IDENTICAL to the offline
+  ``whatif`` CLI on the same world and queries (modulo the wall-clock
+  latency readings — :func:`canonical_document` drops exactly those);
+- the SSE ``GET /alerts`` feed carries exactly the alert sequence batch
+  ``watch`` prints on the same stream, frame payloads byte-for-byte;
+- admission control answers a saturated in-flight queue with HTTP 429
+  and ``whatif_rejected_total`` (never an error, never a queue);
+- the self-SLO watchdog pages about the daemon's own serving series
+  through the same surfaces cluster incidents use (alert stream,
+  ``watch_alerts_total``, history);
+- graceful shutdown drains in-flight queries and appends one
+  ``kind="serve"`` history row;
+- the process self-gauges stay OUT of every offline registry (the
+  satellite-1 byte-compat pin) and ``pool_stats()`` answers honestly in
+  serial mode.
+
+All daemons bind ephemeral ports on 127.0.0.1; everything here is
+tier-1 (the subprocess end-to-end lives in tools/serve_smoke.py behind
+the slow marker).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import http.client
+import json
+import socket
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from gpuschedule_tpu.cli import main
+from gpuschedule_tpu.cluster.tpu import TpuCluster
+from gpuschedule_tpu.obs.history import HistoryStore
+from gpuschedule_tpu.obs.metrics import (
+    MetricsRegistry,
+    PROM_CONTENT_TYPE,
+    process_gauges,
+)
+from gpuschedule_tpu.obs.server import TwinServer
+from gpuschedule_tpu.obs.watch import (
+    AlertStream,
+    Watcher,
+    iter_stream,
+    load_rules,
+    run_watch,
+)
+from gpuschedule_tpu.policies import make_policy
+from gpuschedule_tpu.sim import Simulator
+from gpuschedule_tpu.sim.metrics import MetricsLog
+from gpuschedule_tpu.sim.philly import generate_philly_like_trace
+from gpuschedule_tpu.sim.whatif import WhatIfService, canonical_document
+
+RUN_META = {"run_id": "serve-test", "seed": 11, "policy": "fifo",
+            "config_hash": "x"}
+
+ADMIT = {"kind": "admit", "chips": 8, "duration": 3600}
+
+# the same world flags the whatif CLI smoke pins (tests/test_whatif.py)
+WORLD = [
+    "--synthetic", "12", "--seed", "5", "--cluster", "tpu-v5e",
+    "--dims", "4x4", "--pods", "2", "--policy", "dlas",
+    "--faults", "mtbf=5000,repair=600",
+    "--net", "os=2",
+]
+
+
+# --------------------------------------------------------------------- #
+# harness
+
+
+def _world(jobs=16, seed=11):
+    """A small paused mirror: enough pending/running state to answer
+    queries, cheap enough for tier-1 to spin up per test."""
+    c = TpuCluster("v5e", dims=(4, 4), num_pods=2)
+    trace = generate_philly_like_trace(jobs, seed=seed)
+    ml = MetricsLog(attribution=True, run_meta=dict(RUN_META))
+    return Simulator(c, make_policy("fifo"), trace, metrics=ml,
+                     max_time=400_000.0)
+
+
+@contextlib.contextmanager
+def _serving(**kw):
+    """One started TwinServer over a fresh serial-mode mirror."""
+    max_inflight = kw.pop("max_inflight", None)
+    registry = MetricsRegistry()
+    sim = _world()
+    at = sim.jobs[len(sim.jobs) // 2].submit_time
+    sim.run_until(at)
+    service = WhatIfService(sim, horizon=50_000.0, workers=0,
+                            registry=registry, max_inflight=max_inflight)
+    service.warm()
+    server = TwinServer(
+        service, registry=registry, requested_at=at,
+        run_meta=dict(RUN_META), sse_keepalive_s=0.2,
+        drain_s=kw.pop("drain_s", 5.0), **kw,
+    )
+    server.start()
+    try:
+        yield server
+    finally:
+        server.shutdown()
+
+
+def _get(server, path):
+    c = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    try:
+        c.request("GET", path)
+        r = c.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        c.close()
+
+
+def _post(port, payload, path="/whatif", raw=None):
+    body = raw if raw is not None else json.dumps(payload).encode()
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        c.request("POST", path, body=body,
+                  headers={"Content-Type": "application/json"})
+        r = c.getresponse()
+        return r.status, json.loads(r.read())
+    finally:
+        c.close()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# --------------------------------------------------------------------- #
+# routes / status / metrics
+
+
+def test_routes_status_and_dashboard():
+    with _serving() as server:
+        assert _get(server, "/healthz")[0::2] == (200, b"ok\n")
+        code, _, body = _get(server, "/readyz")
+        assert (code, body) == (200, b"ready\n")
+        code, _, body = _get(server, "/nope")
+        assert code == 404 and b"no route" in body
+        code, headers, body = _get(server, "/")
+        assert code == 200
+        assert headers["Content-Type"].startswith("text/html")
+        # the dashboard reuses the report palette and tails the feed
+        assert b"--page" in body and b"EventSource" in body
+
+        code, _, body = _get(server, "/status")
+        assert code == 200
+        st = json.loads(body)
+        assert st["server"] == "gpuschedule-twin"
+        assert st["ready"] is True and st["stopping"] is False
+        assert st["mode"] == "batch" and st["watch"] is None
+        assert st["run"]["run_id"] == "serve-test"
+        assert st["mirror"]["running"] + st["mirror"]["pending"] > 0
+        assert st["mirror"]["at_s"] <= st["mirror"]["requested_at_s"]
+        assert st["queries"] == {
+            "served": 0, "rejections": 0, "errors": 0,
+            "latency_ms": {"count": 0},
+        }
+        assert st["self_slo"]["observations"] == 0
+
+        # POST grammar edges
+        assert _post(server.port, None, path="/elsewhere")[0] == 404
+        code, doc = _post(server.port, None, raw=b"{nope")
+        assert code == 400 and "bad JSON" in doc["error"]
+        code, doc = _post(server.port, {"no": "kind"})
+        assert code == 400
+
+
+def test_metrics_is_valid_prometheus_text():
+    import re
+
+    with _serving() as server:
+        code, doc = _post(server.port, ADMIT)
+        assert code == 200 and len(doc["queries"]) == 1
+        code, headers, body = _get(server, "/metrics")
+        assert code == 200
+        assert headers["Content-Type"] == PROM_CONTENT_TYPE
+        text = body.decode("utf-8")
+        line_re = re.compile(
+            r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*.*"
+            r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+            r"([-+]?[0-9][0-9.eE+-]*|[-+]?Inf|NaN|nan))$"
+        )
+        for line in text.splitlines():
+            assert line_re.match(line), line
+        # the acceptance families, live from the first scrape
+        assert 'whatif_query_latency_ms_count{kind="admit"} 1' in text
+        assert "whatif_rejected_total 0" in text
+        assert "pool_worker_respawns_total 0" in text
+        assert "pool_task_retries_total 0" in text
+        assert "pool_inflight 0" in text
+        assert "process_uptime_seconds" in text
+        assert "process_rss_bytes" in text
+
+
+def test_serial_pool_stats_and_bounds():
+    with _serving() as server:
+        # ISSUE 18 satellite: serial mode answers pool_stats honestly
+        # instead of None — /status never shows blanks
+        assert server.service.pool_stats() == {
+            "workers": 0, "respawns": 0, "retries": 0,
+        }
+        st = json.loads(_get(server, "/status")[2])
+        assert st["pool"]["workers"] == 0
+        assert st["pool"]["respawns"] == 0
+        assert st["pool"]["retries"] == 0
+        assert st["pool"]["inflight"] == 0
+        assert st["pool"]["max_inflight"] == 2  # 2 * max(1, workers)
+    with pytest.raises(ValueError, match="max_inflight"):
+        WhatIfService(_world(), horizon=1000.0, workers=0, max_inflight=0)
+
+
+# --------------------------------------------------------------------- #
+# the query path: errors, admission control
+
+
+def test_bad_query_is_400_and_counts_as_error():
+    with _serving() as server:
+        past = {"kind": "admit", "chips": 8, "duration": 3600,
+                "at": server.service.sim.now - 1000.0}
+        code, doc = _post(server.port, past)
+        assert code == 400 and "before the mirror instant" in doc["error"]
+        beyond = {"kind": "admit", "chips": 8, "duration": 3600,
+                  "at": server.service.sim.now + 1e9}
+        code, doc = _post(server.port, beyond)
+        assert code == 400 and "beyond the bounded replay" in doc["error"]
+        assert server.errors == 2
+        assert server.service.queries_served == 0
+        # errors are observations too — the watchdog sees user pain
+        assert server.self_slo.observations == 2
+
+
+def test_saturated_queue_is_429_with_counter():
+    with _serving(max_inflight=1) as server:
+        slot = server.service.admitted()
+        slot.__enter__()  # one in-flight query pins the only slot
+        try:
+            assert server.service.inflight == 1
+            code, doc = _post(server.port, ADMIT)
+            assert code == 429
+            assert "admission queue full" in doc["error"]
+            assert server.service.rejections == 1
+            rejected = server.registry.counter("whatif_rejected_total")
+            assert rejected.value == 1.0
+            st = json.loads(_get(server, "/status")[2])
+            assert st["queries"]["rejections"] == 1
+            # a rejection is a breach observation, not an error
+            assert server.self_slo.observations == 1
+            assert server.errors == 0
+        finally:
+            slot.__exit__(None, None, None)
+        # the slot freed: the same query is admitted and answered
+        code, doc = _post(server.port, ADMIT)
+        assert code == 200
+        assert doc["queries"][0]["query"]["kind"] == "admit"
+        assert rejected.value == 1.0  # unchanged
+
+
+# --------------------------------------------------------------------- #
+# SSE identity with batch watch
+
+
+RULES = {
+    "window_s": 100.0,
+    "detectors": {
+        "goodput-collapse": False, "frag-creep": False,
+        "hazard-spike": False, "slo-burn": False,
+        "queue-depth-surge": {"min_pending": 8.0, "surge_factor": 2.0},
+    },
+}
+
+
+def _surge_stream(n=20, window=100.0):
+    recs = [{"schema": 1, "run_id": "w", "seed": 0, "policy": "fifo",
+             "config_hash": "h", "total_chips": 32}]
+    for i in range(n):
+        recs.append({"t": 5.0 * i, "event": "arrival", "job": f"j{i}",
+                     "chips": 8, "duration": 1000.0, "status": "Pass"})
+    recs.append({"t": 4 * window, "event": "arrival", "job": "late",
+                 "chips": 8, "duration": 1000.0, "status": "Pass"})
+    return recs
+
+
+def test_sse_alert_feed_identical_to_batch_watch(tmp_path):
+    events = tmp_path / "ev.jsonl"
+    events.write_text(
+        "".join(json.dumps(r) + "\n" for r in _surge_stream()))
+
+    # the reference sequence: exactly what batch `watch` prints
+    batch = []
+    w = Watcher(load_rules(RULES), alerts=AlertStream(None))
+    run_watch(iter_stream(events), w, on_alert=batch.append)
+    expect = [json.dumps(a, sort_keys=True) for a in batch]
+    assert len(expect) >= 1
+
+    with _serving(events=events, mode="batch",
+                  rules=load_rules(RULES)) as server:
+        assert server._stream_done.wait(timeout=10)
+        c = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+        try:
+            c.request("GET", "/alerts")
+            r = c.getresponse()
+            assert r.status == 200
+            assert r.getheader("Content-Type") == "text/event-stream"
+            got = []
+            deadline = time.monotonic() + 10.0
+            while len(got) < len(expect) and time.monotonic() < deadline:
+                line = r.fp.readline()
+                if line.startswith(b"event: "):
+                    assert line == b"event: alert\n"
+                elif line.startswith(b"data: "):
+                    got.append(line[6:].rstrip(b"\n").decode("utf-8"))
+        finally:
+            c.close()
+        # frame payloads byte-identical to the batch alert lines
+        assert got == expect
+        st = json.loads(_get(server, "/status")[2])
+        assert st["watch"]["stream_done"] is True
+        assert st["watch"]["events"] == len(_surge_stream()) - 1  # - header
+        assert st["watch"]["alerts"] == len(expect)
+        assert st["alerts"]["total"] == len(expect)
+
+
+# --------------------------------------------------------------------- #
+# the self-SLO watchdog, live on the served path
+
+
+def test_self_slo_pages_about_the_daemon_itself(tmp_path):
+    alerts_path = tmp_path / "alerts.jsonl"
+    history = tmp_path / "history.sqlite3"
+    # every observation breaches (slo 0ms), two close a window, one
+    # window is the whole slow horizon: the second query must page
+    slo = {"latency_slo_ms": 0.0, "window_queries": 2,
+           "fast_burn": 1.0, "slow_burn": 1.0, "slow_windows": 1}
+    with _serving(self_slo=slo, alerts_path=alerts_path,
+                  history=history) as server:
+        for _ in range(2):
+            assert _post(server.port, ADMIT)[0] == 200
+        assert server.self_slo.observations == 2
+        assert server.self_slo.windows == 1
+        assert len(server.self_slo.alerts) == 1
+        a = server.self_slo.alerts[0]
+        assert a["event"] == "alert"
+        assert a["detector"] == "self-slo-burn"
+        assert a["severity"] == "page"
+        assert a["cause"] == "serve-latency"
+        assert a["window_queries"] == 2
+        assert a["t"] == 2.0  # this watchdog's clock: observation index
+        # latched: the third and fourth breaching queries do not re-page
+        for _ in range(2):
+            assert _post(server.port, ADMIT)[0] == 200
+        assert len(server.self_slo.alerts) == 1
+        # the same surfaces cluster incidents use
+        fam = server.registry.counter("watch_alerts_total",
+                                      labelnames=("detector",))
+        assert fam.labeled_values()[("self-slo-burn",)] == 1.0
+        assert server.hub.published == 1  # SSE clients see the self page
+        st = json.loads(_get(server, "/status")[2])
+        assert st["self_slo"] == {"observations": 4, "windows": 2,
+                                  "alerts": 1, "active": True}
+        summary = server.shutdown()
+        assert summary["self_slo_alerts"] == 1
+    # the alert side stream got the record AND its header at finish
+    recs = [json.loads(x) for x in alerts_path.read_text().splitlines()]
+    assert [r.get("detector") for r in recs if r.get("event") == "alert"] \
+        == ["self-slo-burn"]
+    assert any(r.get("stream") == "alerts" for r in recs)
+    with HistoryStore(history) as hs:
+        rows = hs.rows(kind="watch", label="self-slo-burn")
+        assert len(rows) == 1
+        assert rows[0].metrics["cause"] == "serve-latency"
+        assert rows[0].metrics["window_queries"] == 2
+
+
+# --------------------------------------------------------------------- #
+# graceful shutdown
+
+
+def test_shutdown_drains_inflight_and_writes_history(tmp_path):
+    history = tmp_path / "history.sqlite3"
+    with _serving(history=history, drain_s=10.0) as server:
+        assert _post(server.port, ADMIT)[0] == 200
+        slot = server.service.admitted()
+        slot.__enter__()  # a query still in flight when SIGTERM lands
+        box = {}
+        t = threading.Thread(target=lambda: box.update(
+            summary=server.shutdown()), daemon=True)
+        t.start()
+        time.sleep(0.3)
+        assert t.is_alive()  # draining: waiting on the in-flight query
+        assert not server.ready  # but no longer admitting
+        slot.__exit__(None, None, None)
+        t.join(timeout=15)
+        assert not t.is_alive()
+        summary = box["summary"]
+        assert summary["drained"] == 1
+        assert summary["queries"] == 1
+        assert summary["rejections"] == 0
+        assert summary["p99_ms"] > 0.0
+        # idempotent: a second signal during/after the drain is a no-op
+        assert server.shutdown() is summary
+    with HistoryStore(history) as hs:
+        rows = hs.rows(kind="serve")
+    assert len(rows) == 1
+    assert rows[0].label == "session"
+    assert rows[0].run_id == "serve-test"
+    assert rows[0].metrics["queries"] == 1
+    assert rows[0].metrics["drained"] == 1
+    assert rows[0].metrics["uptime_s"] > 0.0
+
+
+# --------------------------------------------------------------------- #
+# satellite 1: the self-gauges stay out of offline registries
+
+
+def test_process_gauges_absent_from_offline_registry():
+    registry = MetricsRegistry()
+    sim = _world()
+    sim.run_until(sim.jobs[len(sim.jobs) // 2].submit_time)
+    service = WhatIfService(sim, horizon=50_000.0, workers=0,
+                            registry=registry)
+    try:
+        service.evaluate([dict(ADMIT)])
+    finally:
+        service.close()
+    text = registry.prometheus_text()
+    # the offline whatif path's registry surface is pinned byte-compat:
+    # merely importing the serving module arms nothing
+    assert "process_uptime_seconds" not in text
+    assert "process_rss_bytes" not in text
+    assert "pool_inflight" not in text
+    update = process_gauges(registry)
+    update()
+    text = registry.prometheus_text()
+    assert "process_uptime_seconds" in text
+    assert "process_rss_bytes" in text
+
+
+# --------------------------------------------------------------------- #
+# the tentpole identity: served document == offline whatif CLI
+
+
+def test_served_document_byte_identical_to_whatif_cli(
+        tmp_path, capsys, monkeypatch):
+    import gpuschedule_tpu.obs.server as server_mod
+
+    queries = [
+        {"kind": "admit", "chips": 8, "duration": 3600},
+        {"kind": "drain", "scope": ["pod", 1], "duration": 3600},
+    ]
+    rc = main([
+        "whatif", *WORLD, "--at", "20000", "--horizon", "40000",
+        "--admit", "chips=8,duration=3600",
+        "--drain", "pod=1,duration=3600",
+    ])
+    assert rc == 0
+    offline = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+
+    # drive the REAL serve CLI in a worker thread: the signal-handler
+    # install is swapped for a test-controlled stop event (signals need
+    # the main thread), everything else is the production path
+    stop = threading.Event()
+    started = {}
+
+    def fake_install(server):
+        started["server"] = server
+        return stop
+
+    monkeypatch.setattr(server_mod, "install_signal_handlers",
+                        fake_install)
+    port = _free_port()
+    box = {}
+    t = threading.Thread(target=lambda: box.update(rc=main([
+        "serve", *WORLD, "--at", "20000", "--horizon", "40000",
+        "--port", str(port), "--drain-s", "2",
+    ])), daemon=True)
+    t.start()
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline and \
+            not started.get("server", None):
+        time.sleep(0.02)
+    server = started["server"]
+    while time.monotonic() < deadline and not server.ready:
+        time.sleep(0.02)
+    assert server.ready
+    try:
+        code, served = _post(port, {"queries": queries})
+        assert code == 200
+    finally:
+        stop.set()
+        t.join(timeout=60)
+    assert not t.is_alive()
+    assert box["rc"] == 0
+
+    # wall-clock-free projections byte-identical: same mirror position,
+    # run identity, config hash, per-query deltas and echoes
+    a = json.dumps(canonical_document(served), sort_keys=True)
+    b = json.dumps(canonical_document(offline), sort_keys=True)
+    assert a == b
+    assert served["run_id"] == offline["run_id"]  # same config hash
+    out = capsys.readouterr().out
+    lines = [json.loads(x) for x in out.strip().splitlines()]
+    announce = [x for x in lines if "serve" in x]
+    assert announce and announce[0]["serve"]["port"] == port
+    summary = [x for x in lines if "serve_summary" in x]
+    assert summary and summary[0]["serve_summary"]["queries"] == 2
+    assert summary[0]["serve_summary"]["drained"] == 1
+
+
+# --------------------------------------------------------------------- #
+# serve smoke (slow)
+
+
+@pytest.mark.slow
+def test_serve_smoke_tool():
+    import importlib.util
+
+    root = Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "serve_smoke", root / "tools" / "serve_smoke.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    res = mod.run_smoke()
+    assert res["ok"], res

@@ -136,6 +136,23 @@ def _time_watch_off(num_jobs: int) -> float:
     return time.perf_counter() - t0
 
 
+def _time_serve_off(num_jobs: int) -> float:
+    # the ISSUE 18 serving-daemon contract at its default (no daemon):
+    # with gpuschedule_tpu.obs.server merely IMPORTED — the state every
+    # `serve`-capable deployment is in — a plain sim.run() must stay the
+    # uninstrumented path.  The serving layer lives entirely outside the
+    # engine (its only hooks are the factored-out result_document and
+    # the AlertStream sink list, both dormant here), so this rung is the
+    # tripwire for any future change that grows engine-side work behind
+    # the serving surfaces.
+    import gpuschedule_tpu.obs.server  # noqa: F401  (disarmed on purpose)
+
+    sim = _fresh_sim(num_jobs)
+    t0 = time.perf_counter()
+    sim.run()
+    return time.perf_counter() - t0
+
+
 def _time_accounting_v1(num_jobs: int) -> float:
     # the ISSUE 11 accounting knob at its default: with the v2 ledger
     # code present in the engine, an explicit accounting="v1" must still
@@ -309,13 +326,14 @@ def run_guard(
     for attempt in range(1, max_attempts + 1):
         base_times, dis_times, samp_times = [], [], []
         prof_times, acct_times, watch_times = [], [], []
-        pt_base_times, pt_off_times = [], []
+        pt_base_times, pt_off_times, serve_times = [], [], []
         _time_baseline(num_jobs)  # warm allocator/caches off the record
         _time_disabled(num_jobs)
         _time_sampling(num_jobs)
         _time_selfprof_off(num_jobs)
         _time_accounting_v1(num_jobs)
         _time_watch_off(num_jobs)
+        _time_serve_off(num_jobs)
         _time_pooltrace_base(num_jobs)
         _time_pooltrace_off(num_jobs)
         for _ in range(attempt_repeats):  # interleaved: drift hits all alike
@@ -325,6 +343,7 @@ def run_guard(
             prof_times.append(_time_selfprof_off(num_jobs))
             acct_times.append(_time_accounting_v1(num_jobs))
             watch_times.append(_time_watch_off(num_jobs))
+            serve_times.append(_time_serve_off(num_jobs))
             pt_base_times.append(_time_pooltrace_base(num_jobs))
             pt_off_times.append(_time_pooltrace_off(num_jobs))
         t_base, t_dis = min(base_times), min(dis_times)
@@ -332,12 +351,14 @@ def run_guard(
         t_prof_off = min(prof_times)
         t_acct_v1 = min(acct_times)
         t_watch_off = min(watch_times)
+        t_serve_off = min(serve_times)
         t_pt_base, t_pt_off = min(pt_base_times), min(pt_off_times)
         ratio = t_dis / t_base if t_base > 0 else float("inf")
         samp_ratio = t_samp / t_base if t_base > 0 else float("inf")
         prof_ratio = t_prof_off / t_base if t_base > 0 else float("inf")
         acct_ratio = t_acct_v1 / t_base if t_base > 0 else float("inf")
         watch_ratio = t_watch_off / t_base if t_base > 0 else float("inf")
+        serve_ratio = t_serve_off / t_base if t_base > 0 else float("inf")
         # the pooltrace rung gates against ITS OWN uninstrumented loop,
         # not the engine baseline: the knob's surface is the what-if
         # evaluator, and that is the pair the <=2% contract binds
@@ -347,6 +368,7 @@ def run_guard(
                    and prof_ratio <= tolerance
                    and acct_ratio <= tolerance
                    and watch_ratio <= tolerance
+                   and serve_ratio <= tolerance
                    and pt_ratio <= tolerance),
             "attempt": attempt,
             "repeats": attempt_repeats,
@@ -362,6 +384,8 @@ def run_guard(
             "accounting_v1_over_baseline": round(acct_ratio, 4),
             "watch_off_s": round(t_watch_off, 6),
             "watch_off_over_baseline": round(watch_ratio, 4),
+            "serve_off_s": round(t_serve_off, 6),
+            "serve_off_over_baseline": round(serve_ratio, 4),
             "pooltrace_base_s": round(t_pt_base, 6),
             "pooltrace_off_s": round(t_pt_off, 6),
             "pooltrace_off_over_baseline": round(pt_ratio, 4),

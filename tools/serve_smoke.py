@@ -1,0 +1,187 @@
+"""Serving-daemon smoke (ISSUE 18 satellite / CI tooling).
+
+One REAL ``serve`` daemon subprocess, driven end to end over HTTP:
+
+- the ``{"serve": ...}`` announce line yields the bound ephemeral port;
+- ``/healthz`` and ``/readyz`` answer;
+- ``/metrics`` parses as Prometheus text exposition and carries the
+  acceptance families (query-latency histogram, rejection counter, pool
+  lifecycle counters, process self-gauges);
+- ``POST /whatif`` answers the admit+drain query pair, and the served
+  document is byte-identical (wall-clock-free projection) to the
+  offline ``whatif`` CLI run as a second subprocess on the same world;
+- the self-SLO watchdog — armed with zero latency budget so every
+  served query breaches — pages about the daemon itself: the alert
+  shows up in ``/status``, on the SSE feed, and in the alert file;
+- SIGTERM drains gracefully: exit code 0 and a ``serve_summary`` line
+  whose counts match what we did.
+
+Run directly (one JSON line, exit 1 on failure) or through the
+slow-marked pytest wrapper (tests/test_serve.py)::
+
+    python tools/serve_smoke.py
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gpuschedule_tpu.sim.whatif import canonical_document
+
+WORLD = [
+    "--synthetic", "12", "--seed", "5", "--cluster", "tpu-v5e",
+    "--dims", "4x4", "--pods", "2", "--policy", "dlas",
+    "--faults", "mtbf=5000,repair=600",
+    "--net", "os=2",
+]
+AT, HORIZON = "20000", "40000"
+# zero latency budget + two-query windows: the second served query MUST
+# page the self-SLO watchdog
+SELF_SLO = ('{"latency_slo_ms": 0.0, "window_queries": 2, '
+            '"fast_burn": 1.0, "slow_burn": 1.0, "slow_windows": 1}')
+QUERIES = [
+    {"kind": "admit", "chips": 8, "duration": 3600},
+    {"kind": "drain", "scope": ["pod", 1], "duration": 3600},
+]
+FAMILIES = (
+    "whatif_query_latency_ms_count", "whatif_rejected_total",
+    "pool_worker_respawns_total", "pool_task_retries_total",
+    "pool_inflight", "process_uptime_seconds", "process_rss_bytes",
+    "watch_alerts_total",
+)
+PROM_LINE = re.compile(
+    r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*.*"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+    r"([-+]?[0-9][0-9.eE+-]*|[-+]?Inf|NaN|nan))$"
+)
+
+
+def _get(port: int, path: str, timeout: float = 10.0):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        c.request("GET", path)
+        r = c.getresponse()
+        return r.status, r.read()
+    finally:
+        c.close()
+
+
+def _post(port: int, payload) -> tuple:
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    try:
+        c.request("POST", "/whatif", body=json.dumps(payload).encode(),
+                  headers={"Content-Type": "application/json"})
+        r = c.getresponse()
+        return r.status, json.loads(r.read())
+    finally:
+        c.close()
+
+
+def _read_one_sse_alert(port: int, timeout: float = 10.0) -> dict:
+    """Attach to /alerts and return the first alert frame (the self-SLO
+    page is already in the backlog by the time we connect)."""
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        c.request("GET", "/alerts")
+        r = c.getresponse()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            line = r.fp.readline()
+            if line.startswith(b"data: "):
+                return json.loads(line[6:].decode("utf-8"))
+        raise TimeoutError("no SSE alert frame within the deadline")
+    finally:
+        c.close()
+
+
+def run_smoke() -> dict:
+    tmpdir = tempfile.mkdtemp(prefix="serve-smoke-")
+    alerts_path = os.path.join(tmpdir, "alerts.jsonl")
+    checks: dict = {}
+
+    # the offline reference document (a second subprocess, same world)
+    offline_cmd = [
+        sys.executable, "-m", "gpuschedule_tpu", "whatif", *WORLD,
+        "--at", AT, "--horizon", HORIZON,
+        "--admit", "chips=8,duration=3600",
+        "--drain", "pod=1,duration=3600",
+    ]
+    offline_out = subprocess.run(
+        offline_cmd, capture_output=True, text=True, timeout=300,
+        check=True,
+    ).stdout
+    offline = json.loads(offline_out.strip().splitlines()[0])
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gpuschedule_tpu", "serve", *WORLD,
+         "--at", AT, "--horizon", HORIZON, "--port", "0",
+         "--self-slo", SELF_SLO, "--alerts", alerts_path,
+         "--drain-s", "5"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        announce = json.loads(proc.stdout.readline())
+        port = announce["serve"]["port"]
+        checks["announce"] = announce["serve"]["mode"] == "batch"
+
+        checks["healthz"] = _get(port, "/healthz") == (200, b"ok\n")
+        checks["readyz"] = _get(port, "/readyz")[0] == 200
+
+        code, served = _post(port, {"queries": QUERIES})
+        checks["whatif_200"] = code == 200 and len(served["queries"]) == 2
+        checks["doc_identity"] = (
+            json.dumps(canonical_document(served), sort_keys=True)
+            == json.dumps(canonical_document(offline), sort_keys=True)
+        )
+
+        # the forced self-SLO page: 2 breaching observations = 1 window
+        status = json.loads(_get(port, "/status")[1])
+        checks["self_slo_paged"] = status["self_slo"]["alerts"] >= 1
+        sse_alert = _read_one_sse_alert(port)
+        checks["sse_self_alert"] = (
+            sse_alert.get("detector") == "self-slo-burn"
+            and sse_alert.get("severity") == "page"
+        )
+
+        code, body = _get(port, "/metrics")
+        text = body.decode("utf-8")
+        bad = [ln for ln in text.splitlines() if not PROM_LINE.match(ln)]
+        missing = [f for f in FAMILIES if f not in text]
+        checks["metrics_parse"] = code == 200 and not bad and not missing
+
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=60)
+        checks["exit_0"] = proc.returncode == 0
+        summary = json.loads(out.strip().splitlines()[-1])["serve_summary"]
+        checks["summary"] = (
+            summary["queries"] == 2 and summary["drained"] == 1
+            and summary["self_slo_alerts"] >= 1
+        )
+        with open(alerts_path) as f:
+            recs = [json.loads(ln) for ln in f if ln.strip()]
+        checks["alert_file"] = (
+            any(r.get("stream") == "alerts" for r in recs)
+            and any(r.get("detector") == "self-slo-burn" for r in recs)
+        )
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=30)
+    return {"ok": all(checks.values()), "checks": checks, "port": port}
+
+
+if __name__ == "__main__":
+    res = run_smoke()
+    print(json.dumps(res, sort_keys=True))
+    sys.exit(0 if res["ok"] else 1)
